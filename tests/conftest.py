@@ -1,0 +1,25 @@
+"""Test configuration.
+
+Tests run on CPU with an 8-device virtual platform, the analogue of the
+reference's oversubscribed single-node MPI tests
+(``.github/workflows/test.yml``, ``#[mpi_test(N)]``): distributed code
+paths execute on a real multi-device ``jax.sharding.Mesh`` without TPU
+hardware.
+
+The session environment may pre-import JAX pointed at TPU hardware
+(sitecustomize), so plain env vars are too late — use jax.config, which
+takes effect as long as no backend has been initialized yet.
+"""
+
+import os
+
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

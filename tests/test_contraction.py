@@ -1,0 +1,239 @@
+"""End-to-end contraction: numpy oracle vs einsum, JAX backend parity,
+and analytically-known quantum results (mirrors
+``tnc/src/tensornetwork/contraction.rs`` tests and
+``circuit_builder.rs:362-453``).
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from tnc_tpu import CompositeTensor, LeafTensor, path
+from tnc_tpu.builders.circuit_builder import Circuit
+from tnc_tpu.contractionpath.contraction_path import ssa_replace_ordering
+from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+from tnc_tpu.tensornetwork.contraction import contract_tensor_network
+from tnc_tpu.tensornetwork.tensordata import TensorData
+
+
+def _random_network(rng):
+    """A small random 4-tensor network with mixed open/contracted legs."""
+    bd = {0: 2, 1: 3, 2: 4, 3: 2, 4: 3, 5: 2}
+    specs = [[0, 1, 2], [2, 3], [3, 4, 1], [4, 5]]
+    tensors = []
+    for legs in specs:
+        dims = [bd[l] for l in legs]
+        data = rng.standard_normal(dims) + 1j * rng.standard_normal(dims)
+        t = LeafTensor.from_map(legs, bd)
+        t.data = TensorData.matrix(data)
+        tensors.append(t)
+    return CompositeTensor(tensors)
+
+
+def _einsum_oracle(tn):
+    """Contract with a single np.einsum call, output legs sorted."""
+    arrays = [t.data.into_data() for t in tn.tensors]
+    operands = []
+    for t, a in zip(tn.tensors, arrays):
+        operands.append(a)
+        operands.append(list(t.legs))
+    out_legs = sorted(tn.external_tensor().legs)
+    operands.append(out_legs)
+    return np.einsum(*operands), out_legs
+
+
+@pytest.mark.parametrize("backend", ["numpy", "jax64"])
+def test_contraction_matches_einsum(backend):
+    rng = np.random.default_rng(42)
+    tn = _random_network(rng)
+    result = Greedy(OptMethod.GREEDY).find_path(tn)
+    out = contract_tensor_network(tn, result.replace_path(), backend=backend)
+
+    expected, out_legs = _einsum_oracle(tn)
+    # Permute our result to sorted leg order for comparison.
+    axes = [out.legs.index(l) for l in out_legs]
+    got = np.transpose(out.data.into_data(), axes)
+    np.testing.assert_allclose(got, expected, atol=1e-10)
+
+
+def test_nested_contraction_equals_flat():
+    """Consistency oracle: same network contracted flat vs partitioned
+    (mirrors ``integration_tests.rs:26-86``)."""
+    rng = np.random.default_rng(1)
+    tn = _random_network(rng)
+    flat_result = Greedy(OptMethod.GREEDY).find_path(tn)
+    flat = contract_tensor_network(tn, flat_result.replace_path())
+
+    nested_tn = CompositeTensor(
+        [
+            CompositeTensor([tn.tensors[0].copy(), tn.tensors[1].copy()]),
+            CompositeTensor([tn.tensors[2].copy(), tn.tensors[3].copy()]),
+        ]
+    )
+    result = Greedy(OptMethod.GREEDY).find_path(nested_tn)
+    nested = contract_tensor_network(nested_tn, result.replace_path())
+
+    axes = [nested.legs.index(l) for l in flat.legs]
+    np.testing.assert_allclose(
+        np.transpose(nested.data.into_data(), axes),
+        flat.data.into_data(),
+        atol=1e-10,
+    )
+
+
+def test_outer_product_contraction():
+    bd = {0: 3, 1: 2}
+    t1 = LeafTensor.from_map([0], bd)
+    t1.data = TensorData.matrix(np.array([1.0, 2.0, 3.0]))
+    t2 = LeafTensor.from_map([1], bd)
+    t2.data = TensorData.matrix(np.array([4.0, 5.0]))
+    tn = CompositeTensor([t1, t2])
+    out = contract_tensor_network(tn, path((0, 1)))
+    assert out.legs == [0, 1]
+    np.testing.assert_allclose(
+        out.data.into_data(), np.outer([1, 2, 3], [4, 5]), atol=1e-14
+    )
+
+
+def test_scalar_result():
+    bd = {0: 4}
+    t1 = LeafTensor.from_map([0], bd)
+    t1.data = TensorData.matrix(np.arange(4.0))
+    t2 = LeafTensor.from_map([0], bd)
+    t2.data = TensorData.matrix(np.ones(4))
+    tn = CompositeTensor([t1, t2])
+    out = contract_tensor_network(tn, path((0, 1)))
+    assert out.legs == []
+    assert out.data.into_data() == pytest.approx(6.0)
+
+
+# -- analytic quantum results ----------------------------------------------
+
+
+def _contract_circuit(tn, permutor=None, backend=None):
+    result = Greedy(OptMethod.GREEDY).find_path(tn)
+    out = contract_tensor_network(tn, result.replace_path(), backend=backend)
+    if permutor is not None:
+        out = permutor.apply(out)
+    return out
+
+
+def test_hadamard_statevector():
+    """n Hadamards -> uniform amplitudes (1/sqrt(2))^n
+    (``circuit_builder.rs:362-385``)."""
+    n = 3
+    circuit = Circuit()
+    reg = circuit.allocate_register(n)
+    for q in reg.qubits():
+        circuit.append_gate(TensorData.gate("h"), [q])
+    tn, permutor = circuit.into_statevector_network()
+    out = _contract_circuit(tn, permutor)
+    amp = (1.0 / math.sqrt(2.0)) ** n
+    np.testing.assert_allclose(
+        out.data.into_data(), np.full((2,) * n, amp), atol=1e-12
+    )
+
+
+def test_ghz_amplitudes():
+    """GHZ: amplitude 1/sqrt(2) on |000> and |111>, 0 elsewhere."""
+    circuit = Circuit()
+    reg = circuit.allocate_register(3)
+    circuit.append_gate(TensorData.gate("h"), [reg.qubit(0)])
+    circuit.append_gate(TensorData.gate("cx"), [reg.qubit(0), reg.qubit(1)])
+    circuit.append_gate(TensorData.gate("cx"), [reg.qubit(1), reg.qubit(2)])
+    tn, permutor = circuit.into_statevector_network()
+    out = _contract_circuit(tn, permutor)
+    sv = out.data.into_data()
+    expected = np.zeros((2, 2, 2), dtype=complex)
+    expected[0, 0, 0] = expected[1, 1, 1] = 1.0 / math.sqrt(2.0)
+    np.testing.assert_allclose(sv, expected, atol=1e-12)
+
+
+def test_ghz_single_amplitude():
+    circuit = Circuit()
+    reg = circuit.allocate_register(3)
+    circuit.append_gate(TensorData.gate("h"), [reg.qubit(0)])
+    circuit.append_gate(TensorData.gate("cx"), [reg.qubit(0), reg.qubit(1)])
+    circuit.append_gate(TensorData.gate("cx"), [reg.qubit(1), reg.qubit(2)])
+    tn, _ = circuit.into_amplitude_network("111")
+    out = _contract_circuit(tn)
+    assert out.data.into_data() == pytest.approx(1.0 / math.sqrt(2.0), abs=1e-12)
+
+
+def test_bitstring_validation():
+    circuit = Circuit()
+    circuit.allocate_register(2)
+    with pytest.raises(ValueError):
+        circuit.into_amplitude_network("0")
+    circuit2 = Circuit()
+    circuit2.allocate_register(1)
+    with pytest.raises(ValueError):
+        circuit2.into_amplitude_network("x")
+
+
+def test_rx_expectation_value():
+    """<psi|Z|psi> after Rx(theta) = cos(theta)
+    (``circuit_builder.rs:388-415``)."""
+    for theta in [0.0, math.pi / 3, math.pi / 2, 1.234]:
+        circuit = Circuit()
+        reg = circuit.allocate_register(1)
+        circuit.append_gate(TensorData.gate("rx", (theta,)), [reg.qubit(0)])
+        tn = circuit.into_expectation_value_network()
+        out = _contract_circuit(tn)
+        assert out.data.into_data() == pytest.approx(math.cos(theta), abs=1e-12)
+
+
+def test_two_qubit_expectation_entangled():
+    """GHZ-2: <ZZ> = 1."""
+    circuit = Circuit()
+    reg = circuit.allocate_register(2)
+    circuit.append_gate(TensorData.gate("h"), [reg.qubit(0)])
+    circuit.append_gate(TensorData.gate("cx"), [reg.qubit(0), reg.qubit(1)])
+    tn = circuit.into_expectation_value_network()
+    out = _contract_circuit(tn)
+    assert out.data.into_data() == pytest.approx(1.0, abs=1e-12)
+
+
+def test_dimension_order_regression():
+    """Leg-order regression guard (v1.0.1 bug fix in the reference
+    CHANGELOG; ``contraction.rs:232-261``): a non-symmetric two-qubit
+    state must come out in qubit order."""
+    circuit = Circuit()
+    reg = circuit.allocate_register(2)
+    circuit.append_gate(TensorData.gate("x"), [reg.qubit(1)])
+    tn, permutor = circuit.into_statevector_network()
+    out = _contract_circuit(tn, permutor)
+    sv = out.data.into_data()
+    expected = np.zeros((2, 2), dtype=complex)
+    expected[0, 1] = 1.0  # |01>: qubit0=0, qubit1=1
+    np.testing.assert_allclose(sv, expected, atol=1e-14)
+
+
+def test_jax_backend_complex64_parity():
+    """TPU dtype (complex64) stays within the 1e-5 parity target."""
+    circuit = Circuit()
+    reg = circuit.allocate_register(3)
+    circuit.append_gate(TensorData.gate("h"), [reg.qubit(0)])
+    circuit.append_gate(TensorData.gate("cx"), [reg.qubit(0), reg.qubit(1)])
+    circuit.append_gate(TensorData.gate("cx"), [reg.qubit(1), reg.qubit(2)])
+    tn, permutor = circuit.into_statevector_network()
+    out = _contract_circuit(tn, permutor, backend="jax")
+    expected = np.zeros((2, 2, 2), dtype=complex)
+    expected[0, 0, 0] = expected[1, 1, 1] = 1.0 / math.sqrt(2.0)
+    np.testing.assert_allclose(out.data.into_data(), expected, atol=1e-5)
+
+
+def test_finalized_circuit_cannot_be_reused():
+    """Finalizers consume the builder; a second call must raise (reuse
+    silently corrupted the network before this guard)."""
+    circuit = Circuit()
+    reg = circuit.allocate_register(1)
+    circuit.append_gate(TensorData.gate("h"), [reg.qubit(0)])
+    circuit.into_amplitude_network("0")
+    with pytest.raises(RuntimeError):
+        circuit.into_amplitude_network("1")
+    with pytest.raises(RuntimeError):
+        circuit.append_gate(TensorData.gate("h"), [reg.qubit(0)])
+    with pytest.raises(RuntimeError):
+        circuit.allocate_register(1)

@@ -1,0 +1,109 @@
+"""Cost models against the reference's doctest values
+(``tnc/src/contractionpath/contraction_cost.rs``).
+"""
+
+from tnc_tpu import CompositeTensor, LeafTensor, path
+from tnc_tpu.contractionpath.contraction_cost import (
+    communication_path_cost,
+    communication_path_op_costs,
+    compute_memory_requirements,
+    contract_cost_tensors,
+    contract_op_cost_tensors,
+    contract_path_cost,
+    contract_size_tensors,
+    contract_size_tensors_bytes,
+)
+from tnc_tpu.contractionpath.contraction_path import ssa_replace_ordering
+
+BOND_DIMS = {0: 5, 1: 7, 2: 9, 3: 11, 4: 13}
+
+
+def _pair():
+    t1 = LeafTensor.from_map([0, 1, 2], BOND_DIMS)
+    t2 = LeafTensor.from_map([2, 3, 4], BOND_DIMS)
+    return t1, t2
+
+
+def test_contract_cost_tensors():
+    t1, t2 = _pair()
+    # (9-1)*2 + 9*6 = 70 per output element? No: s=9 -> (9-1)*2 + 9*6 = 70
+    # times |out| = 5*7*11*13 = 5005 -> 350350 (contraction_cost.rs doctest)
+    assert contract_cost_tensors(t1, t2) == 350350.0
+
+
+def test_contract_op_cost_tensors():
+    t1, t2 = _pair()
+    assert contract_op_cost_tensors(t1, t2) == 45045.0  # 5*7*9*11*13
+
+
+def test_contract_size_tensors():
+    t1, t2 = _pair()
+    assert contract_size_tensors(t1, t2) == 6607.0  # 5005 + 315 + 1287
+    assert contract_size_tensors_bytes(t1, t2) == 6607.0 * 16.0
+
+
+def _simple_network():
+    bd = {0: 5, 1: 2, 2: 6, 3: 8, 4: 1, 5: 3, 6: 4}
+    return CompositeTensor(
+        [
+            LeafTensor.from_map([4, 3, 2], bd),
+            LeafTensor.from_map([0, 1, 3, 2], bd),
+            LeafTensor.from_map([4, 5, 6], bd),
+        ]
+    )
+
+
+def test_contract_path_cost_matches_greedy_fixture():
+    tn = _simple_network()
+    ssa = path((0, 1), (3, 2))
+    replace = ssa_replace_ordering(ssa)
+    flops, size = contract_path_cost(tn.tensors, replace, True)
+    assert flops == 600.0
+    assert size == 538.0
+
+
+def test_compute_memory_requirements():
+    tn = _simple_network()
+    replace = ssa_replace_ordering(path((0, 1), (3, 2)))
+    assert compute_memory_requirements(tn.tensors, replace) == 538.0
+
+
+def test_nested_path_cost():
+    bd = {0: 5, 1: 2, 2: 6, 3: 8, 4: 1, 5: 3, 6: 4}
+    inner = CompositeTensor(
+        [LeafTensor.from_map([4, 3, 2], bd), LeafTensor.from_map([0, 1, 3, 2], bd)]
+    )
+    tn = CompositeTensor([inner, LeafTensor.from_map([4, 5, 6], bd)])
+    nested_path = path({0: path((0, 1))}, (0, 1))
+    flops, size = contract_path_cost(tn.tensors, nested_path, True)
+    # Same contractions as the flat fixture -> same costs.
+    assert flops == 600.0
+    assert size == 538.0
+
+
+def test_communication_path_cost_critical_vs_sum():
+    bd = {0: 4, 1: 4, 2: 4, 3: 4}
+    inputs = [
+        LeafTensor.from_map([0, 1], bd),
+        LeafTensor.from_map([1, 2], bd),
+        LeafTensor.from_map([2, 3], bd),
+        LeafTensor.from_map([3, 0], bd),
+    ]
+    p = [(0, 1), (2, 3), (0, 2)]
+    latencies = [10.0, 20.0, 30.0, 40.0]
+    crit, _ = communication_path_cost(inputs, p, True, True, latencies)
+    total, _ = communication_path_cost(inputs, p, True, False, latencies)
+    # step costs: (0,1): 4^3=64; (2,3): 64; (0,2): legs {0,2}x{2,0} union {0,2} = 16
+    assert crit == 16.0 + max(64.0 + 20.0, 64.0 + 40.0)
+    assert total == 16.0 + (64.0 + 10.0 + 20.0) + (64.0 + 30.0 + 40.0)
+    (par, ser), mem = communication_path_op_costs(inputs, p, True, latencies)
+    assert par == crit
+    assert ser == total
+    assert mem > 0
+
+
+def test_communication_path_single_input():
+    bd = {0: 4}
+    inputs = [LeafTensor.from_map([0], bd)]
+    cost, mem = communication_path_cost(inputs, [], True, True, [7.0])
+    assert cost == 7.0 and mem == 7.0

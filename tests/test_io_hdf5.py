@@ -1,0 +1,70 @@
+"""HDF5 IO round-trips (mirrors ``tnc/src/io/hdf5.rs`` tests; the
+reference uses in-memory core-backed files, we use tmp_path).
+"""
+
+import numpy as np
+import pytest
+
+from tnc_tpu import CompositeTensor, LeafTensor
+from tnc_tpu.io.hdf5 import load_data, load_tensor, store_data
+from tnc_tpu.tensornetwork.tensordata import TensorData
+
+
+@pytest.fixture
+def sample_file(tmp_path):
+    path = str(tmp_path / "tensors.h5")
+    rng = np.random.default_rng(3)
+    bd = {0: 2, 1: 3, 2: 4}
+    specs = [[0, 1], [1, 2]]
+    tensors = []
+    for tid, legs in enumerate(specs):
+        dims = [bd[l] for l in legs]
+        data = rng.standard_normal(dims) + 1j * rng.standard_normal(dims)
+        t = LeafTensor.from_map(legs, bd)
+        t.data = TensorData.matrix(data)
+        store_data(path, tid, t)
+        tensors.append(t)
+    return path, tensors
+
+
+def test_store_load_single(sample_file):
+    path, tensors = sample_file
+    data = load_data(path, 1)
+    np.testing.assert_allclose(data, tensors[1].data.into_data())
+
+
+def test_load_network_lazy(sample_file):
+    path, tensors = sample_file
+    tn = load_tensor(path)
+    assert isinstance(tn, CompositeTensor)
+    assert len(tn) == 2
+    assert tn[0].legs == [0, 1]
+    # Lazy: materialization happens on demand.
+    np.testing.assert_allclose(
+        tn[1].data.into_data(), tensors[1].data.into_data()
+    )
+
+
+def test_load_network_eager(sample_file):
+    path, tensors = sample_file
+    tn = load_tensor(path, lazy=False)
+    np.testing.assert_allclose(tn[0].data.into_data(), tensors[0].data.into_data())
+
+
+def test_output_tensor_skipped(sample_file):
+    path, _ = sample_file
+    out = LeafTensor.from_const([5], 2)
+    out.data = TensorData.matrix(np.zeros(2))
+    store_data(path, -1, out)
+    tn = load_tensor(path)
+    assert len(tn) == 2  # "-1" dataset is ignored on network load
+
+
+def test_file_tensordata_adjoint_roundtrip(sample_file):
+    path, tensors = sample_file
+    ref = TensorData.file(path, 0)
+    adj = ref.adjoint()
+    got = adj.into_data()
+    from tnc_tpu.tensornetwork.tensordata import matrix_adjoint
+
+    np.testing.assert_allclose(got, matrix_adjoint(tensors[0].data.into_data()))

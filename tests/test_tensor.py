@@ -1,0 +1,108 @@
+"""Tensor core: leg algebra, sizes, network queries.
+
+Fixture values mirror the reference's doctests in
+``tnc/src/tensornetwork/tensor.rs``.
+"""
+
+import pytest
+
+from tnc_tpu import CompositeTensor, LeafTensor
+
+
+BOND_DIMS = {1: 2, 2: 4, 3: 6, 4: 3, 5: 9}
+
+
+def test_from_map_and_size():
+    t = LeafTensor.from_map([1, 2, 3], {1: 5, 2: 15, 3: 8})
+    assert t.legs == [1, 2, 3]
+    assert t.bond_dims == [5, 15, 8]
+    assert t.size() == 600.0
+
+
+def test_from_const():
+    t = LeafTensor.from_const([0, 1, 2], 2)
+    assert t.bond_dims == [2, 2, 2]
+    assert t.shape == (2, 2, 2)
+    assert t.dims() == 3
+
+
+def test_difference():
+    t1 = LeafTensor.from_map([1, 2, 3], BOND_DIMS)
+    t2 = LeafTensor.from_map([4, 2, 5], BOND_DIMS)
+    d = t1 - t2
+    assert d.legs == [1, 3]
+    assert d.bond_dims == [2, 6]
+
+
+def test_union():
+    t1 = LeafTensor.from_map([1, 2, 3], BOND_DIMS)
+    t2 = LeafTensor.from_map([4, 2, 5], BOND_DIMS)
+    u = t1 | t2
+    assert u.legs == [1, 2, 3, 4, 5]
+    assert u.bond_dims == [2, 4, 6, 3, 9]
+
+
+def test_intersection():
+    t1 = LeafTensor.from_map([1, 2, 3], BOND_DIMS)
+    t2 = LeafTensor.from_map([4, 2, 5], BOND_DIMS)
+    i = t1 & t2
+    assert i.legs == [2]
+    assert i.bond_dims == [4]
+
+
+def test_symmetric_difference():
+    t1 = LeafTensor.from_map([1, 2, 3], BOND_DIMS)
+    t2 = LeafTensor.from_map([4, 2, 5], BOND_DIMS)
+    x = t1 ^ t2
+    assert x.legs == [1, 3, 4, 5]
+    assert x.bond_dims == [2, 6, 3, 9]
+
+
+def test_mismatched_lengths_raise():
+    with pytest.raises(ValueError):
+        LeafTensor([0, 1], [2])
+
+
+def test_external_tensor():
+    # Shared legs cancel; open legs survive in fold order.
+    bd = {0: 5, 1: 7, 2: 9, 3: 11, 4: 13}
+    tn = CompositeTensor(
+        [
+            LeafTensor.from_map([0, 1, 2], bd),
+            LeafTensor.from_map([2, 3, 4], bd),
+        ]
+    )
+    ext = tn.external_tensor()
+    assert ext.legs == [0, 1, 3, 4]
+    assert ext.bond_dims == [5, 7, 11, 13]
+
+
+def test_external_tensor_nested():
+    bd = {0: 2, 1: 3, 2: 4, 3: 5}
+    inner = CompositeTensor(
+        [LeafTensor.from_map([0, 1], bd), LeafTensor.from_map([1, 2], bd)]
+    )
+    tn = CompositeTensor([inner, LeafTensor.from_map([2, 3], bd)])
+    assert tn.external_tensor().legs == [0, 3]
+
+
+def test_is_connected():
+    bd = {0: 2, 1: 2, 2: 2}
+    connected = CompositeTensor(
+        [LeafTensor.from_map([0, 1], bd), LeafTensor.from_map([1, 2], bd)]
+    )
+    assert connected.is_connected()
+    disconnected = CompositeTensor(
+        [LeafTensor.from_map([0], bd), LeafTensor.from_map([1], bd)]
+    )
+    assert not disconnected.is_connected()
+
+
+def test_nested_tensor_and_count():
+    bd = {0: 2, 1: 3, 2: 4}
+    inner = CompositeTensor(
+        [LeafTensor.from_map([0], bd), LeafTensor.from_map([1], bd)]
+    )
+    tn = CompositeTensor([inner, LeafTensor.from_map([2], bd)])
+    assert tn.nested_tensor([0, 1]).legs == [1]
+    assert tn.total_num_tensors() == 3

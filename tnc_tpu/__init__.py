@@ -1,0 +1,34 @@
+"""tnc_tpu — a TPU-native tensor-network contraction framework.
+
+A from-scratch rebuild of the capabilities of qc-tum/TNC (reference:
+``/root/reference``), designed TPU-first:
+
+- Tensor metadata (legs, bond dimensions, nesting) lives host-side in light
+  Python objects with the same leg set-algebra as the reference
+  (``tnc/src/tensornetwork/tensor.rs``).
+- Execution is a pluggable contractor: a NumPy CPU oracle and a JAX/XLA
+  backend that compiles a whole contraction path into a single jitted
+  program with static shapes, so every pairwise einsum lands on the MXU
+  and intermediates stay in HBM (reference hot loop:
+  ``tnc/src/tensornetwork/contraction.rs:52-57`` dispatches to TBLIS).
+- Path planning (greedy / optimal / branch-and-bound / hyper-optimization,
+  partitioning, simulated-annealing repartitioning) is pure host-side work,
+  exactly as in the reference, and only the emitted replace-format path is
+  shipped to the executor.
+- The distributed fan-in reduce (reference: ``tnc/src/mpi/communication.rs``)
+  is expressed as collectives over a ``jax.sharding.Mesh`` instead of MPI
+  point-to-point sends.
+"""
+
+__version__ = "0.1.0"
+
+from tnc_tpu.tensornetwork.tensor import (  # noqa: F401
+    CompositeTensor,
+    LeafTensor,
+    Tensor,
+)
+from tnc_tpu.tensornetwork.tensordata import TensorData  # noqa: F401
+from tnc_tpu.contractionpath.contraction_path import (  # noqa: F401
+    ContractionPath,
+    path,
+)

@@ -1,0 +1,6 @@
+from tnc_tpu.builders.circuit_builder import (  # noqa: F401
+    Circuit,
+    Permutor,
+    QuantumRegister,
+    Qubit,
+)

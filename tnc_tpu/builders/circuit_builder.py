@@ -1,0 +1,209 @@
+"""Quantum-circuit → tensor-network builder.
+
+Mirror of ``tnc/src/builders/circuit_builder.rs``:
+
+- ``allocate_register(n)`` pushes |0⟩ kets, one edge each
+  (``circuit_builder.rs:176-194``).
+- ``append_gate(data, qubits)`` creates a tensor whose legs are the *new*
+  output edges first, then the old input edges (``edges = new ++ old``,
+  ``circuit_builder.rs:197-220``) — matching the gate storage layout
+  ``(out…, in…)``.
+- Three finalizers: ``into_amplitude_network(bitstring)`` (``0``/``1``/``*``
+  wildcards → open legs), ``into_statevector_network()`` (all wildcards),
+  and ``into_expectation_value_network()`` (circuit + adjoint mirror +
+  Z-observable layer computing ⟨ψ|Z…Z|ψ⟩) (``circuit_builder.rs:235-326``).
+- A :class:`Permutor` restores natural qubit order after contraction,
+  since the contraction can emit the open legs in any order
+  (``circuit_builder.rs:77-122``).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Sequence
+
+import numpy as np
+
+from tnc_tpu.tensornetwork.tensor import CompositeTensor, EdgeIndex, LeafTensor
+from tnc_tpu.tensornetwork.tensordata import TensorData
+
+
+class Qubit:
+    """A single qubit handle (global index into the circuit)."""
+
+    __slots__ = ("index",)
+
+    def __init__(self, index: int) -> None:
+        self.index = index
+
+
+class QuantumRegister:
+    """An array of qubits (``circuit_builder.rs:21-67``)."""
+
+    def __init__(self, base: int, size: int) -> None:
+        self.base = base
+        self.size = size
+
+    def qubit(self, index: int) -> Qubit:
+        if not 0 <= index < self.size:
+            raise IndexError(f"qubit index {index} out of range for register of size {self.size}")
+        return Qubit(self.base + index)
+
+    def qubits(self) -> Iterator[Qubit]:
+        return (Qubit(i) for i in range(self.base, self.base + self.size))
+
+    def __len__(self) -> int:
+        return self.size
+
+    def __getitem__(self, index: int) -> Qubit:
+        return self.qubit(index)
+
+
+class Permutor:
+    """Transposes the final tensor to the target (natural) leg order
+    (``circuit_builder.rs:77-122``).
+    """
+
+    def __init__(self, target_leg_order: Sequence[EdgeIndex]) -> None:
+        self.target_leg_order = list(target_leg_order)
+
+    def is_identity(self) -> bool:
+        return not self.target_leg_order
+
+    def apply(self, tensor: LeafTensor) -> LeafTensor:
+        if self.is_identity():
+            return tensor
+        if sorted(tensor.legs) != sorted(self.target_leg_order):
+            raise ValueError(
+                f"tensor legs {tensor.legs} are not a permutation of target "
+                f"{self.target_leg_order}"
+            )
+        # axes[k] = position in `tensor.legs` of the k-th target leg
+        pos = {leg: i for i, leg in enumerate(tensor.legs)}
+        axes = [pos[leg] for leg in self.target_leg_order]
+        data = np.transpose(tensor.data.into_data(), axes)
+        bond_dims = [tensor.bond_dims[a] for a in axes]
+        return LeafTensor(self.target_leg_order, bond_dims, TensorData.matrix(data))
+
+
+def _ket0() -> TensorData:
+    return TensorData.from_values((2,), [1.0 + 0.0j, 0.0 + 0.0j])
+
+
+def _ket1() -> TensorData:
+    return TensorData.from_values((2,), [0.0 + 0.0j, 1.0 + 0.0j])
+
+
+class Circuit:
+    """Tensor-network circuit builder (``circuit_builder.rs:127-134``)."""
+
+    def __init__(self) -> None:
+        self.open_edges: list[EdgeIndex] = []
+        self.next_edge: int = 0
+        self.tensor_network = CompositeTensor()
+        self._finalized = False
+
+    def _finalize(self) -> None:
+        """Finalizers consume the builder (the reference takes ``self`` by
+        value); a second finalizer call would corrupt the network.
+        """
+        if self._finalized:
+            raise RuntimeError(
+                "Circuit was already converted to a network; build a new Circuit"
+            )
+        self._finalized = True
+
+    def _new_edge(self) -> EdgeIndex:
+        edge = self.next_edge
+        self.next_edge += 1
+        return edge
+
+    def num_qubits(self) -> int:
+        return len(self.open_edges)
+
+    def allocate_register(self, size: int) -> QuantumRegister:
+        """Allocate ``size`` qubits initialized to |0⟩."""
+        if self._finalized:
+            raise RuntimeError("Circuit was already converted to a network")
+        base = self.num_qubits()
+        for _ in range(size):
+            edge = self._new_edge()
+            self.open_edges.append(edge)
+            ket = LeafTensor.from_const([edge], 2)
+            ket.data = _ket0()
+            self.tensor_network.push_tensor(ket)
+        return QuantumRegister(base, size)
+
+    def append_gate(self, gate: TensorData, qubits: Sequence[Qubit]) -> None:
+        """Append a gate tensor acting on ``qubits``; legs = new ++ old."""
+        if self._finalized:
+            raise RuntimeError("Circuit was already converted to a network")
+        indices = [q.index for q in qubits]
+        if len(set(indices)) != len(indices):
+            raise ValueError("Qubit arguments must be unique")
+
+        old_edges = [self.open_edges[i] for i in indices]
+        new_edges = [self.next_edge + k for k in range(len(indices))]
+        self.next_edge += len(indices)
+        for qubit_index, new_edge in zip(indices, new_edges):
+            self.open_edges[qubit_index] = new_edge
+
+        tensor = LeafTensor.from_const(new_edges + old_edges, 2)
+        tensor.data = gate
+        self.tensor_network.push_tensor(tensor)
+
+    # -- finalizers --------------------------------------------------------
+
+    def into_amplitude_network(self, bitstring: str) -> tuple[CompositeTensor, Permutor]:
+        """Close the circuit with ⟨0|/⟨1| bras per the bitstring; ``*``
+        leaves the leg open (statevector slice). Returns the network and a
+        Permutor for the open legs in qubit order.
+        """
+        if len(bitstring) != self.num_qubits():
+            raise ValueError(
+                f"bitstring length {len(bitstring)} != qubit count {self.num_qubits()}"
+            )
+        self._finalize()
+        final_legs: list[EdgeIndex] = []
+        for c, edge in zip(bitstring, self.open_edges):
+            if c == "*":
+                final_legs.append(edge)
+                continue
+            if c == "0":
+                data = _ket0()
+            elif c == "1":
+                data = _ket1()
+            else:
+                raise ValueError("Only 0, 1 and * are allowed in bitstring")
+            bra = LeafTensor.from_const([edge], 2)
+            bra.data = data
+            self.tensor_network.push_tensor(bra)
+        return self.tensor_network, Permutor(final_legs)
+
+    def into_statevector_network(self) -> tuple[CompositeTensor, Permutor]:
+        return self.into_amplitude_network("*" * self.num_qubits())
+
+    @staticmethod
+    def _tensor_adjoint(tensor: LeafTensor, leg_offset: int) -> LeafTensor:
+        """Adjoint with legs half-swapped and offset
+        (``circuit_builder.rs:278-297``).
+        """
+        half = len(tensor.legs) // 2
+        legs = [l + leg_offset for l in tensor.legs[half:] + tensor.legs[:half]]
+        bond_dims = tensor.bond_dims[half:] + tensor.bond_dims[:half]
+        return LeafTensor(legs, bond_dims, tensor.data.adjoint())
+
+    def into_expectation_value_network(self) -> CompositeTensor:
+        """⟨ψ|Z…Z|ψ⟩ network: circuit ++ adjoint mirror ++ Z layer
+        (``circuit_builder.rs:304-326``).
+        """
+        self._finalize()
+        offset = self.next_edge
+        adjoints = [
+            self._tensor_adjoint(t, offset) for t in self.tensor_network.tensors
+        ]
+        self.tensor_network.push_tensors(adjoints)
+        for edge in self.open_edges:
+            observable = LeafTensor.from_const([edge, edge + offset], 2)
+            observable.data = TensorData.gate("z")
+            self.tensor_network.push_tensor(observable)
+        return self.tensor_network

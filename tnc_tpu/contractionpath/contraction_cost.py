@@ -1,0 +1,179 @@
+"""Analytic cost models for contraction paths.
+
+Mirror of ``tnc/src/contractionpath/contraction_cost.rs``. These are the
+framework's "profiler": flops and peak memory are predicted *before* any
+kernel runs, and every optimizer (pathfinding, partition balancing,
+simulated annealing) minimizes these analytic costs. All costs are floats —
+Sycamore-class networks overflow 64-bit integers.
+
+Cost functions on a pair of leaf tensors:
+
+- :func:`contract_cost_tensors` — complex-op count
+  ``((s-1)*2 + s*6) * |out|`` where ``s = |shared|``
+  (``contraction_cost.rs:26-32``)
+- :func:`contract_op_cost_tensors` — naive op count = product of the union
+  dims (``contraction_cost.rs:49-52``)
+- :func:`contract_size_tensors` — ``|out| + |a| + |b|`` elements
+  (``contraction_cost.rs:69-77``); ``_bytes`` variant multiplies by 16
+  (complex128).
+
+Path-level aggregation walks nested paths (accumulating op cost, maxing
+memory) then the toplevel replace-left pairs; the communication variant
+adds per-input start latencies and supports critical-path vs sum metrics
+(``contraction_cost.rs:156-244``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+from tnc_tpu.contractionpath.contraction_path import ContractionPath
+from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor, Tensor
+
+COMPLEX_BYTES = 16.0
+
+CostFn = Callable[[LeafTensor, LeafTensor], float]
+
+
+def contract_cost_tensors(t1: LeafTensor, t2: LeafTensor) -> float:
+    """Complex-operation count of contracting ``t1`` with ``t2``."""
+    final_size = (t1 ^ t2).size()
+    shared_size = (t1 & t2).size()
+    return ((shared_size - 1.0) * 2.0 + shared_size * 6.0) * final_size
+
+
+def contract_op_cost_tensors(t1: LeafTensor, t2: LeafTensor) -> float:
+    """Naive operation count: product of all dims in the union."""
+    return (t1 | t2).size()
+
+
+def contract_size_tensors(t1: LeafTensor, t2: LeafTensor) -> float:
+    """Elements live during the pairwise contraction: out + in1 + in2."""
+    return (t1 ^ t2).size() + t1.size() + t2.size()
+
+
+def contract_size_tensors_bytes(t1: LeafTensor, t2: LeafTensor) -> float:
+    return contract_size_tensors(t1, t2) * COMPLEX_BYTES
+
+
+def _as_external_leaf(t: Tensor) -> LeafTensor:
+    return t.external_tensor() if isinstance(t, CompositeTensor) else t
+
+
+def _contract_path_custom_cost(
+    inputs: Sequence[Tensor],
+    contract_path: ContractionPath,
+    cost_function: CostFn,
+    size_function: CostFn,
+) -> tuple[float, float]:
+    op_cost = 0.0
+    mem_cost = 0.0
+    tensors: list[LeafTensor | Tensor] = list(inputs)
+
+    for i, nested_path in contract_path.nested.items():
+        child = tensors[i]
+        if not isinstance(child, CompositeTensor):
+            raise TypeError(f"nested path at {i} targets a non-composite tensor")
+        nested_op, nested_mem = _contract_path_custom_cost(
+            child.tensors, nested_path, cost_function, size_function
+        )
+        op_cost += nested_op
+        mem_cost = max(mem_cost, nested_mem)
+        tensors[i] = child.external_tensor()
+
+    for i, j in contract_path.toplevel:
+        ti = _as_external_leaf(tensors[i])
+        tj = _as_external_leaf(tensors[j])
+        op_cost += cost_function(ti, tj)
+        mem_cost = max(mem_cost, size_function(ti, tj))
+        tensors[i] = ti ^ tj
+
+    return op_cost, mem_cost
+
+
+def contract_path_cost(
+    inputs: Sequence[Tensor],
+    contract_path: ContractionPath,
+    only_count_ops: bool = False,
+) -> tuple[float, float]:
+    """(op cost, peak element memory) of a nested replace-left path
+    (``contraction_cost.rs:101-151``).
+    """
+    cost_function = contract_op_cost_tensors if only_count_ops else contract_cost_tensors
+    return _contract_path_custom_cost(
+        inputs, contract_path, cost_function, contract_size_tensors
+    )
+
+
+def communication_path_cost(
+    inputs: Sequence[LeafTensor],
+    contract_path: Sequence[tuple[int, int]],
+    only_count_ops: bool = False,
+    only_critical_path: bool = True,
+    tensor_cost: Sequence[float] | None = None,
+) -> tuple[float, float]:
+    """Cost of a flat (communication) path with per-input start latencies.
+
+    With ``only_critical_path`` the accumulated cost of a contraction is
+    ``cost(i,j) + max(latency_i, latency_j)`` — the parallel makespan;
+    otherwise latencies add — the serial sum (``contraction_cost.rs:178-244``).
+    """
+    cost_function = contract_op_cost_tensors if only_count_ops else contract_cost_tensors
+    if tensor_cost is not None:
+        if len(tensor_cost) != len(inputs):
+            raise ValueError("tensor_cost length must match inputs")
+        latencies = list(tensor_cost)
+    else:
+        latencies = [0.0] * len(inputs)
+
+    if len(inputs) == 1:
+        return latencies[0], latencies[0]
+
+    tensors = [t.copy() for t in inputs]
+    op_cost = 0.0
+    mem_cost = 0.0
+    for i, j in contract_path:
+        out = tensors[i] ^ tensors[j]
+        mem_cost = max(mem_cost, contract_size_tensors(tensors[i], tensors[j]))
+        step = cost_function(tensors[i], tensors[j])
+        if only_critical_path:
+            op_cost = step + max(latencies[i], latencies[j])
+        else:
+            op_cost = step + latencies[i] + latencies[j]
+        latencies[i] = op_cost
+        tensors[i] = out
+    return op_cost, mem_cost
+
+
+def communication_path_op_costs(
+    inputs: Sequence[LeafTensor],
+    contract_path: Sequence[tuple[int, int]],
+    only_count_ops: bool = False,
+    tensor_cost: Sequence[float] | None = None,
+) -> tuple[tuple[float, float], float]:
+    """((critical-path cost, sum cost), peak memory)
+    (``contraction_cost.rs:156-167``).
+    """
+    parallel_cost, _ = communication_path_cost(
+        inputs, contract_path, only_count_ops, True, tensor_cost
+    )
+    serial_cost, mem_cost = communication_path_cost(
+        inputs, contract_path, only_count_ops, False, tensor_cost
+    )
+    return (parallel_cost, serial_cost), mem_cost
+
+
+def compute_memory_requirements(
+    inputs: Sequence[Tensor],
+    contract_path: ContractionPath,
+    memory_estimator: CostFn = contract_size_tensors,
+) -> float:
+    """Peak memory of a nested path under ``memory_estimator``
+    (``contraction_cost.rs:254-264``).
+    """
+
+    def zero(_a: LeafTensor, _b: LeafTensor) -> float:
+        return 0.0
+
+    _, mem = _contract_path_custom_cost(inputs, contract_path, zero, memory_estimator)
+    return mem

@@ -1,0 +1,8 @@
+from tnc_tpu.contractionpath.paths.base import (  # noqa: F401
+    BasicContractionPathResult,
+    ContractionPathResult,
+    CostType,
+    Pathfinder,
+)
+from tnc_tpu.contractionpath.paths.greedy import Greedy, OptMethod  # noqa: F401
+from tnc_tpu.contractionpath.paths.optimal import Optimal  # noqa: F401

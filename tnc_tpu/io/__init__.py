@@ -1,0 +1,1 @@
+from tnc_tpu.io.hdf5 import load_data, load_tensor, store_data  # noqa: F401

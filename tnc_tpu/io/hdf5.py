@@ -1,0 +1,71 @@
+"""HDF5 tensor and tensor-network IO.
+
+Mirror of ``tnc/src/io/hdf5.rs:3-67``: file schema is a group ``/tensors``
+with one dataset per tensor named by its tensor id, each carrying a
+``bids`` attribute listing its leg (bond) ids. A dataset named ``-1``
+holds an output tensor and is skipped when loading a network.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+from tnc_tpu.tensornetwork.tensordata import TensorData
+
+TENSORS_GROUP = "tensors"
+OUTPUT_TENSOR_NAME = "-1"
+
+
+def load_data(path: str, tensor_id: int) -> np.ndarray:
+    """Load a single tensor's data (``hdf5.rs:26-38`` load_data)."""
+    import h5py
+
+    with h5py.File(path, "r") as f:
+        dataset = f[TENSORS_GROUP][str(tensor_id)]
+        return np.asarray(dataset[()], dtype=np.complex128)
+
+
+def load_tensor(path: str, lazy: bool = True) -> CompositeTensor:
+    """Load a whole tensor network (``hdf5.rs:40-50`` load_tensor).
+
+    With ``lazy`` (default), leaf data stays a FILE reference and is
+    materialized at contraction time, matching the reference's lazy
+    ``TensorData::File``.
+    """
+    import h5py
+
+    tensors: list[LeafTensor] = []
+    with h5py.File(path, "r") as f:
+        group = f[TENSORS_GROUP]
+        for name in sorted(group, key=lambda s: int(s)):
+            if name == OUTPUT_TENSOR_NAME:
+                continue
+            dataset = group[name]
+            legs = [int(b) for b in dataset.attrs["bids"]]
+            shape = list(dataset.shape)
+            if len(legs) != len(shape):
+                raise ValueError(
+                    f"tensor {name}: {len(legs)} leg ids but rank {len(shape)}"
+                )
+            data = (
+                TensorData.file(path, int(name))
+                if lazy
+                else TensorData.matrix(np.asarray(dataset[()], dtype=np.complex128))
+            )
+            tensors.append(LeafTensor(legs, shape, data))
+    return CompositeTensor(tensors)
+
+
+def store_data(path: str, tensor_id: int, tensor: LeafTensor) -> None:
+    """Store a single tensor (``hdf5.rs:52-67`` store_data)."""
+    import h5py
+
+    data = tensor.data.into_data()
+    with h5py.File(path, "a") as f:
+        group = f.require_group(TENSORS_GROUP)
+        name = str(tensor_id)
+        if name in group:
+            del group[name]
+        dataset = group.create_dataset(name, data=data)
+        dataset.attrs["bids"] = np.asarray(tensor.legs, dtype=np.int64)

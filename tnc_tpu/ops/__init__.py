@@ -1,0 +1,7 @@
+from tnc_tpu.ops.program import ContractionProgram, PairStep, build_program  # noqa: F401
+from tnc_tpu.ops.backends import (  # noqa: F401
+    Backend,
+    JaxBackend,
+    NumpyBackend,
+    get_backend,
+)

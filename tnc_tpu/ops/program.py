@@ -1,0 +1,191 @@
+"""Contraction-path → static execution program compiler.
+
+The reference executes a path as a loop of TBLIS einsum calls, one per pair
+(``tnc/src/tensornetwork/contraction.rs:52-57,88-116``). On TPU, the whole
+path is known before execution and every shape is static, so we compile it
+once into a :class:`ContractionProgram`: a flat list of
+transpose→reshape→matmul→reshape steps. This form
+
+- maps every pairwise contraction onto the MXU as a single matmul,
+- avoids einsum-label limits for high-rank tensors (statevector networks
+  can exceed 50 open legs),
+- is traceable by ``jax.jit`` as one XLA program, so intermediates stay in
+  HBM, elementwise glue is fused, and buffers are freed eagerly
+  (the reference frees inputs per step via ``Option::take``,
+  ``contraction.rs:39,53-56``; XLA liveness analysis does the same here).
+
+A pairwise contraction of ``a`` (legs La) and ``b`` (legs Lb) with shared
+legs S = La∩Lb computes ``out = a_keep × S · S × b_keep`` and produces the
+legs ``(La-Lb) ++ (Lb-La)`` — exactly the reference's ``a ^ b`` ordering,
+so no extra transpose is needed afterwards.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tnc_tpu.contractionpath.contraction_path import ContractionPath
+from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor, Tensor
+
+
+@dataclass(frozen=True)
+class PairStep:
+    """One pairwise contraction, fully shape-resolved."""
+
+    lhs: int  # slot of left input (result replaces this slot)
+    rhs: int  # slot of right input (freed after the step)
+    lhs_perm: tuple[int, ...]  # transpose to (keep…, shared…)
+    rhs_perm: tuple[int, ...]  # transpose to (shared…, keep…)
+    lhs_mat: tuple[int, int]  # (m, k) matmul view of lhs
+    rhs_mat: tuple[int, int]  # (k, n) matmul view of rhs
+    out_shape: tuple[int, ...]  # final result shape for this step
+
+
+@dataclass(frozen=True)
+class ContractionProgram:
+    """A compiled contraction path over ``num_inputs`` flat leaf slots."""
+
+    num_inputs: int
+    steps: tuple[PairStep, ...]
+    result_slot: int
+    result_legs: tuple[int, ...]
+    result_shape: tuple[int, ...]
+
+    def signature(self) -> tuple:
+        """Hashable identity for jit-compilation caching."""
+        return (self.num_inputs, self.steps, self.result_slot)
+
+
+def _pair_step(lhs: int, rhs: int, ta: LeafTensor, tb: LeafTensor) -> tuple[PairStep, LeafTensor]:
+    b_leg_set = set(tb.legs)
+    a_leg_set = set(ta.legs)
+
+    a_keep = [(pos, leg, dim) for pos, (leg, dim) in enumerate(ta.edges()) if leg not in b_leg_set]
+    a_shared = [(pos, leg, dim) for pos, (leg, dim) in enumerate(ta.edges()) if leg in b_leg_set]
+    b_keep = [(pos, leg, dim) for pos, (leg, dim) in enumerate(tb.edges()) if leg not in a_leg_set]
+
+    # Order b's shared axes to match a's shared-leg order.
+    b_pos_of_leg = {leg: pos for pos, leg in enumerate(tb.legs)}
+    b_shared = [(b_pos_of_leg[leg], leg, dim) for (_, leg, dim) in a_shared]
+
+    m = 1
+    for _, _, d in a_keep:
+        m *= d
+    k = 1
+    for _, _, d in a_shared:
+        k *= d
+    n = 1
+    for _, _, d in b_keep:
+        n *= d
+
+    lhs_perm = tuple(p for p, _, _ in a_keep) + tuple(p for p, _, _ in a_shared)
+    rhs_perm = tuple(p for p, _, _ in b_shared) + tuple(p for p, _, _ in b_keep)
+
+    out_legs = [leg for _, leg, _ in a_keep] + [leg for _, leg, _ in b_keep]
+    out_dims = [dim for _, _, dim in a_keep] + [dim for _, _, dim in b_keep]
+    result = LeafTensor(out_legs, out_dims)
+
+    step = PairStep(
+        lhs=lhs,
+        rhs=rhs,
+        lhs_perm=lhs_perm,
+        rhs_perm=rhs_perm,
+        lhs_mat=(m, k),
+        rhs_mat=(k, n),
+        out_shape=tuple(out_dims),
+    )
+    return step, result
+
+
+def build_program(tn: CompositeTensor, contract_path: ContractionPath) -> ContractionProgram:
+    """Compile a (possibly nested) replace-left path over ``tn`` into a flat
+    program. Nested children are flattened: their leaves receive global
+    slots and their nested paths are inlined before the toplevel pairs,
+    preserving the reference's contract-children-first order
+    (``contraction.rs:42-49``).
+    """
+    flat_slots: list[LeafTensor] = []
+    steps: list[PairStep] = []
+
+    def compile_composite(tensors: list[Tensor], cpath: ContractionPath) -> int:
+        """Returns the global slot holding this subnetwork's result."""
+        slot_of: list[int] = []
+        current: list[LeafTensor | None] = []
+        for child in tensors:
+            if isinstance(child, CompositeTensor):
+                slot_of.append(-1)  # filled by nested compilation below
+                current.append(None)
+            else:
+                slot = len(flat_slots)
+                flat_slots.append(child)
+                slot_of.append(slot)
+                current.append(child)
+
+        for i in sorted(cpath.nested):
+            nested_path = cpath.nested[i]
+            child = tensors[i]
+            if not isinstance(child, CompositeTensor):
+                raise TypeError(f"nested path at index {i} targets a leaf")
+            slot = compile_composite(child.tensors, nested_path)
+            slot_of[i] = slot
+            current[i] = child.external_tensor()
+
+        for idx, child in enumerate(tensors):
+            if isinstance(child, CompositeTensor) and slot_of[idx] == -1:
+                raise ValueError(
+                    f"composite child {idx} has no nested contraction path"
+                )
+
+        for i, j in cpath.toplevel:
+            ta, tb = current[i], current[j]
+            if ta is None or tb is None:
+                raise ValueError(f"path step ({i}, {j}) uses a consumed tensor")
+            step, result = _pair_step(slot_of[i], slot_of[j], ta, tb)
+            steps.append(step)
+            current[i] = result
+            current[j] = None
+
+        survivors = [idx for idx, t in enumerate(current) if t is not None]
+        if len(survivors) != 1:
+            raise ValueError(
+                f"path does not fully contract: {len(survivors)} tensors remain"
+            )
+        return slot_of[survivors[0]]
+
+    result_slot = compile_composite(list(tn.tensors), contract_path)
+
+    # Recover result legs/shape by replaying metadata.
+    metas: list[LeafTensor | None] = [t.copy() for t in flat_slots]
+    for step in steps:
+        ta, tb = metas[step.lhs], metas[step.rhs]
+        assert ta is not None and tb is not None
+        metas[step.lhs] = ta ^ tb
+        metas[step.rhs] = None
+    final = metas[result_slot]
+    assert final is not None
+
+    return ContractionProgram(
+        num_inputs=len(flat_slots),
+        steps=tuple(steps),
+        result_slot=result_slot,
+        result_legs=tuple(final.legs),
+        result_shape=tuple(final.bond_dims),
+    )
+
+
+def flat_leaf_tensors(tn: CompositeTensor) -> list[LeafTensor]:
+    """Leaves of ``tn`` in the same order `build_program` assigns slots."""
+    out: list[LeafTensor] = []
+
+    def visit(tensors: list[Tensor]) -> None:
+        for child in tensors:
+            if isinstance(child, CompositeTensor):
+                pass
+            else:
+                out.append(child)
+        for child in tensors:
+            if isinstance(child, CompositeTensor):
+                visit(child.tensors)
+
+    visit(list(tn.tensors))
+    return out

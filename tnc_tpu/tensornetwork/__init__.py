@@ -1,0 +1,6 @@
+from tnc_tpu.tensornetwork.tensor import (  # noqa: F401
+    CompositeTensor,
+    LeafTensor,
+    Tensor,
+)
+from tnc_tpu.tensornetwork.tensordata import TensorData  # noqa: F401

@@ -1,0 +1,44 @@
+"""Tensor-network contraction.
+
+Public equivalent of ``tnc/src/tensornetwork/contraction.rs:35-68``:
+``contract_tensor_network(tn, path)`` fully contracts a (possibly nested)
+network along a replace-left path and returns the resulting leaf tensor.
+
+Unlike the reference's step-at-a-time TBLIS loop, the path is first
+compiled to a static :class:`~tnc_tpu.ops.program.ContractionProgram` and
+then executed by a pluggable backend — ``numpy`` (CPU oracle) or ``jax``
+(whole-path jit on TPU). Leaf data (gates, files) is materialized lazily
+here, at the host→device boundary, matching the reference's lazy
+``TensorData::into_data`` (``tensordata.rs:37-56``).
+"""
+
+from __future__ import annotations
+
+from tnc_tpu.contractionpath.contraction_path import ContractionPath
+from tnc_tpu.ops.backends import Backend, get_backend
+from tnc_tpu.ops.program import build_program, flat_leaf_tensors
+from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+from tnc_tpu.tensornetwork.tensordata import TensorData
+
+
+def contract_tensor_network(
+    tn: CompositeTensor,
+    contract_path: ContractionPath,
+    backend: str | Backend | None = None,
+) -> LeafTensor:
+    """Fully contract ``tn`` along ``contract_path`` (replace-left format).
+
+    Returns a :class:`LeafTensor` whose legs follow the fold of the
+    ``^`` (symmetric-difference) operator over the path, as in the
+    reference, and whose data is a materialized matrix.
+    """
+    backend_obj = get_backend(backend)
+    program = build_program(tn, contract_path)
+    leaves = flat_leaf_tensors(tn)
+    arrays = [leaf.data.into_data() for leaf in leaves]
+    result = backend_obj.execute(program, arrays)
+    return LeafTensor(
+        list(program.result_legs),
+        list(program.result_shape),
+        TensorData.matrix(result),
+    )

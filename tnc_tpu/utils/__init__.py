@@ -1,0 +1,1 @@
+from tnc_tpu.utils.datastructures import UnionFind  # noqa: F401
