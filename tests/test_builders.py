@@ -1,0 +1,146 @@
+"""Circuit generators: connectivity maps, Sycamore, random circuits, PEPS
+(mirrors tests in ``tnc/src/builders/``).
+"""
+
+import numpy as np
+import pytest
+
+from tnc_tpu.builders.connectivity import (
+    Connectivity,
+    ConnectivityLayout,
+    all_connect,
+    condor_connect,
+    eagle_connect,
+    line_connect,
+    osprey_connect,
+    sycamore_a,
+    sycamore_b,
+    sycamore_c,
+    sycamore_d,
+    sycamore_connect,
+)
+from tnc_tpu.builders.peps import peps
+from tnc_tpu.builders.random_circuit import (
+    random_circuit,
+    random_circuit_with_set_observable,
+)
+from tnc_tpu.builders.sycamore_circuit import sycamore_circuit
+from tnc_tpu.builders.tensorgeneration import random_sparse_tensor_data
+from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+from tnc_tpu.tensornetwork.contraction import contract_tensor_network
+from tnc_tpu.tensornetwork.tensordata import DataKind
+
+
+def test_line_and_all_connect():
+    assert line_connect(4) == [(0, 1), (1, 2), (2, 3)]
+    assert all_connect(4) == [(0, 1), (0, 2), (0, 3), (1, 2), (1, 3), (2, 3)]
+    assert Connectivity.new(ConnectivityLayout.LINE, 3).connectivity == [(0, 1), (1, 2)]
+
+
+def test_sycamore_patterns_subset_of_graph():
+    """Every per-round activation edge exists in the full coupling graph."""
+    full = {frozenset(e) for e in sycamore_connect()}
+    for pattern in [sycamore_a, sycamore_b, sycamore_c, sycamore_d]:
+        for e in pattern():
+            assert frozenset(e) in full, e
+
+
+def test_hexagon_device_sizes():
+    """Heavy-hex qubit counts of the IBM devices."""
+    for edges, expected_qubits in [
+        (eagle_connect(), 127),
+        (osprey_connect(), 433),
+        (condor_connect(), 1121),
+    ]:
+        qubits = {q for e in edges for q in e}
+        assert max(qubits) + 1 == expected_qubits
+
+
+def test_sycamore_circuit_structure():
+    """3-qubit depth-3 Sycamore (mirrors ``sycamore_circuit.rs`` test):
+    6 rank-1 states, 12 single-qubit gates, 1 two-qubit gate."""
+    rng = np.random.default_rng(42)
+    circuit = sycamore_circuit(3, 3, rng)
+    tn, _ = circuit.into_amplitude_network("000")
+    rank_counts = {}
+    for t in tn:
+        rank_counts[t.dims()] = rank_counts.get(t.dims(), 0) + 1
+    assert rank_counts[1] == 6
+    assert rank_counts[2] == 12
+    assert rank_counts[4] == 1
+
+
+def test_sycamore_53_builds():
+    rng = np.random.default_rng(0)
+    circuit = sycamore_circuit(53, 2, rng)
+    tn, _ = circuit.into_amplitude_network("0" * 53)
+    assert tn.external_tensor().legs == []
+    with pytest.raises(ValueError):
+        sycamore_circuit(54, 1)
+
+
+def test_random_circuit_closed_network():
+    rng = np.random.default_rng(7)
+    tn = random_circuit(6, 4, 0.8, 0.6, rng, ConnectivityLayout.LINE)
+    assert tn.external_tensor().legs == []
+    assert tn.is_connected()
+
+
+def test_random_circuit_contractible():
+    rng = np.random.default_rng(5)
+    tn = random_circuit(5, 3, 0.9, 0.7, rng, ConnectivityLayout.LINE)
+    result = Greedy(OptMethod.GREEDY).find_path(tn)
+    out = contract_tensor_network(tn, result.replace_path())
+    amp = complex(out.data.into_data())
+    assert abs(amp) <= 1.0 + 1e-9  # an amplitude of a normalized state
+
+
+def test_observable_network_real_expectation():
+    """The mirrored network is a genuine expectation value of a Hermitian
+    observable -> the contracted value must be real."""
+    rng = np.random.default_rng(11)
+    tn = random_circuit_with_set_observable(
+        4, 3, 1.0, 1.0, [1, 2], rng, ConnectivityLayout.LINE
+    )
+    assert tn.external_tensor().legs == []
+    result = Greedy(OptMethod.GREEDY).find_path(tn)
+    out = contract_tensor_network(tn, result.replace_path())
+    value = complex(out.data.into_data())
+    assert abs(value.imag) < 1e-10
+
+
+def test_observable_lightcone_skips_gates():
+    """With no observables, no gates or states are placed at all."""
+    rng = np.random.default_rng(3)
+    tn = random_circuit_with_set_observable(
+        4, 3, 1.0, 1.0, [], rng, ConnectivityLayout.LINE
+    )
+    assert len(tn) == 0
+
+
+def test_random_sparse_tensor_data():
+    data = random_sparse_tensor_data([5, 4, 3], 0.3)
+    assert data.kind is DataKind.MATRIX
+    arr = data.payload
+    fill = np.count_nonzero(arr) / arr.size
+    assert fill >= 0.3
+
+
+def test_peps_structure():
+    length, depth, layers = 3, 2, 2
+    tn = peps(length, depth, 2, 4, layers)
+    assert len(tn) == (layers + 2) * length * depth
+    assert tn.external_tensor().legs == []  # closed network
+    assert tn.is_connected()
+    # Corner tensor of the bottom layer: 1 physical + 2 virtual legs.
+    assert tn[0].dims() == 3
+    # Path planning works on the metadata-only network.
+    result = Greedy(OptMethod.GREEDY).find_path(tn)
+    assert result.flops > 0
+
+
+def test_peps_validation():
+    with pytest.raises(ValueError):
+        peps(1, 2, 2, 2, 1)
+    with pytest.raises(ValueError):
+        peps(2, 1, 2, 2, 1)

@@ -237,3 +237,41 @@ def test_finalized_circuit_cannot_be_reused():
         circuit.append_gate(TensorData.gate("h"), [reg.qubit(0)])
     with pytest.raises(RuntimeError):
         circuit.allocate_register(1)
+
+
+def test_nested_path_axis_order_regression():
+    """A nested path whose contraction tree is not left-deep in child order
+    must still produce correct results: the child result's axis order
+    follows the nested path's fold, not the child's tensor order."""
+    rng = np.random.default_rng(9)
+    bd = {10: 2, 11: 3, 12: 4, 13: 5, 14: 2}
+
+    def leaf(legs):
+        t = LeafTensor.from_map(legs, bd)
+        dims = [bd[l] for l in legs]
+        t.data = TensorData.matrix(
+            rng.standard_normal(dims) + 1j * rng.standard_normal(dims)
+        )
+        return t
+
+    inner = CompositeTensor([leaf([10, 11]), leaf([11, 12, 14]), leaf([12, 14, 13])])
+    tn = CompositeTensor([inner, leaf([10]), leaf([13])])
+
+    # Hand-built nested path starting at child 1 (not left-deep at 0).
+    nested = path({0: path((1, 2), (1, 0))}, (0, 1), (0, 2))
+    out = contract_tensor_network(tn, nested)
+
+    # Oracle: single einsum over all five leaves.
+    leaves = [inner[0], inner[1], inner[2], tn[1], tn[2]]
+    operands = []
+    for t in leaves:
+        operands.append(t.data.into_data())
+        operands.append(list(t.legs))
+    operands.append([])
+    expected = np.einsum(*operands)
+    np.testing.assert_allclose(complex(out.data.into_data()), expected, atol=1e-10)
+
+    # And via the stock pathfinder on the same nested structure.
+    result = Greedy(OptMethod.GREEDY).find_path(tn)
+    out2 = contract_tensor_network(tn, result.replace_path())
+    np.testing.assert_allclose(complex(out2.data.into_data()), expected, atol=1e-10)

@@ -1,0 +1,215 @@
+"""QASM2 import: parsing, inlining, broadcasting, and end-to-end goldens
+(mirrors ``tnc/tests/integration_tests.rs:170-244`` and
+``io/qasm`` unit tests).
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+from tnc_tpu.io.qasm import import_qasm
+from tnc_tpu.io.qasm.importer import QasmError
+from tnc_tpu.tensornetwork.contraction import contract_tensor_network
+
+
+def _contract(tn, permutor=None):
+    result = Greedy(OptMethod.GREEDY).find_path(tn)
+    out = contract_tensor_network(tn, result.replace_path())
+    if permutor is not None:
+        out = permutor.apply(out)
+    return out.data.into_data()
+
+
+def test_ghz_qasm():
+    code = """
+    OPENQASM 2.0;
+    include "qelib1.inc";
+    qreg q[3];
+    h q[0];
+    cx q[0], q[1];
+    cx q[1], q[2];
+    """
+    circuit = import_qasm(code)
+    tn, perm = circuit.into_statevector_network()
+    sv = _contract(tn, perm).ravel()
+    expected = np.zeros(8, dtype=complex)
+    expected[0] = expected[7] = 1.0 / math.sqrt(2.0)
+    np.testing.assert_allclose(sv, expected, atol=1e-12)
+
+
+def test_dj_4qubits_statevector():
+    """Deutsch-Jozsa golden (``integration_tests.rs:170-217``):
+    result is 1/sqrt(2) * (|1110> - |1111>)."""
+    code = """OPENQASM 2.0;
+    include "qelib1.inc";
+    qreg q[4];
+    creg c[3];
+    u2(0,0) q[0];
+    u2(0,0) q[1];
+    h q[2];
+    u2(-pi,-pi) q[3];
+    cx q[0],q[3];
+    u2(-pi,-pi) q[0];
+    cx q[1],q[3];
+    u2(-pi,-pi) q[1];
+    cx q[2],q[3];
+    h q[2];"""
+    circuit = import_qasm(code)
+    tn, perm = circuit.into_statevector_network()
+    sv = _contract(tn, perm).ravel()
+    expected = np.zeros(16, dtype=complex)
+    expected[14] = 1.0 / math.sqrt(2.0)
+    expected[15] = -1.0 / math.sqrt(2.0)
+    np.testing.assert_allclose(sv, expected, atol=1e-14)
+
+
+def test_qft_2qubits_expectation():
+    """QFT-2 expectation golden = 0.5 (``integration_tests.rs:219-244``)."""
+    code = """OPENQASM 2.0;
+    include "qelib1.inc";
+    qreg q[2];
+    creg meas[2];
+    h q[1];
+    cx q[1],q[0];
+    h q[1];
+    cp(pi/2) q[1],q[0];
+    h q[0];
+    swap q[0],q[1];"""
+    circuit = import_qasm(code)
+    tn = circuit.into_expectation_value_network()
+    value = complex(_contract(tn))
+    assert value == pytest.approx(0.5, abs=1e-14)
+
+
+def test_register_broadcasting():
+    """h q; applies h to every qubit of the register."""
+    code = """
+    OPENQASM 2.0;
+    include "qelib1.inc";
+    qreg q[3];
+    h q;
+    """
+    circuit = import_qasm(code)
+    tn, perm = circuit.into_statevector_network()
+    sv = _contract(tn, perm)
+    amp = (1.0 / math.sqrt(2.0)) ** 3
+    np.testing.assert_allclose(sv, np.full((2, 2, 2), amp), atol=1e-12)
+
+
+def test_two_register_broadcast():
+    """cx a, b; broadcasts elementwise over equal-size registers."""
+    code = """
+    OPENQASM 2.0;
+    include "qelib1.inc";
+    qreg a[2];
+    qreg b[2];
+    x a;
+    cx a, b;
+    """
+    circuit = import_qasm(code)
+    tn, perm = circuit.into_statevector_network()
+    sv = _contract(tn, perm).ravel()
+    expected = np.zeros(16, dtype=complex)
+    expected[0b1111] = 1.0  # all four qubits flipped
+    np.testing.assert_allclose(sv, expected, atol=1e-12)
+
+
+def test_user_gate_inlining():
+    """A user-defined gate inlines down to registry builtins with
+    parameter substitution."""
+    code = """
+    OPENQASM 2.0;
+    include "qelib1.inc";
+    gate myrot(a) q { rx(2*a) q; }
+    qreg q[1];
+    myrot(pi/6) q[0];
+    """
+    circuit = import_qasm(code)
+    tn = circuit.into_expectation_value_network()
+    value = complex(_contract(tn))
+    assert value == pytest.approx(math.cos(math.pi / 3.0), abs=1e-12)
+
+
+def test_primitive_u_and_cx():
+    code = """
+    OPENQASM 2.0;
+    qreg q[2];
+    U(pi, 0, pi) q[0];
+    CX q[0], q[1];
+    """
+    circuit = import_qasm(code)
+    tn, perm = circuit.into_statevector_network()
+    sv = _contract(tn, perm).ravel()
+    expected = np.zeros(4, dtype=complex)
+    expected[3] = 1.0  # |11>
+    np.testing.assert_allclose(np.abs(sv), np.abs(expected), atol=1e-12)
+
+
+def test_unsupported_statements_raise():
+    for snippet in [
+        "measure q[0] -> c[0];",
+        "reset q[0];",
+        "if (c == 1) x q[0];",
+    ]:
+        code = f"""
+        OPENQASM 2.0;
+        include "qelib1.inc";
+        qreg q[1];
+        creg c[1];
+        {snippet}
+        """
+        with pytest.raises(QasmError):
+            import_qasm(code)
+
+
+def test_unknown_gate_raises():
+    with pytest.raises(QasmError):
+        import_qasm("OPENQASM 2.0;\nqreg q[1];\nfoo q[0];")
+
+
+def test_mismatched_broadcast_raises():
+    code = """
+    OPENQASM 2.0;
+    include "qelib1.inc";
+    qreg a[2];
+    qreg b[3];
+    cx a, b;
+    """
+    with pytest.raises(QasmError):
+        import_qasm(code)
+
+
+def test_qelib_gate_coverage():
+    """A sweep of qelib1 gates all inline and contract to a normalized state."""
+    code = """
+    OPENQASM 2.0;
+    include "qelib1.inc";
+    qreg q[3];
+    u3(0.1, 0.2, 0.3) q[0];
+    u2(0.4, 0.5) q[1];
+    u1(0.6) q[2];
+    s q[0];
+    sdg q[1];
+    t q[2];
+    tdg q[0];
+    rx(0.7) q[1];
+    ry(0.8) q[2];
+    rz(0.9) q[0];
+    sx q[1];
+    sxdg q[2];
+    p(1.0) q[0];
+    id q[1];
+    cy q[0], q[1];
+    ch q[1], q[2];
+    ccx q[0], q[1], q[2];
+    crz(0.3) q[0], q[2];
+    cu1(0.4) q[1], q[2];
+    cu3(0.5, 0.6, 0.7) q[0], q[1];
+    rzz(0.8) q[1], q[2];
+    """
+    circuit = import_qasm(code)
+    tn, perm = circuit.into_statevector_network()
+    sv = _contract(tn, perm).ravel()
+    assert np.linalg.norm(sv) == pytest.approx(1.0, abs=1e-10)

@@ -1,0 +1,94 @@
+"""PEPS sandwich network generation.
+
+Equivalent of ``tnc/src/builders/peps.rs:446-460``: builds the 2-D tensor
+network of ⟨PEPS|PEPO^layers|PEPS⟩ on a ``length × depth`` grid — a bottom
+PEPS layer, ``layers`` PEPO layers, and a top (bra) PEPS layer. Virtual
+bonds (dimension ``virtual_dim``) connect lattice neighbours within a
+layer; physical bonds (dimension ``physical_dim``) connect consecutive
+layers vertically. The network is closed (no open legs). Tensors are
+metadata-only, as in the reference — the structure is a planning/benchmark
+workload.
+
+The reference writes out corner/edge/bulk leg arithmetic explicitly
+(~900 lines); here a single edge allocator handles all cases.
+"""
+
+from __future__ import annotations
+
+from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+
+
+def peps(
+    length: int,
+    depth: int,
+    physical_dim: int,
+    virtual_dim: int,
+    layers: int,
+) -> CompositeTensor:
+    """Build the closed PEPS/PEPO sandwich network.
+
+    Total tensors: ``(layers + 2) * length * depth``.
+    """
+    if length < 2:
+        raise ValueError("PEPS should have length greater than 1")
+    if depth < 2:
+        raise ValueError("PEPS should have depth greater than 1")
+
+    next_edge = 0
+
+    def new_edge() -> int:
+        nonlocal next_edge
+        edge = next_edge
+        next_edge += 1
+        return edge
+
+    n_layers = layers + 2  # bottom PEPS + PEPOs + top PEPS
+    tensors: list[LeafTensor] = []
+
+    # Virtual bonds within each layer: right[(k, r, c)] connects (r, c)-(r, c+1),
+    # down[(k, r, c)] connects (r, c)-(r+1, c).
+    right: dict[tuple[int, int, int], int] = {}
+    down: dict[tuple[int, int, int], int] = {}
+    for k in range(n_layers):
+        for r in range(depth):
+            for c in range(length):
+                if c + 1 < length:
+                    right[(k, r, c)] = new_edge()
+                if r + 1 < depth:
+                    down[(k, r, c)] = new_edge()
+
+    # Physical bonds between consecutive layers.
+    vertical: dict[tuple[int, int, int], int] = {}
+    for k in range(n_layers - 1):
+        for r in range(depth):
+            for c in range(length):
+                vertical[(k, r, c)] = new_edge()
+
+    for k in range(n_layers):
+        for r in range(depth):
+            for c in range(length):
+                legs: list[int] = []
+                dims: list[int] = []
+                # Physical legs: down to layer below, up to layer above.
+                if k > 0:
+                    legs.append(vertical[(k - 1, r, c)])
+                    dims.append(physical_dim)
+                if k + 1 < n_layers:
+                    legs.append(vertical[(k, r, c)])
+                    dims.append(physical_dim)
+                # Virtual bonds: left, right, up, down within the layer.
+                if c > 0:
+                    legs.append(right[(k, r, c - 1)])
+                    dims.append(virtual_dim)
+                if c + 1 < length:
+                    legs.append(right[(k, r, c)])
+                    dims.append(virtual_dim)
+                if r > 0:
+                    legs.append(down[(k, r - 1, c)])
+                    dims.append(virtual_dim)
+                if r + 1 < depth:
+                    legs.append(down[(k, r, c)])
+                    dims.append(virtual_dim)
+                tensors.append(LeafTensor(legs, dims))
+
+    return CompositeTensor(tensors)

@@ -1,0 +1,203 @@
+"""Random circuit generation.
+
+Mirror of ``tnc/src/builders/random_circuit.rs``:
+
+- :func:`random_circuit` — ``rounds`` rounds of Bernoulli-placed
+  {sx, sy, sz} single-qubit gates and fsim(0.3, 0.2) two-qubit gates on a
+  connectivity graph, closed as a |0…0⟩ amplitude network
+  (``random_circuit.rs:29-80``).
+- :func:`random_circuit_with_observable` /
+  :func:`random_circuit_with_set_observable` — builds a ⟨O⟩
+  expectation-value network *directly*: observables sit in the middle,
+  each gate appears paired with its adjoint on the mirror side, and gates
+  with no causal effect on any observable are skipped entirely
+  (``random_circuit.rs:88-275``). Note: the reference pairs sy/sz with an
+  sx adjoint on the mirror side (``random_circuit.rs:133-145``), which is
+  an apparent copy-paste slip; here each gate is mirrored by its own
+  adjoint so the network is a true expectation value.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tnc_tpu.builders.circuit_builder import Circuit
+from tnc_tpu.builders.connectivity import Connectivity, ConnectivityLayout
+from tnc_tpu.builders.tensorgeneration import random_sparse_tensor_data_with_rng
+from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+from tnc_tpu.tensornetwork.tensordata import TensorData
+
+_SINGLE_QUBIT_GATES = ("sx", "sy", "sz")
+_OBSERVABLES = ("x", "y", "z")
+_FSIM_ANGLES = (0.3, 0.2)
+
+
+def _filtered_connectivity(
+    layout: ConnectivityLayout, qubits: int
+) -> list[tuple[int, int]]:
+    graph = Connectivity.new(layout, qubits)
+    return [(u, v) for (u, v) in graph.connectivity if u < qubits and v < qubits]
+
+
+def random_circuit(
+    qubits: int,
+    rounds: int,
+    single_qubit_probability: float,
+    two_qubit_probability: float,
+    rng: np.random.Generator,
+    connectivity: ConnectivityLayout,
+) -> CompositeTensor:
+    """Random circuit closed as a |0…0⟩ amplitude network."""
+    connectivity_pairs = _filtered_connectivity(connectivity, qubits)
+
+    circuit = Circuit()
+    qr = circuit.allocate_register(qubits)
+
+    for _ in range(1, rounds):
+        for i in range(qubits):
+            if rng.random() < single_qubit_probability:
+                name = _SINGLE_QUBIT_GATES[int(rng.integers(0, 3))]
+                circuit.append_gate(TensorData.gate(name), [qr.qubit(i)])
+        for i, j in connectivity_pairs:
+            if rng.random() < two_qubit_probability:
+                circuit.append_gate(
+                    TensorData.gate("fsim", _FSIM_ANGLES), [qr.qubit(i), qr.qubit(j)]
+                )
+
+    return circuit.into_amplitude_network("0" * qubits)[0]
+
+
+def random_circuit_with_observable(
+    qubits: int,
+    rounds: int,
+    single_qubit_probability: float,
+    two_qubit_probability: float,
+    observable_probability: float,
+    rng: np.random.Generator,
+    connectivity: ConnectivityLayout,
+) -> CompositeTensor:
+    """Random ⟨O⟩ network with Bernoulli-placed observables."""
+    observable_locations = [
+        i for i in range(qubits) if rng.random() < observable_probability
+    ]
+    return random_circuit_with_set_observable(
+        qubits,
+        rounds,
+        single_qubit_probability,
+        two_qubit_probability,
+        observable_locations,
+        rng,
+        connectivity,
+    )
+
+
+def random_circuit_with_set_observable(
+    qubits: int,
+    rounds: int,
+    single_qubit_probability: float,
+    two_qubit_probability: float,
+    observable_location: list[int],
+    rng: np.random.Generator,
+    connectivity: ConnectivityLayout,
+) -> CompositeTensor:
+    """Random ⟨O⟩ network with observables on the given qubits.
+
+    Gate placement walks *outward* from the observable layer: a qubit whose
+    forward and backward edges coincide (no observable in its causal cone
+    yet) contributes nothing, so gates there are skipped — the reference's
+    light-cone optimization (``random_circuit.rs:190-255``).
+
+    Each qubit's ``open_edges[i] = (left, right)`` tracks the next open leg
+    on the circuit side (left) and the adjoint-mirror side (right).
+    """
+    tn = CompositeTensor()
+    observable_set = set(observable_location)
+
+    open_edges: dict[int, tuple[int, int]] = {}
+    next_edge = 0
+
+    # Observable layer in the middle.
+    for i in range(qubits):
+        if i in observable_set:
+            open_edges[i] = (next_edge, next_edge + 1)
+            name = _OBSERVABLES[int(rng.integers(0, 3))]
+            obs = LeafTensor.from_const([next_edge, next_edge + 1], 2)
+            obs.data = TensorData.gate(name)
+            tn.push_tensor(obs)
+            next_edge += 2
+        else:
+            open_edges[i] = (0, 0)  # sentinel: not yet in any causal cone
+
+    connectivity_pairs = _filtered_connectivity(connectivity, qubits)
+
+    for _ in range(1, rounds):
+        # Two-qubit gates (and their mirror adjoints), only where they can
+        # affect an observable.
+        for i, j in connectivity_pairs:
+            if rng.random() >= two_qubit_probability:
+                continue
+            i_open = open_edges[i][0] != open_edges[i][1]
+            j_open = open_edges[j][0] != open_edges[j][1]
+            if not (i_open or j_open):
+                continue
+            if i_open:
+                left_i, right_i = open_edges[i]
+            else:
+                left_i = right_i = next_edge
+                next_edge += 1
+            if j_open:
+                left_j, right_j = open_edges[j]
+            else:
+                left_j = right_j = next_edge
+                next_edge += 1
+
+            left = LeafTensor.from_const(
+                [next_edge, next_edge + 1, left_i, left_j], 2
+            )
+            left.data = TensorData.gate("fsim", _FSIM_ANGLES)
+            tn.push_tensor(left)
+
+            right = LeafTensor.from_const(
+                [right_i, right_j, next_edge + 2, next_edge + 3], 2
+            )
+            right.data = TensorData.gate("fsim", _FSIM_ANGLES, adjoint=True)
+            tn.push_tensor(right)
+
+            open_edges[i] = (next_edge, next_edge + 2)
+            open_edges[j] = (next_edge + 1, next_edge + 3)
+            next_edge += 4
+
+        # Single-qubit gates + mirrored adjoints.
+        for i in range(qubits):
+            left_index, right_index = open_edges[i]
+            if rng.random() < single_qubit_probability and left_index != right_index:
+                name = _SINGLE_QUBIT_GATES[int(rng.integers(0, 3))]
+
+                left = LeafTensor.from_const([next_edge, left_index], 2)
+                left.data = TensorData.gate(name)
+                tn.push_tensor(left)
+
+                right = LeafTensor.from_const([right_index, next_edge + 1], 2)
+                right.data = TensorData.gate(name, adjoint=True)
+                tn.push_tensor(right)
+
+                open_edges[i] = (next_edge, next_edge + 1)
+                next_edge += 2
+
+    # Random initial states, shared by circuit and mirror sides.
+    for i in range(qubits):
+        left_index, right_index = open_edges[i]
+        if left_index != right_index:
+            state = random_sparse_tensor_data_with_rng([2], 1.0, rng)
+
+            left_state = LeafTensor.from_const([left_index], 2)
+            left_state.data = state
+            tn.push_tensor(left_state)
+
+            right_state = LeafTensor.from_const([right_index], 2)
+            right_state.data = TensorData.matrix(
+                np.conj(state.into_data())
+            )
+            tn.push_tensor(right_state)
+
+    return tn

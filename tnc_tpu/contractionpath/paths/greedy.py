@@ -31,7 +31,10 @@ import random
 from typing import Sequence
 
 from tnc_tpu.contractionpath.contraction_cost import contract_path_cost
-from tnc_tpu.contractionpath.contraction_path import ContractionPath
+from tnc_tpu.contractionpath.contraction_path import (
+    ContractionPath,
+    ssa_replace_ordering,
+)
 from tnc_tpu.contractionpath.paths.base import Pathfinder
 from tnc_tpu.tensornetwork.tensor import LeafTensor
 
@@ -173,7 +176,7 @@ class Greedy(Pathfinder):
             candidate = _ssa_greedy(leaf_tensors, rng, temp)
             flops, _ = contract_path_cost(
                 leaf_tensors,
-                _to_replace(ContractionPath.simple(candidate)),
+                ssa_replace_ordering(ContractionPath.simple(candidate)),
                 True,
             )
             if flops < best_flops:
@@ -181,12 +184,6 @@ class Greedy(Pathfinder):
                 best_path = candidate
         assert best_path is not None
         return best_path
-
-
-def _to_replace(ssa: ContractionPath) -> ContractionPath:
-    from tnc_tpu.contractionpath.contraction_path import ssa_replace_ordering
-
-    return ssa_replace_ordering(ssa)
 
 
 # Backwards-parity alias: the reference calls this finder `Cotengrust`.

@@ -1,0 +1,1 @@
+from tnc_tpu.io.qasm.importer import import_qasm  # noqa: F401

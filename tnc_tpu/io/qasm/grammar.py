@@ -1,0 +1,82 @@
+"""OpenQASM 2.0 grammar (lark).
+
+Replaces the reference's ANTLR-generated lexer/parser
+(``tnc/src/io/qasm/generated``, ~5.7k generated LoC) with a compact lark
+grammar covering the same supported subset: version header, includes,
+register declarations, gate declarations, gate calls (incl. the ``U`` and
+``CX`` primitives), ``barrier``; ``measure``/``reset``/``if`` are parsed
+so the importer can reject them with a clear error
+(``qasm_importer.rs:10-11``).
+"""
+
+QASM2_GRAMMAR = r"""
+start: version? statement*
+
+version: "OPENQASM" REAL_OR_INT ";"
+
+statement: include_stmt
+         | qreg_decl
+         | creg_decl
+         | gate_decl
+         | opaque_decl
+         | gate_call
+         | barrier_stmt
+         | measure_stmt
+         | reset_stmt
+         | if_stmt
+
+include_stmt: "include" ESCAPED_STRING ";"
+qreg_decl: "qreg" CNAME "[" INT "]" ";"
+creg_decl: "creg" CNAME "[" INT "]" ";"
+
+gate_decl: "gate" CNAME gate_params? id_list "{" gate_body "}"
+gate_params: "(" [param_list] ")"
+param_list: CNAME ("," CNAME)*
+id_list: CNAME ("," CNAME)*
+gate_body: (gate_call | barrier_stmt)*
+
+opaque_decl: "opaque" CNAME gate_params? id_list ";"
+
+gate_call: gate_name call_args? argument_list ";"
+gate_name: CNAME | UGATE | CXGATE
+UGATE: "U"
+CXGATE: "CX"
+call_args: "(" [expr_list] ")"
+expr_list: expr ("," expr)*
+argument_list: argument ("," argument)*
+argument: CNAME ("[" INT "]")?
+
+barrier_stmt: "barrier" argument_list ";"
+measure_stmt: "measure" argument "->" argument ";"
+reset_stmt: "reset" argument ";"
+if_stmt: "if" "(" CNAME "==" INT ")" gate_call
+
+?expr: term
+     | expr "+" term -> add
+     | expr "-" term -> sub
+?term: factor
+     | term "*" factor -> mul
+     | term "/" factor -> div
+?factor: power
+       | "-" factor -> neg
+       | "+" factor
+?power: atom
+      | atom "^" factor -> pow
+?atom: REAL_OR_INT -> number
+     | PI -> pi
+     | CNAME -> name
+     | FUNC "(" expr ")" -> func
+     | "(" expr ")"
+
+PI: "pi"
+FUNC: "sin" | "cos" | "tan" | "exp" | "ln" | "sqrt"
+REAL_OR_INT: /\d+\.\d*([eE][+-]?\d+)?|\.\d+([eE][+-]?\d+)?|\d+([eE][+-]?\d+)?/
+INT: /\d+/
+
+COMMENT: /\/\/[^\n]*/
+%import common.CNAME
+%import common.ESCAPED_STRING
+%import common.WS
+%ignore WS
+%ignore COMMENT
+"""

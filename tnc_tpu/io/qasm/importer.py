@@ -1,0 +1,263 @@
+"""OpenQASM 2.0 → Circuit importer.
+
+Equivalent of the reference pipeline ``import_qasm``
+(``tnc/src/io/qasm/qasm_importer.rs:13-38``): include expansion (with the
+standard ``qelib1.inc`` embedded), parse, constant folding, gate inlining
+down to registry built-ins, and circuit creation with QASM register
+broadcasting (``circuit_creator.rs:16-58``).
+
+Where the reference runs four separate AST passes (fold → inline → fold →
+create), this importer evaluates recursively: user-defined gate calls are
+expanded with a numeric parameter environment, so folding happens
+naturally at substitution time. A gate call whose (lowercased) name is in
+the gate registry is emitted directly and never inlined, matching the
+reference's ``is_gate_known`` check (``ast.rs:328``).
+
+Unsupported (as in the reference): ``measure``, ``reset``, ``if``,
+classical ops. ``barrier`` is a no-op.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from pathlib import Path
+
+from lark import Lark, Token, Tree
+
+from tnc_tpu.builders.circuit_builder import Circuit, Qubit
+from tnc_tpu.gates import is_gate_known
+from tnc_tpu.io.qasm.grammar import QASM2_GRAMMAR
+from tnc_tpu.io.qasm.qelib1 import QELIB1
+from tnc_tpu.tensornetwork.tensordata import TensorData
+
+
+class QasmError(ValueError):
+    """Raised on unsupported or malformed QASM input."""
+
+
+_PARSER: Lark | None = None
+
+
+def _parser() -> Lark:
+    global _PARSER
+    if _PARSER is None:
+        _PARSER = Lark(QASM2_GRAMMAR, parser="lalr", lexer="contextual")
+    return _PARSER
+
+
+_FUNCS = {
+    "sin": math.sin,
+    "cos": math.cos,
+    "tan": math.tan,
+    "exp": math.exp,
+    "ln": math.log,
+    "sqrt": math.sqrt,
+}
+
+
+def _eval_expr(node, env: dict[str, float]) -> float:
+    """Numeric evaluation of a parameter expression (replaces the
+    reference's ``ExpressionFolder``)."""
+    if isinstance(node, Token):
+        return float(node)
+    data = node.data
+    kids = node.children
+    if data == "number":
+        return float(kids[0])
+    if data == "pi":
+        return math.pi
+    if data == "name":
+        name = str(kids[0])
+        if name not in env:
+            raise QasmError(f"Unknown parameter '{name}' in expression")
+        return env[name]
+    if data == "func":
+        return _FUNCS[str(kids[0])](_eval_expr(kids[1], env))
+    if data == "add":
+        return _eval_expr(kids[0], env) + _eval_expr(kids[1], env)
+    if data == "sub":
+        return _eval_expr(kids[0], env) - _eval_expr(kids[1], env)
+    if data == "mul":
+        return _eval_expr(kids[0], env) * _eval_expr(kids[1], env)
+    if data == "div":
+        return _eval_expr(kids[0], env) / _eval_expr(kids[1], env)
+    if data == "neg":
+        return -_eval_expr(kids[0], env)
+    if data == "pow":
+        return _eval_expr(kids[0], env) ** _eval_expr(kids[1], env)
+    raise QasmError(f"Unsupported expression node '{data}'")
+
+
+@dataclass
+class _GateDef:
+    params: list[str]
+    qubit_args: list[str]
+    body: list  # gate_call trees
+
+
+class _Importer:
+    def __init__(self, include_dir: Path | None = None) -> None:
+        self.circuit = Circuit()
+        self.registers: dict[str, object] = {}
+        self.gate_defs: dict[str, _GateDef] = {}
+        self.include_dir = include_dir
+
+    # -- include expansion (include_resolver.rs) ----------------------------
+
+    def expand_includes(self, code: str, depth: int = 0) -> str:
+        if depth > 16:
+            raise QasmError("Include depth exceeded (cycle?)")
+        out_lines = []
+        for line in code.splitlines():
+            stripped = line.strip()
+            if stripped.startswith("include"):
+                path = stripped.split('"')[1]
+                if path == "qelib1.inc":
+                    included = QELIB1
+                else:
+                    if self.include_dir is None:
+                        raise QasmError(
+                            f"Cannot resolve include '{path}' without an include dir"
+                        )
+                    included = (self.include_dir / path).read_text()
+                out_lines.append(self.expand_includes(included, depth + 1))
+            else:
+                out_lines.append(line)
+        return "\n".join(out_lines)
+
+    # -- statement handling -------------------------------------------------
+
+    def run(self, code: str) -> Circuit:
+        code = self.expand_includes(code)
+        tree = _parser().parse(code)
+        for stmt in tree.children:
+            if isinstance(stmt, Tree) and stmt.data == "version":
+                continue
+            self._statement(stmt.children[0])
+        return self.circuit
+
+    def _statement(self, node: Tree) -> None:
+        data = node.data
+        if data == "include_stmt":
+            raise QasmError("Unexpanded include found after expansion")
+        if data == "qreg_decl":
+            name, size = str(node.children[0]), int(node.children[1])
+            if name in self.registers:
+                raise QasmError(f"Register '{name}' redeclared")
+            self.registers[name] = self.circuit.allocate_register(size)
+            return
+        if data == "creg_decl":
+            return  # tolerated, unused
+        if data == "gate_decl":
+            self._gate_decl(node)
+            return
+        if data == "opaque_decl":
+            name = str(node.children[0])
+            if not is_gate_known(name.lower()):
+                raise QasmError(f"Opaque gate '{name}' is not a known gate")
+            return
+        if data == "gate_call":
+            self._toplevel_gate_call(node)
+            return
+        if data == "barrier_stmt":
+            return
+        if data in ("measure_stmt", "reset_stmt", "if_stmt"):
+            keyword = data.split("_")[0]
+            raise QasmError(f"'{keyword}' is not supported")
+        raise QasmError(f"Unsupported statement '{data}'")
+
+    def _gate_decl(self, node: Tree) -> None:
+        name = str(node.children[0])
+        idx = 1
+        params: list[str] = []
+        if isinstance(node.children[idx], Tree) and node.children[idx].data == "gate_params":
+            inner = node.children[idx].children
+            if inner and inner[0] is not None:
+                params = [str(t) for t in inner[0].children]
+            idx += 1
+        qubit_args = [str(t) for t in node.children[idx].children]
+        body_node = node.children[idx + 1]
+        body = [c for c in body_node.children if c.data == "gate_call"]
+        self.gate_defs[name] = _GateDef(params, qubit_args, body)
+
+    # -- gate call resolution (gate_inliner.rs + circuit_creator.rs) --------
+
+    @staticmethod
+    def _call_parts(node: Tree) -> tuple[str, list, list[Tree]]:
+        name = str(node.children[0].children[0])
+        idx = 1
+        exprs: list = []
+        if (
+            idx < len(node.children)
+            and isinstance(node.children[idx], Tree)
+            and node.children[idx].data == "call_args"
+        ):
+            inner = node.children[idx].children
+            if inner and inner[0] is not None:
+                exprs = list(inner[0].children)
+            idx += 1
+        args = list(node.children[idx].children)
+        return name, exprs, args
+
+    def _toplevel_gate_call(self, node: Tree) -> None:
+        name, exprs, args = self._call_parts(node)
+        angles = [_eval_expr(e, {}) for e in exprs]
+
+        # QASM broadcasting: full-register args apply the gate per element
+        # (``circuit_creator.rs`` broadcast semantics).
+        resolved: list[list[Qubit]] = []
+        broadcast_len: int | None = None
+        for arg in args:
+            reg_name = str(arg.children[0])
+            if reg_name not in self.registers:
+                raise QasmError(f"Unknown register '{reg_name}'")
+            register = self.registers[reg_name]
+            if len(arg.children) > 1 and arg.children[1] is not None:
+                resolved.append([register.qubit(int(arg.children[1]))])
+            else:
+                resolved.append(list(register.qubits()))
+                if broadcast_len is None:
+                    broadcast_len = len(register)
+                elif broadcast_len != len(register):
+                    raise QasmError("Mismatched register sizes in broadcast")
+
+        n = broadcast_len if broadcast_len is not None else 1
+        for k in range(n):
+            qubits = [(qs[0] if len(qs) == 1 else qs[k]) for qs in resolved]
+            self._apply(name, angles, qubits)
+
+    def _apply(self, name: str, angles: list[float], qubits: list[Qubit]) -> None:
+        lname = name.lower()
+        if is_gate_known(lname):
+            self.circuit.append_gate(TensorData.gate(lname, tuple(angles)), qubits)
+            return
+        if name not in self.gate_defs:
+            raise QasmError(f"Unknown gate '{name}'")
+        gate = self.gate_defs[name]
+        if len(gate.params) != len(angles):
+            raise QasmError(
+                f"Gate '{name}' expects {len(gate.params)} params, got {len(angles)}"
+            )
+        if len(gate.qubit_args) != len(qubits):
+            raise QasmError(
+                f"Gate '{name}' expects {len(gate.qubit_args)} qubits, got {len(qubits)}"
+            )
+        env = dict(zip(gate.params, angles))
+        qubit_env = dict(zip(gate.qubit_args, qubits))
+        for call in gate.body:
+            sub_name, sub_exprs, sub_args = self._call_parts(call)
+            sub_angles = [_eval_expr(e, env) for e in sub_exprs]
+            sub_qubits = []
+            for arg in sub_args:
+                qname = str(arg.children[0])
+                if qname not in qubit_env:
+                    raise QasmError(f"Unknown qubit '{qname}' in gate '{name}'")
+                sub_qubits.append(qubit_env[qname])
+            self._apply(sub_name, sub_angles, sub_qubits)
+
+
+def import_qasm(code: str, include_dir: str | Path | None = None) -> Circuit:
+    """Create a :class:`Circuit` from OpenQASM 2.0 source."""
+    importer = _Importer(Path(include_dir) if include_dir else None)
+    return importer.run(code)

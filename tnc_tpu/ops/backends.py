@@ -18,7 +18,7 @@ nothing.
 
 from __future__ import annotations
 
-import math
+import warnings
 from typing import Any, Sequence
 
 import numpy as np
@@ -92,8 +92,17 @@ class JaxBackend(Backend):
             self._jax.device_put(jnp.asarray(a, dtype=self.dtype), self.device)
             for a in arrays
         ]
-        result = self._compiled(program)(buffers)
+        result = self._run(program, buffers)
         return np.asarray(result)
+
+    def _run(self, program: ContractionProgram, buffers: list[Any]):
+        with warnings.catch_warnings():
+            # Tiny gate inputs are routinely not reusable for larger
+            # intermediates; XLA's per-buffer warning is pure noise here.
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable"
+            )
+            return self._compiled(program)(buffers)
 
     def execute_on_device(self, program: ContractionProgram, arrays: Sequence[Any]):
         """Like :meth:`execute` but leaves the result on device (no host
@@ -105,7 +114,7 @@ class JaxBackend(Backend):
             self._jax.device_put(jnp.asarray(a, dtype=self.dtype), self.device)
             for a in arrays
         ]
-        return self._compiled(program)(buffers)
+        return self._run(program, buffers)
 
 
 _BACKENDS: dict[str, Backend] = {}

@@ -107,8 +107,13 @@ def build_program(tn: CompositeTensor, contract_path: ContractionPath) -> Contra
     flat_slots: list[LeafTensor] = []
     steps: list[PairStep] = []
 
-    def compile_composite(tensors: list[Tensor], cpath: ContractionPath) -> int:
-        """Returns the global slot holding this subnetwork's result."""
+    def compile_composite(
+        tensors: list[Tensor], cpath: ContractionPath
+    ) -> tuple[int, LeafTensor]:
+        """Returns the global slot holding this subnetwork's result and the
+        result's metadata in the slot buffer's *actual* axis order (the fold
+        of ``^`` along this path — NOT ``external_tensor()``, whose leg
+        order follows child order instead of contraction order)."""
         slot_of: list[int] = []
         current: list[LeafTensor | None] = []
         for child in tensors:
@@ -126,9 +131,9 @@ def build_program(tn: CompositeTensor, contract_path: ContractionPath) -> Contra
             child = tensors[i]
             if not isinstance(child, CompositeTensor):
                 raise TypeError(f"nested path at index {i} targets a leaf")
-            slot = compile_composite(child.tensors, nested_path)
+            slot, child_result = compile_composite(child.tensors, nested_path)
             slot_of[i] = slot
-            current[i] = child.external_tensor()
+            current[i] = child_result
 
         for idx, child in enumerate(tensors):
             if isinstance(child, CompositeTensor) and slot_of[idx] == -1:
@@ -150,19 +155,12 @@ def build_program(tn: CompositeTensor, contract_path: ContractionPath) -> Contra
             raise ValueError(
                 f"path does not fully contract: {len(survivors)} tensors remain"
             )
-        return slot_of[survivors[0]]
+        survivor = survivors[0]
+        result = current[survivor]
+        assert result is not None
+        return slot_of[survivor], result
 
-    result_slot = compile_composite(list(tn.tensors), contract_path)
-
-    # Recover result legs/shape by replaying metadata.
-    metas: list[LeafTensor | None] = [t.copy() for t in flat_slots]
-    for step in steps:
-        ta, tb = metas[step.lhs], metas[step.rhs]
-        assert ta is not None and tb is not None
-        metas[step.lhs] = ta ^ tb
-        metas[step.rhs] = None
-    final = metas[result_slot]
-    assert final is not None
+    result_slot, final = compile_composite(list(tn.tensors), contract_path)
 
     return ContractionProgram(
         num_inputs=len(flat_slots),
@@ -179,9 +177,7 @@ def flat_leaf_tensors(tn: CompositeTensor) -> list[LeafTensor]:
 
     def visit(tensors: list[Tensor]) -> None:
         for child in tensors:
-            if isinstance(child, CompositeTensor):
-                pass
-            else:
+            if not isinstance(child, CompositeTensor):
                 out.append(child)
         for child in tensors:
             if isinstance(child, CompositeTensor):
