@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Benchmark driver: Sycamore-53 depth-14 single-amplitude contraction.
+
+The north-star config from BASELINE.md (#3): build the Sycamore-53
+depth-14 amplitude network, plan a path with the native hyper-optimizer,
+slice it to fit single-chip HBM, and execute on the JAX backend (TPU when
+available). Prints ONE JSON line:
+
+    {"metric": ..., "value": <wall-clock seconds>, "unit": "s",
+     "vs_baseline": <speedup vs the CPU (numpy/BLAS) oracle>}
+
+Methodology mirrors the reference benchmark's ``time_to_solution``
+(``benchmark/src/main.rs:365-405``): path optimization is excluded from
+the timed region; the contraction itself — all slices — is timed after a
+warmup run that triggers XLA compilation. The CPU baseline runs the SAME
+sliced program on a subset of slices with numpy and extrapolates linearly
+(slices are identical work by construction), because running every slice
+on CPU would take hours.
+
+Configurable via env:
+  BENCH_QUBITS (53), BENCH_DEPTH (14), BENCH_SEED (42),
+  BENCH_TARGET_LOG2_PEAK (28), BENCH_NTRIALS (16),
+  BENCH_CPU_SLICES (2), BENCH_REPS (3)
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    qubits = int(os.environ.get("BENCH_QUBITS", "53"))
+    depth = int(os.environ.get("BENCH_DEPTH", "14"))
+    seed = int(os.environ.get("BENCH_SEED", "42"))
+    target_log2 = float(os.environ.get("BENCH_TARGET_LOG2_PEAK", "28"))
+    ntrials = int(os.environ.get("BENCH_NTRIALS", "16"))
+    cpu_slices = int(os.environ.get("BENCH_CPU_SLICES", "2"))
+    reps = int(os.environ.get("BENCH_REPS", "3"))
+
+    import jax
+
+    from tnc_tpu.builders.sycamore_circuit import sycamore_circuit
+    from tnc_tpu.contractionpath.paths.hyper import Hyperoptimizer
+    from tnc_tpu.contractionpath.slicing import find_slicing, sliced_flops
+    from tnc_tpu.ops.backends import JaxBackend, NumpyBackend
+    from tnc_tpu.ops.program import flat_leaf_tensors
+    from tnc_tpu.ops.sliced import build_sliced_program
+
+    device = jax.devices()[0]
+    log = lambda *a: print(*a, file=sys.stderr)  # noqa: E731
+    log(f"[bench] device: {device.platform} ({device.device_kind})")
+
+    # -- build network ------------------------------------------------------
+    from tnc_tpu.tensornetwork.simplify import simplify_network
+
+    rng = np.random.default_rng(seed)
+    circuit = sycamore_circuit(qubits, depth, rng)
+    raw, _ = circuit.into_amplitude_network("0" * qubits)
+    tn = simplify_network(raw)
+    log(
+        f"[bench] network: {len(raw)} tensors -> {len(tn)} cores after host "
+        f"simplification (sycamore-{qubits} m={depth})"
+    )
+
+    # -- plan (excluded from timing, like the reference's Sweep phase) ------
+    t0 = time.monotonic()
+    result = Hyperoptimizer(ntrials=ntrials, seed=seed).find_path(tn)
+    replace = result.replace_path()
+    plan_s = time.monotonic() - t0
+    log(
+        f"[bench] path: flops={result.flops:.3e} "
+        f"peak=2^{np.log2(max(result.size, 1)):.1f} (planned in {plan_s:.1f}s)"
+    )
+
+    inputs = list(tn.tensors)
+    slicing = find_slicing(inputs, replace.toplevel, 2.0**target_log2)
+    total_flops = sliced_flops(inputs, replace.toplevel, slicing)
+    log(
+        f"[bench] slicing: {len(slicing.legs)} legs, {slicing.num_slices} slices, "
+        f"total flops {total_flops:.3e}"
+    )
+
+    sp = build_sliced_program(tn, replace, slicing)
+    leaves = flat_leaf_tensors(tn)
+    arrays = [leaf.data.into_data() for leaf in leaves]
+
+    # -- TPU/accelerator timing --------------------------------------------
+    backend = JaxBackend(dtype="complex64")
+    t0 = time.monotonic()
+    amp_warm = backend.execute_sliced(sp, arrays)  # includes compile
+    compile_s = time.monotonic() - t0
+    log(f"[bench] warmup (incl. compile): {compile_s:.2f}s")
+
+    times = []
+    for _ in range(reps):
+        t0 = time.monotonic()
+        amp = backend.execute_sliced(sp, arrays)
+        times.append(time.monotonic() - t0)
+    tpu_s = float(np.median(times))
+    amplitude = complex(np.asarray(amp).reshape(-1)[0])
+    log(f"[bench] amplitude: {amplitude} | runs: {[round(t, 3) for t in times]}")
+
+    # -- CPU baseline: same program, subset of slices, extrapolated --------
+    from tnc_tpu.contractionpath.slicing import Slicing
+    from tnc_tpu.ops.sliced import execute_sliced_numpy
+
+    n_sub = max(1, min(cpu_slices, slicing.num_slices))
+    # time numpy on n_sub slices by shrinking the slice loop
+    sub = Slicing(slicing.legs, slicing.dims)
+    t0 = time.monotonic()
+    _partial_baseline(sp, arrays, n_sub)
+    cpu_sub_s = time.monotonic() - t0
+    cpu_s = cpu_sub_s * (slicing.num_slices / n_sub)
+    log(
+        f"[bench] cpu oracle: {cpu_sub_s:.2f}s for {n_sub}/{slicing.num_slices} "
+        f"slices -> {cpu_s:.1f}s extrapolated"
+    )
+
+    vs_baseline = cpu_s / tpu_s if tpu_s > 0 else 0.0
+    print(
+        json.dumps(
+            {
+                "metric": f"sycamore{qubits}_m{depth}_amplitude_wallclock",
+                "value": round(tpu_s, 4),
+                "unit": "s",
+                "vs_baseline": round(vs_baseline, 2),
+            }
+        )
+    )
+
+
+def _partial_baseline(sp, arrays, n_sub: int) -> None:
+    """Run the first ``n_sub`` slices of ``sp`` with numpy."""
+    from tnc_tpu.ops.backends import _run_steps
+    from tnc_tpu.ops.sliced import _slice_indices
+
+    full = [np.asarray(a, dtype=np.complex64) for a in arrays]
+    acc = np.zeros(sp.program.result_shape, dtype=np.complex64)
+    for s in range(n_sub):
+        indices = _slice_indices(sp.slicing, s)
+        buffers = []
+        for arr, info in zip(full, sp.slot_slices):
+            view = arr
+            offset = 0
+            for axis, pos in info:
+                view = np.take(view, indices[pos], axis=axis - offset)
+                offset += 1
+            buffers.append(view)
+        acc = acc + _run_steps(np, sp.program, buffers)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,133 @@
+"""Branch-and-bound pathfinders and communication schemes."""
+
+import random
+
+import numpy as np
+import pytest
+
+from tnc_tpu import CompositeTensor, LeafTensor
+from tnc_tpu.builders.random_circuit import random_circuit
+from tnc_tpu.builders.connectivity import ConnectivityLayout
+from tnc_tpu.contractionpath.communication_schemes import CommunicationScheme
+from tnc_tpu.contractionpath.contraction_cost import (
+    communication_path_cost,
+    contract_path_cost,
+)
+from tnc_tpu.contractionpath.contraction_path import validate_path
+from tnc_tpu.contractionpath.paths import Greedy, Optimal, OptMethod
+from tnc_tpu.contractionpath.paths.base import CostType
+from tnc_tpu.contractionpath.paths.branchbound import (
+    BranchBound,
+    WeightedBranchBound,
+)
+
+
+def setup_simple():
+    bd = {0: 5, 1: 2, 2: 6, 3: 8, 4: 1, 5: 3, 6: 4}
+    return CompositeTensor(
+        [
+            LeafTensor.from_map([4, 3, 2], bd),
+            LeafTensor.from_map([0, 1, 3, 2], bd),
+            LeafTensor.from_map([4, 5, 6], bd),
+        ]
+    )
+
+
+def setup_complex():
+    bd = {
+        0: 27, 1: 18, 2: 12, 3: 15, 4: 5, 5: 3,
+        6: 18, 7: 22, 8: 45, 9: 65, 10: 5, 11: 17,
+    }
+    return CompositeTensor(
+        [
+            LeafTensor.from_map([4, 3, 2], bd),
+            LeafTensor.from_map([0, 1, 3, 2], bd),
+            LeafTensor.from_map([4, 5, 6], bd),
+            LeafTensor.from_map([6, 8, 9], bd),
+            LeafTensor.from_map([10, 8, 9], bd),
+            LeafTensor.from_map([5, 1, 0], bd),
+        ]
+    )
+
+
+def test_branchbound_simple_matches_optimal():
+    tn = setup_simple()
+    bb = BranchBound(nbranch=None).find_path(tn)
+    opt = Optimal().find_path(tn)
+    assert validate_path(bb.replace_path(), len(tn))
+    assert bb.flops == opt.flops == 600.0
+
+
+def test_branchbound_complex_not_worse_than_greedy():
+    tn = setup_complex()
+    bb = BranchBound(nbranch=10).find_path(tn)
+    greedy = Greedy(OptMethod.GREEDY).find_path(tn)
+    assert validate_path(bb.replace_path(), len(tn))
+    assert bb.flops <= greedy.flops
+
+
+def test_branchbound_minimize_size():
+    tn = setup_complex()
+    by_size = BranchBound(nbranch=None, minimize=CostType.SIZE).find_path(tn)
+    by_flops = BranchBound(nbranch=None, minimize=CostType.FLOPS).find_path(tn)
+    assert by_size.size <= by_flops.size
+
+
+def test_weighted_branchbound_respects_latency():
+    """With a huge latency on one input, the schedule should defer
+    touching it (critical path hides other work behind the latency)."""
+    bd = {0: 8, 1: 8, 2: 8, 3: 8}
+    inputs = [
+        LeafTensor.from_map([0, 1], bd),
+        LeafTensor.from_map([1, 2], bd),
+        LeafTensor.from_map([2, 3], bd),
+        LeafTensor.from_map([3, 0], bd),
+    ]
+    tn = CompositeTensor([t.copy() for t in inputs])
+    latencies = {0: 1e6, 1: 0.0, 2: 0.0, 3: 0.0}
+    result = WeightedBranchBound(latencies).find_path(tn)
+    rp = result.replace_path().toplevel
+    assert validate_path(result.replace_path(), 4)
+    crit, _ = communication_path_cost(inputs, rp, True, True, [1e6, 0, 0, 0])
+    # the other three tensors contract while waiting: critical path is
+    # latency + one final pairwise contraction at most
+    assert crit <= 1e6 + 8**3 + 8**2
+
+
+def test_weighted_branchbound_latency_validation():
+    tn = setup_simple()
+    with pytest.raises(ValueError):
+        WeightedBranchBound({0: 1.0}).find_path(tn)
+
+
+@pytest.mark.parametrize(
+    "scheme",
+    [
+        CommunicationScheme.GREEDY,
+        CommunicationScheme.RANDOM_GREEDY,
+        CommunicationScheme.BIPARTITION,
+        CommunicationScheme.BIPARTITION_SWEEP,
+        CommunicationScheme.WEIGHTED_BRANCH_BOUND,
+        CommunicationScheme.BRANCH_BOUND,
+    ],
+)
+def test_all_schemes_produce_valid_fanin(scheme):
+    rng = np.random.default_rng(6)
+    bd = {i: 4 for i in range(12)}
+    # 6 partition-result tensors in a ring
+    tensors = [
+        LeafTensor.from_map([i, (i + 1) % 6, 6 + i], bd) for i in range(6)
+    ]
+    latency = {i: float(i) * 10.0 for i in range(6)}
+    path = scheme.communication_path(tensors, latency, random.Random(0))
+    assert len(path) == 5
+    alive = set(range(6))
+    for a, b in path:
+        assert a in alive and b in alive and a != b
+        alive.discard(b)
+    assert len(alive) == 1
+
+
+def test_scheme_single_tensor():
+    t = [LeafTensor.from_const([0], 2)]
+    assert CommunicationScheme.GREEDY.communication_path(t) == []

@@ -1,0 +1,138 @@
+"""Partitioner and partitioned-network tests (mirrors
+``tnc/src/tensornetwork/partitioning.rs:186-244`` behaviorally: exact
+partition vectors are solver-specific, so tests assert balance, cut
+quality, and contraction consistency instead).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from tnc_tpu import CompositeTensor, LeafTensor
+from tnc_tpu.builders.random_circuit import random_circuit
+from tnc_tpu.builders.connectivity import ConnectivityLayout
+from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+from tnc_tpu.partitioning.bisect import bisect, partition_kway
+from tnc_tpu.partitioning.hypergraph import Hypergraph, hypergraph_from_tensors
+from tnc_tpu.tensornetwork.contraction import contract_tensor_network
+from tnc_tpu.tensornetwork.partitioning import (
+    PartitioningStrategy,
+    communication_partitioning,
+    find_partitioning,
+    partition_tensor_network,
+)
+
+
+def _ring_graph(n):
+    """n vertices in a ring; unit weights."""
+    edges = [[i, (i + 1) % n] for i in range(n)]
+    return Hypergraph(n, [1.0] * n, edges, [1.0] * n)
+
+
+def test_bisect_ring():
+    """Bisecting a ring must cut exactly 2 edges and balance halves."""
+    hg = _ring_graph(32)
+    part = bisect(hg, imbalance=0.05, rng=random.Random(0))
+    sizes = [part.count(0), part.count(1)]
+    assert min(sizes) >= 14
+    assert hg.cut_weight(part) == 2.0
+
+
+def test_bisect_two_cliques():
+    """Two cliques joined by one edge: the bridge is the min cut."""
+    edges = []
+    for base in (0, 8):
+        for i in range(8):
+            for j in range(i + 1, 8):
+                edges.append([base + i, base + j])
+    edges.append([0, 8])
+    hg = Hypergraph(16, [1.0] * 16, edges, [1.0] * len(edges))
+    part = bisect(hg, imbalance=0.05, rng=random.Random(1))
+    assert hg.cut_weight(part) == 1.0
+    assert {part[i] for i in range(8)} != {part[i] for i in range(8, 16)}
+
+
+def test_partition_kway_balance():
+    hg = _ring_graph(64)
+    for k in (2, 4, 8):
+        part = partition_kway(hg, k, 0.1, random.Random(2))
+        counts = [part.count(b) for b in range(k)]
+        assert len([c for c in counts if c > 0]) == k
+        assert max(counts) <= (64 / k) * 1.35
+
+
+def test_hypergraph_from_tensors():
+    bd = {0: 2, 1: 4, 2: 8, 3: 16}
+    tn = [
+        LeafTensor.from_map([0, 1], bd),
+        LeafTensor.from_map([1, 2], bd),
+        LeafTensor.from_map([2, 3], bd),  # leg 3 open -> no hyperedge
+    ]
+    hg = hypergraph_from_tensors(tn, weight_scale=1.0)
+    assert hg.num_vertices == 3
+    assert len(hg.edge_pins) == 2
+    assert hg.edge_weights == [2.0, 3.0]  # log2(4), log2(8)
+
+
+def test_find_partitioning_balanced():
+    rng = np.random.default_rng(3)
+    tn = random_circuit(10, 5, 0.9, 0.7, rng, ConnectivityLayout.LINE)
+    for k in (2, 4):
+        part = find_partitioning(tn, k, PartitioningStrategy.MIN_CUT)
+        assert len(part) == len(tn)
+        counts = [part.count(b) for b in range(k)]
+        assert all(c > 0 for c in counts)
+        assert max(counts) / (len(tn) / k) < 1.5
+
+
+def test_find_partitioning_k1():
+    tn = CompositeTensor([LeafTensor.from_const([0], 2)])
+    assert find_partitioning(tn, 1) == [0]
+    with pytest.raises(ValueError):
+        find_partitioning(tn, 0)
+
+
+def test_partition_tensor_network_structure():
+    bd = {0: 2, 1: 2, 2: 2, 3: 2}
+    tensors = [LeafTensor.from_map([i], bd) for i in range(4)]
+    tn = CompositeTensor(tensors)
+    grouped = partition_tensor_network(tn, [1, 0, 1, 0])
+    assert len(grouped) == 2
+    assert grouped[0].tensors == [tensors[1], tensors[3]]
+    assert grouped[1].tensors == [tensors[0], tensors[2]]
+    with pytest.raises(ValueError):
+        partition_tensor_network(tn, [0, 1])
+
+
+def test_partitioned_contraction_consistency():
+    """Oracle pattern from ``integration_tests.rs:26-86``: flat vs
+    partitioned contraction of the same network agree."""
+    rng = np.random.default_rng(4)
+    tn = random_circuit(8, 4, 0.9, 0.8, rng, ConnectivityLayout.LINE)
+    flat_result = Greedy(OptMethod.GREEDY).find_path(tn)
+    flat = complex(
+        contract_tensor_network(tn, flat_result.replace_path()).data.into_data()
+    )
+
+    part = find_partitioning(tn, 4)
+    grouped = partition_tensor_network(CompositeTensor(list(tn.tensors)), part)
+    nested_result = Greedy(OptMethod.GREEDY).find_path(grouped)
+    nested = complex(
+        contract_tensor_network(grouped, nested_result.replace_path()).data.into_data()
+    )
+    assert nested == pytest.approx(flat, rel=1e-10, abs=1e-12)
+
+
+def test_communication_partitioning_weights():
+    rng = np.random.default_rng(5)
+    tn = random_circuit(8, 4, 0.9, 0.8, rng, ConnectivityLayout.LINE)
+    weights = [float(i + 1) for i in range(len(tn))]
+    part = communication_partitioning(tn, 2, weights)
+    assert len(part) == len(tn)
+    # weighted balance: each side's weight within tolerance
+    w0 = sum(w for w, b in zip(weights, part) if b == 0)
+    total = sum(weights)
+    assert 0.25 < w0 / total < 0.75
+    with pytest.raises(ValueError):
+        communication_partitioning(tn, 2, [1.0])

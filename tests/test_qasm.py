@@ -213,3 +213,19 @@ def test_qelib_gate_coverage():
     tn, perm = circuit.into_statevector_network()
     sv = _contract(tn, perm).ravel()
     assert np.linalg.norm(sv) == pytest.approx(1.0, abs=1e-10)
+
+
+def test_builtin_arity_check():
+    with pytest.raises(QasmError, match="expects 2 qubits"):
+        import_qasm("OPENQASM 2.0;\nqreg q[2];\ncx q[0];")
+
+
+def test_parse_error_wrapped():
+    with pytest.raises(QasmError, match="parse error"):
+        import_qasm("OPENQASM 2.0;\nqreg q[")
+
+
+def test_recursive_gate_definition_rejected():
+    code = "OPENQASM 2.0;\nqreg q[1];\ngate g a { g a; }\ng q[0];"
+    with pytest.raises(QasmError, match="depth"):
+        import_qasm(code)

@@ -1,0 +1,160 @@
+"""Repartitioning: compute_solution, SA models/engine, genetic
+(mirrors ``repartitioning`` tests behaviorally)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from tnc_tpu import CompositeTensor
+from tnc_tpu.builders.connectivity import ConnectivityLayout
+from tnc_tpu.builders.random_circuit import random_circuit
+from tnc_tpu.contractionpath.communication_schemes import CommunicationScheme
+from tnc_tpu.contractionpath.contraction_path import validate_path
+from tnc_tpu.contractionpath.repartitioning import compute_solution
+from tnc_tpu.contractionpath.repartitioning.genetic import (
+    GeneticSettings,
+    balance_partitions as genetic_balance,
+)
+from tnc_tpu.contractionpath.repartitioning.simulated_annealing import (
+    IntermediatePartitioningModel,
+    LeafPartitioningModel,
+    NaiveIntermediatePartitioningModel,
+    NaivePartitioningModel,
+    balance_partitions,
+    evaluate_partitioning,
+)
+from tnc_tpu.tensornetwork.contraction import contract_tensor_network
+from tnc_tpu.tensornetwork.partitioning import find_partitioning
+
+
+@pytest.fixture(scope="module")
+def network():
+    rng = np.random.default_rng(8)
+    return random_circuit(10, 5, 0.9, 0.8, rng, ConnectivityLayout.LINE)
+
+
+@pytest.fixture(scope="module")
+def initial(network):
+    return find_partitioning(network, 4)
+
+
+def test_compute_solution_costs(network, initial):
+    partitioned, path, parallel, serial = compute_solution(
+        network, initial, CommunicationScheme.GREEDY, random.Random(0)
+    )
+    assert parallel <= serial
+    assert len(path.toplevel) == len(partitioned) - 1
+    # the combined path contracts the partitioned network correctly
+    flat = CompositeTensor(list(network.tensors))
+    from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+
+    res = Greedy(OptMethod.GREEDY).find_path(flat)
+    want = complex(contract_tensor_network(flat, res.replace_path()).data.into_data())
+    got = complex(contract_tensor_network(partitioned, path).data.into_data())
+    assert got == pytest.approx(want, rel=1e-10, abs=1e-13)
+
+
+def _roundtrip_assert_improves(model, solution, network):
+    rng = random.Random(1)
+    score0 = model.evaluate(solution, rng)
+    best, best_score = balance_partitions(
+        model, solution, rng, max_time=2.0, n_trials=4
+    )
+    assert best_score <= score0
+    partitioning = best[0] if isinstance(best, tuple) else best
+    assert len(partitioning) == len(network)
+    # the improved partitioning still contracts to the same value
+    _, path, _, _ = compute_solution(
+        network, partitioning, CommunicationScheme.GREEDY, rng
+    )
+    assert path is not None
+
+
+def test_naive_model(network, initial):
+    model = NaivePartitioningModel(network, 4)
+    _roundtrip_assert_improves(model, model.initial_solution(initial), network)
+
+
+def test_naive_intermediate_model(network, initial):
+    model = NaiveIntermediatePartitioningModel(network, 4)
+    _roundtrip_assert_improves(model, model.initial_solution(initial), network)
+
+
+def test_leaf_model(network, initial):
+    model = LeafPartitioningModel(network)
+    _roundtrip_assert_improves(model, model.initial_solution(initial), network)
+
+
+def test_intermediate_model(network, initial):
+    model = IntermediatePartitioningModel(network)
+    _roundtrip_assert_improves(model, model.initial_solution(initial), network)
+
+
+def test_memory_limit_scores_infinity(network, initial):
+    rng = random.Random(2)
+    score = evaluate_partitioning(
+        network, initial, CommunicationScheme.GREEDY, 1.0, rng
+    )
+    assert score == float("inf")
+
+
+def test_subtree_leaves_collection():
+    from tnc_tpu.contractionpath.repartitioning.simulated_annealing import (
+        _subtree_leaves,
+    )
+
+    # replace path: (0,1) then (2,3) then (0,2): subtree of final pair is all
+    path = [(0, 1), (2, 3), (0, 2)]
+    assert _subtree_leaves(path, 2) == {0, 1, 2, 3}
+    assert _subtree_leaves(path, 1) == {2, 3}
+    assert _subtree_leaves(path, 0) == {0, 1}
+
+
+def test_genetic_balance(network, initial):
+    rng = random.Random(3)
+    score0 = evaluate_partitioning(
+        network, initial, CommunicationScheme.GREEDY, None, rng
+    )
+    best, best_score = genetic_balance(
+        network,
+        initial,
+        4,
+        rng,
+        settings=GeneticSettings(population_size=12, max_generations=6, stale_limit=6),
+    )
+    assert best_score <= score0
+    assert len(best) == len(network)
+
+
+def test_balance_partitions_iter(network, initial):
+    from tnc_tpu.contractionpath.balancing import (
+        BalanceSettings,
+        BalancingScheme,
+        balance_partitions_iter,
+    )
+
+    for scheme in [
+        BalancingScheme.BEST_WORST,
+        BalancingScheme.TENSOR,
+        BalancingScheme.ALTERNATING_TENSORS,
+        BalancingScheme.INTERMEDIATE_TENSORS,
+    ]:
+        settings = BalanceSettings(iterations=6, scheme=scheme)
+        best_iter, best_tn, best_path, history = balance_partitions_iter(
+            network, initial, settings, random.Random(0)
+        )
+        assert len(history) >= 1
+        assert min(history) == history[best_iter]
+        # the balanced network still contracts to the correct value
+        got = complex(
+            contract_tensor_network(best_tn, best_path).data.into_data()
+        )
+        from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+
+        flat = CompositeTensor(list(network.tensors))
+        res = Greedy(OptMethod.GREEDY).find_path(flat)
+        want = complex(
+            contract_tensor_network(flat, res.replace_path()).data.into_data()
+        )
+        assert got == pytest.approx(want, rel=1e-9, abs=1e-12), scheme

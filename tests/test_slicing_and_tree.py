@@ -1,0 +1,126 @@
+"""Slicing, sliced execution, contraction trees, and the hyper-optimizer."""
+
+import numpy as np
+import pytest
+
+from tnc_tpu import CompositeTensor, LeafTensor
+from tnc_tpu.builders.sycamore_circuit import sycamore_circuit
+from tnc_tpu.contractionpath.contraction_path import validate_path
+from tnc_tpu.contractionpath.contraction_tree import ContractionTree
+from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+from tnc_tpu.contractionpath.paths.hyper import Hyperoptimizer
+from tnc_tpu.contractionpath.slicing import find_slicing, sliced_flops
+from tnc_tpu.tensornetwork.contraction import (
+    contract_tensor_network,
+    contract_tensor_network_sliced,
+)
+
+
+def _sycamore_network(qubits=12, depth=6, seed=1):
+    rng = np.random.default_rng(seed)
+    circuit = sycamore_circuit(qubits, depth, rng)
+    return circuit.into_amplitude_network("0" * qubits)[0]
+
+
+def test_find_slicing_reduces_peak():
+    tn = _sycamore_network()
+    res = Greedy(OptMethod.GREEDY).find_path(tn)
+    rp = res.replace_path()
+    target = max(64.0, res.size / 8)
+    slicing = find_slicing(list(tn.tensors), rp.toplevel, target)
+    assert slicing.num_slices > 1
+    # overhead is bounded by num_slices
+    total = sliced_flops(list(tn.tensors), rp.toplevel, slicing)
+    assert total <= res.flops * slicing.num_slices
+
+
+def test_sliced_contraction_matches_unsliced():
+    tn = _sycamore_network()
+    res = Greedy(OptMethod.GREEDY).find_path(tn)
+    rp = res.replace_path()
+    want = complex(contract_tensor_network(tn, rp).data.into_data())
+
+    slicing = find_slicing(list(tn.tensors), rp.toplevel, max(64.0, res.size / 8))
+    for backend in ("numpy", "jax64"):
+        got = complex(
+            contract_tensor_network_sliced(tn, rp, slicing, backend=backend)
+            .data.into_data()
+        )
+        assert got == pytest.approx(want, rel=1e-8, abs=1e-14), backend
+
+
+def test_sliced_open_legs_preserved():
+    """Slicing must never pick open (output) legs."""
+    tn = _sycamore_network()
+    # statevector-style: leave 2 legs open
+    rng = np.random.default_rng(2)
+    circuit = sycamore_circuit(6, 4, rng)
+    tn, _ = circuit.into_amplitude_network("0000**")
+    res = Greedy(OptMethod.GREEDY).find_path(tn)
+    rp = res.replace_path()
+    slicing = find_slicing(list(tn.tensors), rp.toplevel, max(64.0, res.size / 4))
+    open_legs = set(tn.external_tensor().legs)
+    assert not (set(slicing.legs) & open_legs)
+    want = contract_tensor_network(tn, rp)
+    got = contract_tensor_network_sliced(tn, rp, slicing)
+    assert got.legs == want.legs
+    np.testing.assert_allclose(
+        got.data.into_data(), want.data.into_data(), atol=1e-10
+    )
+
+
+def test_contraction_tree_roundtrip():
+    tn = _sycamore_network(8, 4)
+    res = Greedy(OptMethod.GREEDY).find_path(tn)
+    tree = ContractionTree.from_ssa_path(list(tn.tensors), res.ssa_path.toplevel)
+    flops, peak = tree.total_cost()
+    assert flops == res.flops
+    assert peak <= res.size  # tree model: out+in1+in2 per step
+    pairs = tree.to_ssa_path()
+    # round-trip gives a valid full contraction with identical cost
+    tree2 = ContractionTree.from_ssa_path(list(tn.tensors), pairs)
+    assert tree2.total_cost()[0] == flops
+
+
+def test_tree_weights_monotone():
+    tn = _sycamore_network(8, 4)
+    res = Greedy(OptMethod.GREEDY).find_path(tn)
+    tree = ContractionTree.from_ssa_path(list(tn.tensors), res.ssa_path.toplevel)
+    weights = tree.tree_weights()
+    assert weights[tree.root] == pytest.approx(tree.total_cost()[0])
+    for i, nd in enumerate(tree.nodes):
+        if not nd.is_leaf and nd.parent >= 0:
+            assert weights[i] <= weights[nd.parent] + 1e-9
+
+
+def test_reconfigure_improves_or_keeps():
+    tn = _sycamore_network(14, 8, seed=7)
+    res = Greedy(OptMethod.GREEDY).find_path(tn)
+    tree = ContractionTree.from_ssa_path(list(tn.tensors), res.ssa_path.toplevel)
+    before, _ = tree.total_cost()
+    tree.reconfigure(subtree_size=8, max_rounds=3)
+    after, _ = tree.total_cost()
+    assert after <= before
+    # result is still a valid full contraction of all leaves
+    pairs = tree.to_ssa_path()
+    leaves_used = {a for a, b in pairs if a < tree.num_leaves} | {
+        b for a, b in pairs if b < tree.num_leaves
+    }
+    assert leaves_used == set(range(tree.num_leaves))
+
+
+def test_hyperoptimizer_beats_greedy_on_sycamore():
+    tn = _sycamore_network(20, 10, seed=3)
+    greedy = Greedy(OptMethod.GREEDY).find_path(tn)
+    hyper = Hyperoptimizer(ntrials=8, reconfigure_rounds=2).find_path(tn)
+    assert validate_path(hyper.replace_path(), len(tn))
+    assert hyper.flops <= greedy.flops
+
+
+def test_hyperoptimizer_correctness():
+    tn = _sycamore_network(10, 5, seed=4)
+    hyper = Hyperoptimizer(ntrials=4, reconfigure_rounds=1).find_path(tn)
+    greedy = Greedy(OptMethod.GREEDY).find_path(tn)
+    a = complex(contract_tensor_network(tn, hyper.replace_path()).data.into_data())
+    b = complex(contract_tensor_network(tn, greedy.replace_path()).data.into_data())
+    assert a == pytest.approx(b, rel=1e-10, abs=1e-13)

@@ -1,0 +1,301 @@
+"""Greedy iterative partition balancing.
+
+Mirror of ``tnc/src/contractionpath/contraction_tree/balancing.rs`` (the
+``balance_partitions_iter`` entry point, ``:98-210``) and its scheme
+catalogue (``balancing/balancing_schemes.rs:12-68``): iteratively shift
+leaf tensors or whole subtrees between partitions to minimize the
+critical-path cost of the partitioned contraction, re-running the greedy
+finder on the two touched partitions after every shift and re-scheduling
+the fan-in with a :class:`CommunicationScheme`.
+
+Schemes:
+
+- ``BEST_WORST`` — move the best-scoring leaf from the most expensive
+  partition to the least expensive one.
+- ``TENSOR`` / ``TENSORS`` — move the single best (or a batch of) leaf
+  tensor(s) from the critical partition to the best target partition.
+- ``ALTERNATING_TENSORS`` — alternate donor between the most expensive
+  and the most memory-heavy partition.
+- ``INTERMEDIATE_TENSORS(height_limit)`` — move an intermediate subtree
+  (bounded leaf count) instead of single leaves.
+- ``ALTERNATING_INTERMEDIATE_TENSORS`` / ``ALTERNATING_TREE_TENSORS`` —
+  alternating donor selection for subtree moves.
+
+The cost history of every iteration is returned along with the best
+iteration's network and path, as in the reference.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass, field
+from typing import Callable, Sequence
+
+from tnc_tpu.contractionpath.communication_schemes import CommunicationScheme
+from tnc_tpu.contractionpath.contraction_cost import (
+    communication_path_op_costs,
+    compute_memory_requirements,
+    contract_path_cost,
+    contract_size_tensors_bytes,
+)
+from tnc_tpu.contractionpath.contraction_path import ContractionPath
+from tnc_tpu.contractionpath.paths.greedy import Greedy, OptMethod
+from tnc_tpu.contractionpath.repartitioning.simulated_annealing import (
+    _local_greedy_path,
+    _subtree_leaves,
+)
+from tnc_tpu.tensornetwork.partitioning import partition_tensor_network
+from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+
+
+class BalancingScheme:
+    """Scheme tags; ``INTERMEDIATE_TENSORS`` carries a height limit."""
+
+    BEST_WORST = "best_worst"
+    TENSOR = "tensor"
+    TENSORS = "tensors"
+    ALTERNATING_TENSORS = "alternating_tensors"
+    INTERMEDIATE_TENSORS = "intermediate_tensors"
+    ALTERNATING_INTERMEDIATE_TENSORS = "alternating_intermediate_tensors"
+    ALTERNATING_TREE_TENSORS = "alternating_tree_tensors"
+
+
+def _default_objective(
+    shifted: LeafTensor, target_external: LeafTensor
+) -> float:
+    """Memory-reduction objective: growth of the target's external tensor
+    (lower is better)."""
+    return (shifted ^ target_external).size() - target_external.size()
+
+
+@dataclass
+class BalanceSettings:
+    """Mirror of ``BalanceSettings`` (``balancing.rs:27-86``)."""
+
+    iterations: int = 20
+    scheme: str = BalancingScheme.BEST_WORST
+    height_limit: int = 4  # for intermediate-subtree schemes
+    communication_scheme: CommunicationScheme = CommunicationScheme.GREEDY
+    memory_limit: float | None = None
+    objective: Callable[[LeafTensor, LeafTensor], float] = field(
+        default=_default_objective
+    )
+    weighted_random_top: int | None = None  # pick randomly among top-N moves
+
+
+@dataclass
+class _State:
+    partitioning: list[int]
+    local_paths: list[list[tuple[int, int]]]
+    num_partitions: int
+
+
+def _partition_cost(
+    tensor: CompositeTensor, state: _State, p: int
+) -> float:
+    members = [
+        t for t, b in zip(tensor.tensors, state.partitioning) if b == p
+    ]
+    if len(members) <= 1:
+        return 0.0
+    local = CompositeTensor(members)
+    flops, _ = contract_path_cost(local, ContractionPath.simple(state.local_paths[p]), True)
+    return flops
+
+
+def _partition_external(tensor: CompositeTensor, state: _State, p: int) -> LeafTensor:
+    external = LeafTensor()
+    for t, b in zip(tensor.tensors, state.partitioning):
+        if b == p:
+            external = external ^ t
+    return external
+
+
+def _partition_memory(tensor: CompositeTensor, state: _State, p: int) -> float:
+    total = 0.0
+    for t, b in zip(tensor.tensors, state.partitioning):
+        if b == p:
+            total += t.size()
+    return total
+
+
+def _evaluate(
+    tensor: CompositeTensor,
+    state: _State,
+    settings: BalanceSettings,
+    rng: random.Random,
+) -> tuple[float, CompositeTensor, ContractionPath]:
+    partitioned = partition_tensor_network(
+        CompositeTensor(list(tensor.tensors)), state.partitioning
+    )
+    result = Greedy(OptMethod.GREEDY).find_path(partitioned)
+    path = result.replace_path()
+
+    latency = {i: 0.0 for i in range(len(partitioned))}
+    for i, local in path.nested.items():
+        cost, _ = contract_path_cost(partitioned[i].tensors, local, True)
+        latency[i] = cost
+    externals = [child.external_tensor() for child in partitioned]
+    comm = settings.communication_scheme.communication_path(externals, latency, rng)
+    costs = [latency[i] for i in range(len(externals))]
+    (parallel, _), _ = communication_path_op_costs(externals, comm, True, costs)
+    full_path = ContractionPath(path.nested, comm)
+
+    if settings.memory_limit is not None:
+        mem = compute_memory_requirements(
+            partitioned.tensors, full_path, contract_size_tensors_bytes
+        )
+        if mem > settings.memory_limit:
+            parallel = math.inf
+    return parallel, partitioned, full_path
+
+
+def _movable_groups(
+    tensor: CompositeTensor,
+    state: _State,
+    donor: int,
+    settings: BalanceSettings,
+    rng: random.Random,
+) -> list[list[int]]:
+    """Candidate move groups (lists of global tensor indices) from the
+    donor partition, per scheme."""
+    donor_indices = [
+        g for g, b in enumerate(state.partitioning) if b == donor
+    ]
+    if len(donor_indices) <= 1:
+        return []
+
+    scheme = settings.scheme
+    subtree_schemes = (
+        BalancingScheme.INTERMEDIATE_TENSORS,
+        BalancingScheme.ALTERNATING_INTERMEDIATE_TENSORS,
+        BalancingScheme.ALTERNATING_TREE_TENSORS,
+    )
+    if scheme in subtree_schemes:
+        local_path = state.local_paths[donor]
+        groups = []
+        limit = max(2, settings.height_limit)
+        for pair_index in range(max(0, len(local_path) - 1)):
+            leaves = _subtree_leaves(local_path, pair_index)
+            if 2 <= len(leaves) <= limit and len(leaves) < len(donor_indices):
+                groups.append([donor_indices[k] for k in sorted(leaves)])
+        if groups:
+            return groups
+    # leaf moves (also the fallback for subtree schemes)
+    return [[g] for g in donor_indices]
+
+
+def _pick_donor(
+    tensor: CompositeTensor,
+    state: _State,
+    settings: BalanceSettings,
+    iteration: int,
+) -> int:
+    costs = [
+        _partition_cost(tensor, state, p) for p in range(state.num_partitions)
+    ]
+    alternating = settings.scheme in (
+        BalancingScheme.ALTERNATING_TENSORS,
+        BalancingScheme.ALTERNATING_INTERMEDIATE_TENSORS,
+        BalancingScheme.ALTERNATING_TREE_TENSORS,
+    )
+    if alternating and iteration % 2 == 1:
+        memories = [
+            _partition_memory(tensor, state, p)
+            for p in range(state.num_partitions)
+        ]
+        return max(range(state.num_partitions), key=lambda p: memories[p])
+    return max(range(state.num_partitions), key=lambda p: costs[p])
+
+
+def balance_partitions_iter(
+    tensor: CompositeTensor,
+    partitioning: Sequence[int],
+    settings: BalanceSettings | None = None,
+    rng: random.Random | None = None,
+) -> tuple[int, CompositeTensor, ContractionPath, list[float]]:
+    """Iteratively rebalance ``partitioning``; returns
+    (best iteration, best partitioned network, best path, cost history)
+    (``balancing.rs:98-210``)."""
+    settings = settings or BalanceSettings()
+    rng = rng or random.Random(42)
+
+    num_partitions = max(partitioning) + 1
+    state = _State(
+        partitioning=list(partitioning),
+        local_paths=[],
+        num_partitions=num_partitions,
+    )
+    for p in range(num_partitions):
+        members = [
+            t for t, b in zip(tensor.tensors, state.partitioning) if b == p
+        ]
+        state.local_paths.append(_local_greedy_path(members))
+
+    cost, best_tn, best_path = _evaluate(tensor, state, settings, rng)
+    history = [cost]
+    best_cost = cost
+    best_iteration = 0
+
+    for iteration in range(settings.iterations):
+        donor = _pick_donor(tensor, state, settings, iteration)
+        groups = _movable_groups(tensor, state, donor, settings, rng)
+        if not groups:
+            break
+
+        # Score each (group, target) by the objective on the target's
+        # external tensor; BEST_WORST fixes the target to the cheapest
+        # partition.
+        if settings.scheme == BalancingScheme.BEST_WORST:
+            costs = [
+                _partition_cost(tensor, state, p)
+                for p in range(num_partitions)
+            ]
+            targets = [
+                min(
+                    (p for p in range(num_partitions) if p != donor),
+                    key=lambda p: costs[p],
+                )
+            ]
+        else:
+            targets = [p for p in range(num_partitions) if p != donor]
+
+        externals = {
+            p: _partition_external(tensor, state, p) for p in targets
+        }
+        moves: list[tuple[float, list[int], int]] = []
+        for group in groups:
+            shifted = LeafTensor()
+            for g in group:
+                shifted = shifted ^ tensor.tensors[g]
+            for p in targets:
+                moves.append((settings.objective(shifted, externals[p]), group, p))
+        if not moves:
+            break
+        moves.sort(key=lambda m: m[0])
+        if settings.weighted_random_top:
+            top = moves[: settings.weighted_random_top]
+            _, group, target = top[rng.randrange(len(top))]
+        else:
+            _, group, target = moves[0]
+
+        # Apply the shift and re-path both partitions.
+        for g in group:
+            state.partitioning[g] = target
+        for p in (donor, target):
+            members = [
+                t
+                for t, b in zip(tensor.tensors, state.partitioning)
+                if b == p
+            ]
+            state.local_paths[p] = _local_greedy_path(members)
+
+        cost, tn, path = _evaluate(tensor, state, settings, rng)
+        history.append(cost)
+        if cost < best_cost:
+            best_cost = cost
+            best_tn, best_path = tn, path
+            best_iteration = iteration + 1
+
+    return best_iteration, best_tn, best_path, history

@@ -1,0 +1,160 @@
+"""Communication scheduling for partitioned contraction.
+
+Mirror of ``tnc/src/contractionpath/communication_schemes.rs:19-73``: once
+each partition has contracted locally, the partitions' result tensors must
+be combined. The pair order of that fan-in *is* the inter-device
+communication schedule (``mpi/communication.rs:199-249``; in this
+framework it drives mesh collectives instead of MPI sends), and the right
+objective is the **critical path** including each partition's local
+completion latency.
+
+Six schemes, as in the reference:
+
+- ``GREEDY`` / ``RANDOM_GREEDY`` — the greedy pathfinders over the
+  partition result tensors (latencies ignored).
+- ``BIPARTITION`` — recursive 2-cut of the result tensors, larger tensor
+  kept left (``communication_schemes.rs:147-212``).
+- ``BIPARTITION_SWEEP`` — 20 random imbalances in [0.01, 0.5], keep the
+  best critical-path cost (``communication_schemes.rs:91-123``).
+- ``WEIGHTED_BRANCH_BOUND`` — latency-aware branch-and-bound.
+- ``BRANCH_BOUND`` — same engine with zero latencies.
+
+All schemes return a **replace-format** flat path over the partition
+indices.
+"""
+
+from __future__ import annotations
+
+import enum
+import random
+from typing import Sequence
+
+from tnc_tpu.contractionpath.contraction_cost import communication_path_cost
+from tnc_tpu.contractionpath.contraction_path import (
+    ContractionPath,
+    ssa_replace_ordering,
+)
+from tnc_tpu.contractionpath.paths.branchbound import WeightedBranchBound
+from tnc_tpu.contractionpath.paths.greedy import Greedy, OptMethod
+from tnc_tpu.partitioning.bisect import bisect
+from tnc_tpu.partitioning.hypergraph import hypergraph_from_tensors
+from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+
+SimplePath = list
+
+
+class CommunicationScheme(enum.Enum):
+    GREEDY = "greedy"
+    RANDOM_GREEDY = "random_greedy"
+    BIPARTITION = "bipartition"
+    BIPARTITION_SWEEP = "bipartition_sweep"
+    WEIGHTED_BRANCH_BOUND = "weightedbranchbound"
+    BRANCH_BOUND = "branchbound"
+
+    def communication_path(
+        self,
+        children_tensors: Sequence[LeafTensor],
+        latency_map: dict[int, float] | None = None,
+        rng: random.Random | None = None,
+    ) -> list[tuple[int, int]]:
+        """Replace-format fan-in path over the partition tensors."""
+        if latency_map is None:
+            latency_map = {i: 0.0 for i in range(len(children_tensors))}
+        if len(children_tensors) <= 1:
+            return []
+
+        if self is CommunicationScheme.GREEDY:
+            return _greedy_path(children_tensors, OptMethod.GREEDY)
+        if self is CommunicationScheme.RANDOM_GREEDY:
+            return _greedy_path(children_tensors, OptMethod.RANDOM_GREEDY)
+        if self is CommunicationScheme.BIPARTITION:
+            return _tensor_bipartition(list(enumerate(children_tensors)), 0.03)
+        if self is CommunicationScheme.BIPARTITION_SWEEP:
+            if rng is None:
+                raise ValueError("BIPARTITION_SWEEP requires a random generator")
+            return _bipartition_sweep(children_tensors, latency_map, rng)
+        if self is CommunicationScheme.WEIGHTED_BRANCH_BOUND:
+            return _branchbound_path(children_tensors, latency_map)
+        if self is CommunicationScheme.BRANCH_BOUND:
+            zero = {i: 0.0 for i in range(len(children_tensors))}
+            return _branchbound_path(children_tensors, zero)
+        raise ValueError(self)  # pragma: no cover
+
+
+def _greedy_path(
+    children_tensors: Sequence[LeafTensor], method: OptMethod
+) -> list[tuple[int, int]]:
+    tn = CompositeTensor([t.copy() for t in children_tensors])
+    result = Greedy(method).find_path(tn)
+    return result.replace_path().toplevel
+
+
+def _branchbound_path(
+    children_tensors: Sequence[LeafTensor], latency_map: dict[int, float]
+) -> list[tuple[int, int]]:
+    tn = CompositeTensor([t.copy() for t in children_tensors])
+    finder = WeightedBranchBound(latency_map, nbranch=10, cutoff_flops_factor=5.0)
+    return finder.find_path(tn).replace_path().toplevel
+
+
+def _bipartition_sweep(
+    children_tensors: Sequence[LeafTensor],
+    latency_map: dict[int, float],
+    rng: random.Random,
+    sweeps: int = 20,
+) -> list[tuple[int, int]]:
+    latencies = [latency_map[i] for i in sorted(latency_map)]
+    best_flops = float("inf")
+    best_path: list[tuple[int, int]] = []
+    for _ in range(sweeps):
+        imbalance = 0.01 + rng.random() * 0.49
+        path = _tensor_bipartition(list(enumerate(children_tensors)), imbalance, rng)
+        flops, _ = communication_path_cost(
+            children_tensors, path, True, True, latencies
+        )
+        if flops < best_flops:
+            best_flops = flops
+            best_path = path
+    return best_path
+
+
+def _tensor_bipartition(
+    children: list[tuple[int, LeafTensor]],
+    imbalance: float,
+    rng: random.Random | None = None,
+) -> list[tuple[int, int]]:
+    """Recursive bipartition fan-in; result replaces the larger side's id
+    (``communication_schemes.rs:147-212``)."""
+    _, _, path = _tensor_bipartition_recursive(children, imbalance, rng)
+    return path
+
+
+def _tensor_bipartition_recursive(
+    children: list[tuple[int, LeafTensor]],
+    imbalance: float,
+    rng: random.Random | None,
+) -> tuple[int, LeafTensor, list[tuple[int, int]]]:
+    if len(children) == 1:
+        return children[0][0], children[0][1], []
+    if len(children) == 2:
+        (ia, ta), (ib, tb) = children
+        if tb.size() > ta.size():
+            ia, ib = ib, ia
+        return ia, ta ^ tb, [(ia, ib)]
+
+    hg = hypergraph_from_tensors([t for _, t in children])
+    sides = bisect(hg, imbalance, rng or random.Random(42))
+    left = [c for c, s in zip(children, sides) if s == 0]
+    right = [c for c, s in zip(children, sides) if s == 1]
+    if not left or not right:
+        half = len(children) // 2
+        left, right = children[:half], children[half:]
+
+    id1, t1, path1 = _tensor_bipartition_recursive(left, imbalance, rng)
+    id2, t2, path2 = _tensor_bipartition_recursive(right, imbalance, rng)
+    out = t1 ^ t2
+    if t2.size() > t1.size():
+        id1, id2 = id2, id1
+    combined = path1 + path2
+    combined.append((id1, id2))
+    return id1, out, combined

@@ -1,0 +1,279 @@
+"""Hyper-optimized pathfinding via recursive hypergraph bisection.
+
+Equivalent of the reference's cotengra ``HyperOptimizer`` bridge
+(``tnc/src/contractionpath/paths/hyperoptimization.rs:36-73``, which calls
+cotengra's kahypar-based search through Python). This is a native
+implementation of the same algorithm family, using the framework's own
+multilevel partitioner:
+
+- Build the contraction tree **top-down**: recursively bisect the
+  network's hypergraph (legs = hyperedges, weight = log2(bond dim)); the
+  cut structure becomes the upper tree levels.
+- Below a cutoff, finish subproblems with the greedy finder.
+- Run ``ntrials`` randomized trials (different seeds and imbalance
+  fractions, as cotengra samples imbalance) plus a plain-greedy baseline,
+  and keep the lowest predicted cost.
+
+On Sycamore-class circuits this produces paths orders of magnitude
+cheaper than pure greedy, which is why the reference reserves this finder
+for its hardest benchmark configs (``BASELINE.md`` config 3).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+
+from tnc_tpu.contractionpath.contraction_cost import contract_path_cost
+from tnc_tpu.contractionpath.contraction_path import (
+    ContractionPath,
+    ssa_replace_ordering,
+)
+from tnc_tpu.contractionpath.paths.base import Pathfinder
+from tnc_tpu.contractionpath.paths.greedy import _ssa_greedy
+from tnc_tpu.partitioning.bisect import bisect
+from tnc_tpu.partitioning.hypergraph import Hypergraph
+from tnc_tpu.tensornetwork.tensor import LeafTensor
+
+
+class Hyperoptimizer(Pathfinder):
+    def __init__(
+        self,
+        ntrials: int = 16,
+        seed: int = 42,
+        cutoff: int = 12,
+        imbalance_range: tuple[float, float] = (0.02, 0.40),
+        minimize: str = "flops",
+        reconfigure_size: int = 10,
+        reconfigure_rounds: int = 6,
+        reconfigure_budget: float | None = 60.0,
+    ) -> None:
+        if minimize not in ("flops", "size"):
+            raise ValueError("minimize must be 'flops' or 'size'")
+        self.ntrials = ntrials
+        self.seed = seed
+        self.cutoff = cutoff
+        self.imbalance_range = imbalance_range
+        self.minimize = minimize
+        self.reconfigure_size = reconfigure_size
+        self.reconfigure_rounds = reconfigure_rounds
+        self.reconfigure_budget = reconfigure_budget
+
+    def _solve_toplevel(self, inputs: list[LeafTensor]) -> list[tuple[int, int]]:
+        n = len(inputs)
+        if n <= 2:
+            return [(0, 1)] if n == 2 else []
+
+        dims: dict[int, int] = {}
+        for t in inputs:
+            for leg, dim in t.edges():
+                dims[leg] = dim
+
+        # Preprocessing: absorb rank<=2 tensors (kets, bras, single-qubit
+        # gate chains) into their neighbours. These contractions cost
+        # next to nothing but shrink the graph to its rank>=3 cores,
+        # which is what makes partition-based trees competitive on
+        # circuit networks (cotengra's preprocessing does the same).
+        prefix, legs_map, next_id = _simplify(
+            {i: frozenset(t.legs) for i, t in enumerate(inputs)}, dims
+        )
+        core_ids = sorted(legs_map)
+
+        candidates: list[list[tuple[int, int]]] = [
+            prefix + _greedy_on(core_ids, legs_map, dims, next_id)[0]
+        ]
+        for trial in range(self.ntrials):
+            rng = random.Random(self.seed + trial)
+            lo, hi = self.imbalance_range
+            imbalance = lo + (hi - lo) * rng.random()
+            candidates.append(
+                prefix
+                + self._bisection_path(core_ids, legs_map, dims, next_id, rng, imbalance)
+            )
+
+        def evaluate(candidate: list[tuple[int, int]]) -> float:
+            flops, size = contract_path_cost(
+                inputs,
+                ssa_replace_ordering(ContractionPath.simple(candidate)),
+                True,
+            )
+            return flops if self.minimize == "flops" else size
+
+        best_path: list[tuple[int, int]] | None = None
+        best_key = math.inf
+        for candidate in candidates:
+            key = evaluate(candidate)
+            if key < best_key:
+                best_key = key
+                best_path = candidate
+        assert best_path is not None
+
+        # Refine the winner by exact-DP subtree reconfiguration
+        # (the reference's TreeReconfigure capability, natively).
+        if self.reconfigure_rounds > 0:
+            from tnc_tpu.contractionpath.contraction_tree import ContractionTree
+
+            tree = ContractionTree.from_ssa_path(inputs, best_path)
+            tree.reconfigure(
+                self.reconfigure_size,
+                self.reconfigure_rounds,
+                time_budget=self.reconfigure_budget,
+            )
+            refined = tree.to_ssa_path()
+            if evaluate(refined) < best_key:
+                best_path = refined
+        return best_path
+
+    def _bisection_path(
+        self,
+        core_ids: list[int],
+        legs_map: dict[int, frozenset[int]],
+        dims: dict[int, int],
+        start_id: int,
+        rng: random.Random,
+        imbalance: float,
+    ) -> list[tuple[int, int]]:
+        legs = dict(legs_map)
+        next_id = start_id
+        ssa_path: list[tuple[int, int]] = []
+
+        def greedy_finish(ids: list[int]) -> int:
+            """Contract a small set of (global-id) tensors with greedy."""
+            nonlocal next_id
+            local_tensors = [
+                LeafTensor(sorted(legs[i]), [dims[l] for l in sorted(legs[i])])
+                for i in ids
+            ]
+            local_pairs = _ssa_greedy(local_tensors)
+            m = len(ids)
+            local_to_global = {i: ids[i] for i in range(m)}
+            last = ids[0]
+            for a, b in local_pairs:
+                ga = local_to_global[a]
+                gb = local_to_global[b]
+                ssa_path.append((ga, gb))
+                legs[next_id] = legs[ga] ^ legs[gb]
+                local_to_global[m] = next_id
+                m += 1
+                last = next_id
+                next_id += 1
+            return last
+
+        def solve(ids: list[int]) -> int:
+            nonlocal next_id
+            if len(ids) == 1:
+                return ids[0]
+            if len(ids) <= self.cutoff:
+                return greedy_finish(ids)
+
+            # Sub-hypergraph over `ids`
+            index = {v: i for i, v in enumerate(ids)}
+            pin_lists: dict[int, list[int]] = {}
+            for v in ids:
+                for leg in legs[v]:
+                    pin_lists.setdefault(leg, []).append(index[v])
+            edge_pins = []
+            edge_weights = []
+            for leg, pins in pin_lists.items():
+                if len(pins) >= 2:
+                    edge_pins.append(pins)
+                    edge_weights.append(math.log2(max(2, dims[leg])))
+            sub = Hypergraph(len(ids), [1.0] * len(ids), edge_pins, edge_weights)
+            sides = bisect(sub, imbalance, rng)
+            left = [v for v, s in zip(ids, sides) if s == 0]
+            right = [v for v, s in zip(ids, sides) if s == 1]
+            if not left or not right:
+                return greedy_finish(ids)
+            a = solve(left)
+            b = solve(right)
+            ssa_path.append((a, b))
+            legs[next_id] = legs[a] ^ legs[b]
+            result = next_id
+            next_id += 1
+            return result
+
+        solve(list(core_ids))
+        return ssa_path
+
+
+def _simplify(
+    legs: dict[int, frozenset[int]], dims: dict[int, int]
+) -> tuple[list[tuple[int, int]], dict[int, frozenset[int]], int]:
+    """Absorb every rank<=2 tensor into a neighbour sharing a leg.
+
+    Returns (ssa prefix pairs, surviving id -> legs, next free ssa id).
+    Tensors sharing no leg with anyone are left for the outer search's
+    outer-product handling.
+    """
+    legs = dict(legs)
+    next_id = max(legs) + 1 if legs else 0
+    pairs: list[tuple[int, int]] = []
+
+    leg_owners: dict[int, set[int]] = {}
+    for i, ls in legs.items():
+        for leg in ls:
+            leg_owners.setdefault(leg, set()).add(i)
+
+    from collections import deque
+
+    queue = deque(i for i, ls in legs.items() if len(ls) <= 2)
+    while queue:
+        i = queue.popleft()
+        if i not in legs or len(legs[i]) > 2:
+            continue
+        if len(legs) <= 2:
+            break
+        # find a neighbour (prefer the smallest) sharing any leg
+        neighbour = -1
+        neighbour_rank = 1 << 30
+        for leg in legs[i]:
+            for j in leg_owners.get(leg, ()):
+                if j != i and j in legs and len(legs[j]) < neighbour_rank:
+                    neighbour = j
+                    neighbour_rank = len(legs[j])
+        if neighbour < 0:
+            continue  # disconnected scalar/vector; leave it
+        merged = legs[i] ^ legs[neighbour]
+        pairs.append((i, neighbour))
+        for leg in legs[i] | legs[neighbour]:
+            owners = leg_owners.get(leg)
+            if owners is not None:
+                owners.discard(i)
+                owners.discard(neighbour)
+        del legs[i], legs[neighbour]
+        new_id = next_id
+        next_id += 1
+        legs[new_id] = merged
+        for leg in merged:
+            leg_owners.setdefault(leg, set()).add(new_id)
+        if len(merged) <= 2:
+            queue.append(new_id)
+        # neighbours of the merged tensor may have become absorbable
+        # (not strictly needed: ranks only shrink via future merges)
+
+    return pairs, legs, next_id
+
+
+def _greedy_on(
+    core_ids: list[int],
+    legs_map: dict[int, frozenset[int]],
+    dims: dict[int, int],
+    start_id: int,
+) -> tuple[list[tuple[int, int]], int]:
+    """Run the greedy finder over surviving cores, mapping local ssa ids
+    back to global ids."""
+    local_tensors = [
+        LeafTensor(sorted(legs_map[i]), [dims[l] for l in sorted(legs_map[i])])
+        for i in core_ids
+    ]
+    local_pairs = _ssa_greedy(local_tensors)
+    m = len(core_ids)
+    to_global = {k: core_ids[k] for k in range(m)}
+    out: list[tuple[int, int]] = []
+    next_id = start_id
+    for a, b in local_pairs:
+        out.append((to_global[a], to_global[b]))
+        to_global[m] = next_id
+        m += 1
+        next_id += 1
+    return out, next_id

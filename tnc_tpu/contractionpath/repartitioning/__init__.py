@@ -1,0 +1,57 @@
+"""Partitioning refinement: evaluate and improve a partition assignment.
+
+Mirror of ``tnc/src/contractionpath/repartitioning.rs``:
+:func:`compute_solution` is the shared evaluation kernel — partition the
+network, find greedy local paths per partition, schedule the fan-in with a
+communication scheme using the local costs as latencies, and return the
+critical-path (parallel) and sum (serial) costs.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Sequence
+
+from tnc_tpu.contractionpath.communication_schemes import CommunicationScheme
+from tnc_tpu.contractionpath.contraction_cost import (
+    communication_path_op_costs,
+    contract_path_cost,
+)
+from tnc_tpu.contractionpath.contraction_path import ContractionPath
+from tnc_tpu.contractionpath.paths.greedy import Greedy, OptMethod
+from tnc_tpu.tensornetwork.partitioning import partition_tensor_network
+from tnc_tpu.tensornetwork.tensor import CompositeTensor
+
+
+def compute_solution(
+    tensor: CompositeTensor,
+    partitioning: Sequence[int],
+    communication_scheme: CommunicationScheme = CommunicationScheme.GREEDY,
+    rng: random.Random | None = None,
+) -> tuple[CompositeTensor, ContractionPath, float, float]:
+    """(partitioned network, full path, parallel cost, serial cost)
+    for a partition assignment (``repartitioning.rs:25-76``)."""
+    partitioned = partition_tensor_network(
+        CompositeTensor(list(tensor.tensors)), partitioning
+    )
+
+    result = Greedy(OptMethod.GREEDY).find_path(partitioned)
+    path = result.replace_path()
+
+    latency_map = {i: 0.0 for i in range(len(partitioned))}
+    for i, local_path in path.nested.items():
+        child = partitioned[i]
+        local_cost, _ = contract_path_cost(child.tensors, local_path, True)
+        latency_map[i] = local_cost
+
+    children_tensors = [child.external_tensor() for child in partitioned]
+    communication_path = communication_scheme.communication_path(
+        children_tensors, latency_map, rng
+    )
+    tensor_costs = [latency_map[i] for i in range(len(children_tensors))]
+    (parallel_cost, sum_cost), _ = communication_path_op_costs(
+        children_tensors, communication_path, True, tensor_costs
+    )
+
+    final_path = ContractionPath(path.nested, communication_path)
+    return partitioned, final_path, parallel_cost, sum_cost

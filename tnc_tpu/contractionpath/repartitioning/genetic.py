@@ -1,0 +1,93 @@
+"""Genetic-algorithm repartitioning.
+
+Mirror of ``tnc/src/contractionpath/repartitioning/genetic.rs``: evolve
+partition-assignment chromosomes with single-gene mutation, uniform
+crossover, and tournament selection (the reference uses the
+``genetic_algorithm`` crate with population 100, stale limit 100,
+``MutateSingleGene(0.2)``; this is a self-contained equivalent).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Sequence
+
+from tnc_tpu.contractionpath.communication_schemes import CommunicationScheme
+from tnc_tpu.contractionpath.repartitioning.simulated_annealing import (
+    evaluate_partitioning,
+)
+from tnc_tpu.tensornetwork.tensor import CompositeTensor
+
+
+@dataclass
+class GeneticSettings:
+    population_size: int = 100
+    mutation_probability: float = 0.2
+    tournament_size: int = 4
+    stale_limit: int = 100
+    max_generations: int = 1000
+
+
+def balance_partitions(
+    tensor: CompositeTensor,
+    initial_partitioning: Sequence[int],
+    num_partitions: int,
+    rng: random.Random,
+    communication_scheme: CommunicationScheme = CommunicationScheme.GREEDY,
+    memory_limit: float | None = None,
+    settings: GeneticSettings | None = None,
+    max_time: float | None = None,
+) -> tuple[list[int], float]:
+    """Evolve the partitioning; returns (best chromosome, best score)."""
+    import time
+
+    settings = settings or GeneticSettings()
+    deadline = time.monotonic() + max_time if max_time else None
+
+    def fitness(chromosome: list[int]) -> float:
+        return evaluate_partitioning(
+            tensor, chromosome, communication_scheme, memory_limit, rng
+        )
+
+    def mutate(chromosome: list[int]) -> list[int]:
+        out = list(chromosome)
+        if rng.random() < settings.mutation_probability:
+            gene = rng.randrange(len(out))
+            out[gene] = rng.randrange(num_partitions)
+        return out
+
+    def crossover(a: list[int], b: list[int]) -> list[int]:
+        return [x if rng.random() < 0.5 else y for x, y in zip(a, b)]
+
+    def tournament(scored: list[tuple[float, list[int]]]) -> list[int]:
+        picks = [scored[rng.randrange(len(scored))] for _ in range(settings.tournament_size)]
+        return min(picks, key=lambda p: p[0])[1]
+
+    population = [list(initial_partitioning)]
+    for _ in range(settings.population_size - 1):
+        population.append(mutate(list(initial_partitioning)))
+
+    scored = [(fitness(c), c) for c in population]
+    best_score, best = min(scored, key=lambda p: p[0])
+    stale = 0
+
+    for _generation in range(settings.max_generations):
+        if stale >= settings.stale_limit:
+            break
+        if deadline is not None and time.monotonic() > deadline:
+            break
+        next_population = [best]  # elitism
+        while len(next_population) < settings.population_size:
+            child = mutate(crossover(tournament(scored), tournament(scored)))
+            next_population.append(child)
+        population = next_population
+        scored = [(fitness(c), c) for c in population]
+        gen_best_score, gen_best = min(scored, key=lambda p: p[0])
+        if gen_best_score < best_score:
+            best_score, best = gen_best_score, gen_best
+            stale = 0
+        else:
+            stale += 1
+
+    return best, best_score

@@ -1,0 +1,406 @@
+"""Simulated-annealing repartitioning.
+
+Mirror of ``tnc/src/contractionpath/repartitioning/simulated_annealing.rs``:
+an SA engine with a wall-clock budget, log-interpolated temperature
+(2.0 → 0.05), restart-after-stale, multi-chain trial generation, and
+acceptance probability ``exp(-log2(score/current) / T)``
+(``simulated_annealing.rs:122-127``), plus four move models:
+
+- :class:`NaivePartitioningModel` — random tensor → random partition.
+- :class:`NaiveIntermediatePartitioningModel` — random *subtree* of a
+  partition's local path → random partition.
+- :class:`LeafPartitioningModel` — random tensor → the partition whose
+  external tensor shrinks the most.
+- :class:`IntermediatePartitioningModel` — random subtree → best
+  partition (the reference book calls this the best method).
+
+Scores are the critical-path (parallel) cost from
+:func:`~tnc_tpu.contractionpath.repartitioning.compute_solution`;
+exceeding a memory limit scores infinity
+(``simulated_annealing.rs:171-199``).
+
+Divergence: the reference evaluates 48 rayon chains in parallel
+(``PROCESSING_THREADS = 48``); chains here run sequentially (Python), so
+``n_trials`` defaults lower. Seeded determinism is preserved.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+import time
+from dataclasses import dataclass
+from typing import Sequence
+
+from tnc_tpu.contractionpath.communication_schemes import CommunicationScheme
+from tnc_tpu.contractionpath.contraction_cost import (
+    compute_memory_requirements,
+    contract_size_tensors_bytes,
+)
+from tnc_tpu.contractionpath.paths.greedy import Greedy, OptMethod
+from tnc_tpu.contractionpath.repartitioning import compute_solution
+from tnc_tpu.tensornetwork.partitioning import partition_tensor_network
+from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+
+
+def evaluate_partitioning(
+    tensor: CompositeTensor,
+    partitioning: Sequence[int],
+    communication_scheme: CommunicationScheme,
+    memory_limit: float | None,
+    rng: random.Random,
+) -> float:
+    partitioned, path, parallel_cost, _ = compute_solution(
+        tensor, partitioning, communication_scheme, rng
+    )
+    if memory_limit is not None:
+        mem = compute_memory_requirements(
+            partitioned.tensors, path, contract_size_tensors_bytes
+        )
+        if mem > memory_limit:
+            return math.inf
+    return parallel_cost
+
+
+class OptModel:
+    """Trial-generation + scoring interface (``simulated_annealing.rs:38-51``)."""
+
+    def generate_trial_solution(self, current, rng: random.Random):
+        raise NotImplementedError
+
+    def evaluate(self, solution, rng: random.Random) -> float:
+        raise NotImplementedError
+
+
+@dataclass
+class NaivePartitioningModel(OptModel):
+    tensor: CompositeTensor
+    num_partitions: int
+    communication_scheme: CommunicationScheme = CommunicationScheme.GREEDY
+    memory_limit: float | None = None
+
+    def initial_solution(self, partitioning: Sequence[int]) -> list[int]:
+        return list(partitioning)
+
+    def generate_trial_solution(self, current: list[int], rng: random.Random):
+        solution = list(current)
+        index = rng.randrange(len(solution))
+        current_partition = solution[index]
+        while True:
+            b = rng.randrange(self.num_partitions)
+            if b != current_partition:
+                break
+        solution[index] = b
+        return solution
+
+    def evaluate(self, solution: list[int], rng: random.Random) -> float:
+        return evaluate_partitioning(
+            self.tensor, solution, self.communication_scheme, self.memory_limit, rng
+        )
+
+
+def _local_greedy_path(tensors: list) -> list[tuple[int, int]]:
+    tn = CompositeTensor(tensors)
+    if len(tn) <= 1:
+        return []
+    return Greedy(OptMethod.GREEDY).find_path(tn).replace_path().toplevel
+
+
+def _subtree_leaves(
+    local_path: list[tuple[int, int]], pair_index: int
+) -> set[int]:
+    """Leaves contributing to the contraction at ``pair_index``
+    (``simulated_annealing.rs:279-292``): walk earlier pairs backwards,
+    collecting partners of already-included results."""
+    i, j = local_path[pair_index]
+    leaves = {i, j}
+    for a, b in reversed(local_path[:pair_index]):
+        if a in leaves:
+            leaves.add(b)
+    return leaves
+
+
+def _pick_subtree_and_indices(
+    partitioning: list[int],
+    local_paths: list[list[tuple[int, int]]],
+    rng: random.Random,
+) -> tuple[int, list[int]] | None:
+    """Pick a source partition with >=3 local pairs and a random subtree;
+    return (source partition, global tensor indices to move)."""
+    viable = [p for p, path in enumerate(local_paths) if len(path) >= 3]
+    if not viable:
+        return None
+    source = rng.choice(viable)
+    pair_index = rng.randrange(len(local_paths[source]) - 1)
+    leaves = _subtree_leaves(local_paths[source], pair_index)
+
+    shifted_global: list[int] = []
+    local_index = 0
+    for global_index, partition in enumerate(partitioning):
+        if partition != source:
+            continue
+        if local_index in leaves:
+            shifted_global.append(global_index)
+        local_index += 1
+    return source, shifted_global
+
+
+def _recompute_two_paths(
+    tensor: CompositeTensor,
+    partitioning: list[int],
+    local_paths: list[list[tuple[int, int]]],
+    source: int,
+    target: int,
+) -> None:
+    from_tensors = []
+    to_tensors = []
+    for partition, t in zip(partitioning, tensor.tensors):
+        if partition == source:
+            from_tensors.append(t)
+        elif partition == target:
+            to_tensors.append(t)
+    local_paths[source] = _local_greedy_path(from_tensors)
+    local_paths[target] = _local_greedy_path(to_tensors)
+
+
+@dataclass
+class NaiveIntermediatePartitioningModel(OptModel):
+    """Moves a random subtree to a random partition."""
+
+    tensor: CompositeTensor
+    num_partitions: int
+    communication_scheme: CommunicationScheme = CommunicationScheme.GREEDY
+    memory_limit: float | None = None
+
+    def initial_solution(
+        self, partitioning: Sequence[int]
+    ) -> tuple[list[int], list[list[tuple[int, int]]]]:
+        partitioned = partition_tensor_network(
+            CompositeTensor(list(self.tensor.tensors)), partitioning
+        )
+        paths = [_local_greedy_path(list(child.tensors)) for child in partitioned]
+        return list(partitioning), paths
+
+    def generate_trial_solution(self, current, rng: random.Random):
+        partitioning, local_paths = current
+        partitioning = list(partitioning)
+        local_paths = [list(p) for p in local_paths]
+
+        picked = _pick_subtree_and_indices(partitioning, local_paths, rng)
+        if picked is None:
+            return partitioning, local_paths
+        source, shifted = picked
+        while True:
+            target = rng.randrange(self.num_partitions)
+            if target != source:
+                break
+        for index in shifted:
+            partitioning[index] = target
+        _recompute_two_paths(self.tensor, partitioning, local_paths, source, target)
+        return partitioning, local_paths
+
+    def evaluate(self, solution, rng: random.Random) -> float:
+        return evaluate_partitioning(
+            self.tensor, solution[0], self.communication_scheme, self.memory_limit, rng
+        )
+
+
+@dataclass
+class LeafPartitioningModel(OptModel):
+    """Moves a random tensor to the partition maximizing size reduction."""
+
+    tensor: CompositeTensor
+    communication_scheme: CommunicationScheme = CommunicationScheme.GREEDY
+    memory_limit: float | None = None
+
+    def initial_solution(
+        self, partitioning: Sequence[int]
+    ) -> tuple[list[int], list[LeafTensor]]:
+        partitioned = partition_tensor_network(
+            CompositeTensor(list(self.tensor.tensors)), partitioning
+        )
+        externals = [child.external_tensor() for child in partitioned]
+        return list(partitioning), externals
+
+    def generate_trial_solution(self, current, rng: random.Random):
+        partitioning, partition_tensors = current
+        partitioning = list(partitioning)
+        partition_tensors = [t.copy() for t in partition_tensors]
+
+        index = rng.randrange(len(partitioning))
+        shifted = self.tensor.tensors[index]
+        source = partitioning[index]
+
+        best_target = -1
+        best_score = math.inf
+        for p, external in enumerate(partition_tensors):
+            if p == source:
+                continue
+            score = (shifted ^ external).size() - external.size()
+            if score < best_score:
+                best_score = score
+                best_target = p
+        if best_target < 0:
+            return partitioning, partition_tensors
+
+        partitioning[index] = best_target
+        partition_tensors[source] = partition_tensors[source] ^ shifted
+        partition_tensors[best_target] = partition_tensors[best_target] ^ shifted
+        return partitioning, partition_tensors
+
+    def evaluate(self, solution, rng: random.Random) -> float:
+        return evaluate_partitioning(
+            self.tensor, solution[0], self.communication_scheme, self.memory_limit, rng
+        )
+
+
+@dataclass
+class IntermediatePartitioningModel(OptModel):
+    """Moves a random subtree to the partition maximizing size reduction
+    (the reference's best-performing model, ``book/src/partitioning.md``)."""
+
+    tensor: CompositeTensor
+    communication_scheme: CommunicationScheme = CommunicationScheme.GREEDY
+    memory_limit: float | None = None
+
+    def initial_solution(
+        self,
+        partitioning: Sequence[int],
+        initial_paths: list[list[tuple[int, int]]] | None = None,
+    ):
+        partitioned = partition_tensor_network(
+            CompositeTensor(list(self.tensor.tensors)), partitioning
+        )
+        externals = [child.external_tensor() for child in partitioned]
+        paths = initial_paths or [
+            _local_greedy_path(list(child.tensors)) for child in partitioned
+        ]
+        return list(partitioning), externals, paths
+
+    def generate_trial_solution(self, current, rng: random.Random):
+        partitioning, partition_tensors, local_paths = current
+        partitioning = list(partitioning)
+        partition_tensors = [t.copy() for t in partition_tensors]
+        local_paths = [list(p) for p in local_paths]
+
+        picked = _pick_subtree_and_indices(partitioning, local_paths, rng)
+        if picked is None:
+            return partitioning, partition_tensors, local_paths
+        source, shifted_indices = picked
+
+        shifted = LeafTensor()
+        for index in shifted_indices:
+            shifted = shifted ^ self.tensor.tensors[index]
+
+        best_target = -1
+        best_score = math.inf
+        for p, external in enumerate(partition_tensors):
+            if p == source:
+                continue
+            score = (shifted ^ external).size() - external.size()
+            if score < best_score:
+                best_score = score
+                best_target = p
+        if best_target < 0:
+            return partitioning, partition_tensors, local_paths
+
+        for index in shifted_indices:
+            partitioning[index] = best_target
+        partition_tensors[source] = partition_tensors[source] ^ shifted
+        partition_tensors[best_target] = partition_tensors[best_target] ^ shifted
+        _recompute_two_paths(
+            self.tensor, partitioning, local_paths, source, best_target
+        )
+        return partitioning, partition_tensors, local_paths
+
+    def evaluate(self, solution, rng: random.Random) -> float:
+        return evaluate_partitioning(
+            self.tensor, solution[0], self.communication_scheme, self.memory_limit, rng
+        )
+
+
+@dataclass
+class SimulatedAnnealingOptimizer:
+    """SA engine (``simulated_annealing.rs:54-167``)."""
+
+    n_trials: int = 8
+    max_time: float = 10.0
+    n_steps: int = 80
+    restart_iter: int = 50
+    initial_temperature: float = 2.0
+    final_temperature: float = 0.05
+
+    def optimize(self, model: OptModel, initial_solution, rng: random.Random):
+        current_score = model.evaluate(initial_solution, rng)
+        current_solution = initial_solution
+        best_solution = current_solution
+        best_score = current_score
+        last_improvement = 0
+        steps_per_chain = -(-self.n_steps // self.n_trials)
+
+        log_start = math.log2(self.initial_temperature)
+        log_end = math.log2(self.final_temperature)
+        temperature = self.initial_temperature
+        chain_rngs = [
+            random.Random(rng.getrandbits(64)) for _ in range(self.n_trials)
+        ]
+        start = time.monotonic()
+        end_time = start + self.max_time
+
+        while True:
+            best_chain = None
+            for chain_rng in chain_rngs:
+                trial_score = current_score
+                trial_solution = current_solution
+                for _ in range(steps_per_chain):
+                    solution = model.generate_trial_solution(trial_solution, chain_rng)
+                    score = model.evaluate(solution, chain_rng)
+                    if score <= 0 or trial_score <= 0:
+                        accept = score < trial_score
+                    else:
+                        diff = math.log2(score / trial_score)
+                        accept = math.exp(-diff / temperature) >= chain_rng.random()
+                    if accept:
+                        trial_solution = solution
+                        trial_score = score
+                if best_chain is None or trial_score < best_chain[0]:
+                    best_chain = (trial_score, trial_solution)
+            assert best_chain is not None
+            current_score, current_solution = best_chain
+
+            if current_score < best_score:
+                best_solution = current_solution
+                best_score = current_score
+                last_improvement = 0
+            last_improvement += 1
+            if last_improvement == self.restart_iter:
+                current_solution = best_solution
+                current_score = best_score
+
+            now = time.monotonic()
+            if now > end_time:
+                break
+            progress = 1.0 - (end_time - now) / self.max_time
+            temperature = 2.0 ** (log_start + (log_end - log_start) * progress)
+
+        return best_solution, best_score
+
+
+def balance_partitions(
+    model: OptModel,
+    initial_solution,
+    rng: random.Random,
+    max_time: float = 10.0,
+    n_trials: int = 8,
+):
+    """Run SA with the reference's engine settings
+    (``simulated_annealing.rs:576-595``)."""
+    optimizer = SimulatedAnnealingOptimizer(
+        n_trials=n_trials,
+        max_time=max_time,
+        n_steps=n_trials * 10,
+        restart_iter=50,
+        initial_temperature=2.0,
+        final_temperature=0.05,
+    )
+    return optimizer.optimize(model, initial_solution, rng)

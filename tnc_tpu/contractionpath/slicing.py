@@ -1,0 +1,158 @@
+"""Contraction slicing: trade flops for peak memory.
+
+The reference explicitly does not support slicing
+(``book/src/parallelization.md`` "What about slicing?",
+``book/src/future_work.md`` item 2) — it spreads memory across MPI nodes
+instead. On TPU, HBM per chip is the binding constraint (16 GB on v5e), so
+slicing is first-class here: selected *contracted* legs are fixed to an
+index value, the contraction is executed once per index combination, and
+the results are summed. Each slice is an identical-shape program — ideal
+for XLA: one compiled executable, many cheap invocations (or a batched
+axis).
+
+The slice-leg selection is the standard greedy heuristic (as used by
+cotengra's SliceFinder): repeatedly slice the leg that most reduces the
+predicted peak intermediate size, until the peak fits the target.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Sequence
+
+from tnc_tpu.contractionpath.contraction_path import ContractionPath
+from tnc_tpu.tensornetwork.tensor import LeafTensor
+
+
+@dataclass(frozen=True)
+class Slicing:
+    """A set of sliced legs and their dimensions."""
+
+    legs: tuple[int, ...]
+    dims: tuple[int, ...]
+
+    @property
+    def num_slices(self) -> int:
+        n = 1
+        for d in self.dims:
+            n *= d
+        return n
+
+    def overhead(self) -> float:
+        """Upper bound on the flops multiplier caused by slicing."""
+        return float(self.num_slices)
+
+
+def _replay_sizes(
+    inputs: Sequence[LeafTensor],
+    replace_path: Sequence[tuple[int, int]],
+    removed: set[int],
+) -> tuple[float, dict[int, float]]:
+    """Peak step size of a flat replace path with ``removed`` legs sliced
+    away, and per-leg 'presence in peak step' accounting.
+
+    Returns (peak_size, leg -> largest step size that leg participates in).
+    """
+    tensors = [
+        LeafTensor(
+            [l for l in t.legs if l not in removed],
+            [d for l, d in t.edges() if l not in removed],
+        )
+        for t in inputs
+    ]
+    peak = 0.0
+    leg_peak: dict[int, float] = {}
+    for i, j in replace_path:
+        ti, tj = tensors[i], tensors[j]
+        out = ti ^ tj
+        step = out.size() + ti.size() + tj.size()
+        peak = max(peak, step)
+        for t in (ti, tj, out):
+            for leg in t.legs:
+                if step > leg_peak.get(leg, 0.0):
+                    leg_peak[leg] = step
+        tensors[i] = out
+    return peak, leg_peak
+
+
+def find_slicing(
+    inputs: Sequence[LeafTensor],
+    replace_path: Sequence[tuple[int, int]],
+    target_size: float,
+    max_slices: int = 1 << 24,
+) -> Slicing:
+    """Greedily pick legs to slice until the path's peak intermediate size
+    (in elements, out+in1+in2 model) is at most ``target_size``.
+
+    Only *closed* legs (absent from the final result) are sliceable.
+    Raises if the target cannot be met within ``max_slices``.
+    """
+    dims: dict[int, int] = {}
+    open_legs: set[int] = set()
+    for t in inputs:
+        for leg, dim in t.edges():
+            dims[leg] = dim
+            if leg in open_legs:
+                open_legs.discard(leg)
+            else:
+                open_legs.add(leg)
+
+    removed: set[int] = set()
+    num_slices = 1
+    while True:
+        peak, leg_peak = _replay_sizes(inputs, replace_path, removed)
+        if peak <= target_size:
+            break
+        # candidate legs: participate in the peak-sized steps, closed, unsliced
+        candidates = [
+            (size, dims[leg], leg)
+            for leg, size in leg_peak.items()
+            if leg not in removed and leg not in open_legs and dims[leg] > 1
+        ]
+        if not candidates:
+            raise ValueError(
+                f"No sliceable legs left but peak {peak:.3e} > target {target_size:.3e}"
+            )
+        # slice the leg participating in the largest step; among those,
+        # prefer larger dims (fewer legs for the same memory reduction)
+        candidates.sort(key=lambda c: (-c[0], -c[1], c[2]))
+        _, dim, leg = candidates[0]
+        removed.add(leg)
+        num_slices *= dim
+        if num_slices > max_slices:
+            raise ValueError(
+                f"Slicing needs more than {max_slices} slices to reach "
+                f"target {target_size:.3e}"
+            )
+
+    ordered = sorted(removed)
+    return Slicing(tuple(ordered), tuple(dims[l] for l in ordered))
+
+
+def sliced_flops(
+    inputs: Sequence[LeafTensor],
+    replace_path: Sequence[tuple[int, int]],
+    slicing: Slicing,
+) -> float:
+    """Total naive op cost across all slices."""
+    removed = set(slicing.legs)
+    tensors = [
+        LeafTensor(
+            [l for l in t.legs if l not in removed],
+            [d for l, d in t.edges() if l not in removed],
+        )
+        for t in inputs
+    ]
+    per_slice = 0.0
+    for i, j in replace_path:
+        per_slice += (tensors[i] | tensors[j]).size()
+        tensors[i] = tensors[i] ^ tensors[j]
+    return per_slice * slicing.num_slices
+
+
+def flat_replace_path(path_: ContractionPath) -> list[tuple[int, int]]:
+    """Toplevel of a simple replace path (slicing operates on flat paths)."""
+    if path_.nested:
+        raise ValueError("Slicing expects a flat (non-nested) path")
+    return list(path_.toplevel)

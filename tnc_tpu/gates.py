@@ -187,14 +187,25 @@ def _conjugate_adjoint(fn: GateFn) -> GateFn:
 
 
 class Gate:
-    """A named gate: compute(angles) -> tensor, adjoint(angles) -> tensor."""
+    """A named gate: compute(angles) -> tensor, adjoint(angles) -> tensor.
 
-    __slots__ = ("name", "compute", "_adjoint")
+    ``arity`` (qubit count) is optional; when set, frontends validate the
+    number of qubit arguments at call sites.
+    """
 
-    def __init__(self, name: str, compute: GateFn, adjoint: GateFn | None = None):
+    __slots__ = ("name", "compute", "_adjoint", "arity")
+
+    def __init__(
+        self,
+        name: str,
+        compute: GateFn,
+        adjoint: GateFn | None = None,
+        arity: int | None = None,
+    ):
         self.name = name
         self.compute = compute
         self._adjoint = adjoint
+        self.arity = arity
 
     def adjoint(self, angles: Sequence[float]) -> np.ndarray:
         if self._adjoint is not None:
@@ -229,24 +240,25 @@ def register_gate(gate: Gate) -> None:
 
 def _register_builtins() -> None:
     builtins = [
-        Gate("x", _gate_x, _gate_x),
-        Gate("y", _gate_y, _gate_y),
-        Gate("z", _gate_z, _gate_z),
-        Gate("h", _gate_h, _gate_h),
-        Gate("t", _gate_t, _conjugate_adjoint(_gate_t)),
-        Gate("u", _gate_u, _u_adjoint),
-        Gate("sx", _gate_sx, _conjugate_adjoint(_gate_sx)),
-        Gate("sy", _gate_sy, None),  # asymmetric: generic conjugate-transpose
-        Gate("sz", _gate_sz, _conjugate_adjoint(_gate_sz)),
-        Gate("rx", _gate_rx, _negated_angles_adjoint(_gate_rx)),
-        Gate("ry", _gate_ry, _negated_angles_adjoint(_gate_ry)),
-        Gate("rz", _gate_rz, _negated_angles_adjoint(_gate_rz)),
-        Gate("cx", _gate_cx, _gate_cx),
-        Gate("cz", _gate_cz, _gate_cz),
-        Gate("swap", _gate_swap, _gate_swap),
-        Gate("cp", _gate_cp, _negated_angles_adjoint(_gate_cp)),
-        Gate("iswap", _gate_iswap, _conjugate_adjoint(_gate_iswap)),
-        Gate("fsim", _gate_fsim, _negated_angles_adjoint(_gate_fsim)),
+        Gate("x", _gate_x, _gate_x, 1),
+        Gate("y", _gate_y, _gate_y, 1),
+        Gate("z", _gate_z, _gate_z, 1),
+        Gate("h", _gate_h, _gate_h, 1),
+        Gate("t", _gate_t, _conjugate_adjoint(_gate_t), 1),
+        Gate("u", _gate_u, _u_adjoint, 1),
+        Gate("sx", _gate_sx, _conjugate_adjoint(_gate_sx), 1),
+        # sy is asymmetric: generic conjugate-transpose adjoint
+        Gate("sy", _gate_sy, None, 1),
+        Gate("sz", _gate_sz, _conjugate_adjoint(_gate_sz), 1),
+        Gate("rx", _gate_rx, _negated_angles_adjoint(_gate_rx), 1),
+        Gate("ry", _gate_ry, _negated_angles_adjoint(_gate_ry), 1),
+        Gate("rz", _gate_rz, _negated_angles_adjoint(_gate_rz), 1),
+        Gate("cx", _gate_cx, _gate_cx, 2),
+        Gate("cz", _gate_cz, _gate_cz, 2),
+        Gate("swap", _gate_swap, _gate_swap, 2),
+        Gate("cp", _gate_cp, _negated_angles_adjoint(_gate_cp), 2),
+        Gate("iswap", _gate_iswap, _conjugate_adjoint(_gate_iswap), 2),
+        Gate("fsim", _gate_fsim, _negated_angles_adjoint(_gate_fsim), 2),
     ]
     for g in builtins:
         register_gate(g)
@@ -269,6 +281,12 @@ def load_gate_adjoint(name: str, angles: Sequence[float] = ()) -> np.ndarray:
     if name not in _GATES:
         raise KeyError(f"Gate '{name}' not found.")
     return _GATES[name].adjoint(angles)
+
+
+def gate_arity(name: str) -> int | None:
+    """Qubit count of a registered gate, if declared."""
+    gate = _GATES.get(name)
+    return gate.arity if gate is not None else None
 
 
 def gate_names() -> list[str]:

@@ -26,7 +26,7 @@ from pathlib import Path
 from lark import Lark, Token, Tree
 
 from tnc_tpu.builders.circuit_builder import Circuit, Qubit
-from tnc_tpu.gates import is_gate_known
+from tnc_tpu.gates import gate_arity, is_gate_known
 from tnc_tpu.io.qasm.grammar import QASM2_GRAMMAR
 from tnc_tpu.io.qasm.qelib1 import QELIB1
 from tnc_tpu.tensornetwork.tensordata import TensorData
@@ -130,7 +130,10 @@ class _Importer:
 
     def run(self, code: str) -> Circuit:
         code = self.expand_includes(code)
-        tree = _parser().parse(code)
+        try:
+            tree = _parser().parse(code)
+        except Exception as exc:  # lark parse/lex errors -> QasmError
+            raise QasmError(f"QASM parse error: {exc}") from exc
         for stmt in tree.children:
             if isinstance(stmt, Tree) and stmt.data == "version":
                 continue
@@ -227,9 +230,20 @@ class _Importer:
             qubits = [(qs[0] if len(qs) == 1 else qs[k]) for qs in resolved]
             self._apply(name, angles, qubits)
 
-    def _apply(self, name: str, angles: list[float], qubits: list[Qubit]) -> None:
+    def _apply(
+        self, name: str, angles: list[float], qubits: list[Qubit], depth: int = 0
+    ) -> None:
+        if depth > 64:
+            raise QasmError(
+                f"Gate inlining exceeded depth 64 at '{name}' (recursive definition?)"
+            )
         lname = name.lower()
         if is_gate_known(lname):
+            arity = gate_arity(lname)
+            if arity is not None and arity != len(qubits):
+                raise QasmError(
+                    f"Gate '{name}' expects {arity} qubits, got {len(qubits)}"
+                )
             self.circuit.append_gate(TensorData.gate(lname, tuple(angles)), qubits)
             return
         if name not in self.gate_defs:
@@ -254,7 +268,7 @@ class _Importer:
                 if qname not in qubit_env:
                     raise QasmError(f"Unknown qubit '{qname}' in gate '{name}'")
                 sub_qubits.append(qubit_env[qname])
-            self._apply(sub_name, sub_angles, sub_qubits)
+            self._apply(sub_name, sub_angles, sub_qubits, depth + 1)
 
 
 def import_qasm(code: str, include_dir: str | Path | None = None) -> Circuit:

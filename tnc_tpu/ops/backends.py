@@ -32,6 +32,9 @@ class Backend:
     def execute(self, program: ContractionProgram, arrays: Sequence[Any]) -> np.ndarray:
         raise NotImplementedError
 
+    def execute_sliced(self, sp, arrays: Sequence[Any]) -> np.ndarray:
+        raise NotImplementedError
+
 
 def _run_steps(xp, program: ContractionProgram, buffers: list[Any]) -> Any:
     for step in program.steps:
@@ -55,44 +58,99 @@ class NumpyBackend(Backend):
         buffers = [np.asarray(a, dtype=self.dtype) for a in arrays]
         return np.asarray(_run_steps(np, program, buffers))
 
+    def execute_sliced(self, sp, arrays: Sequence[Any]) -> np.ndarray:
+        from tnc_tpu.ops.sliced import execute_sliced_numpy
+
+        return execute_sliced_numpy(sp, arrays, dtype=self.dtype)
+
 
 class JaxBackend(Backend):
-    """jit-compiled whole-path execution on the default JAX device."""
+    """jit-compiled whole-path execution on the default JAX device.
+
+    Off-CPU the backend automatically switches to split-complex mode
+    (tensors as (real, imag) float pairs, Gauss 3-matmul contractions) —
+    the TPU runtime has no complex dtypes (see
+    :mod:`tnc_tpu.ops.split_complex`). ``precision`` controls the MXU
+    matmul passes in split mode ('default' | 'float32' | 'highest').
+    """
 
     name = "jax"
 
-    def __init__(self, dtype="complex64", donate: bool = True, device=None):
+    def __init__(
+        self,
+        dtype="complex64",
+        donate: bool = True,
+        device=None,
+        split_complex: bool | None = None,
+        precision: str | None = "float32",
+    ):
         import jax
 
         self._jax = jax
         self.dtype = dtype
         self.donate = donate
         self.device = device
+        if split_complex is None:
+            platform = (device or jax.devices()[0]).platform
+            split_complex = platform != "cpu"
+        self.split_complex = split_complex
+        self.precision = precision
+        self.part_dtype = "float64" if "128" in str(dtype) else "float32"
         self._cache: dict[tuple, Any] = {}
 
     def _compiled(self, program: ContractionProgram):
-        key = (program.signature(), str(self.dtype))
+        key = (program.signature(), str(self.dtype), self.split_complex)
         fn = self._cache.get(key)
         if fn is None:
             jax = self._jax
             import jax.numpy as jnp
 
-            def run(buffers: list[Any]) -> Any:
-                return _run_steps(jnp, program, list(buffers))
+            if self.split_complex:
+                from tnc_tpu.ops.split_complex import run_steps_split
+
+                precision = self.precision
+
+                def run(buffers: list[Any]) -> Any:
+                    return run_steps_split(jnp, program, list(buffers), precision)
+
+            else:
+
+                def run(buffers: list[Any]) -> Any:
+                    return _run_steps(jnp, program, list(buffers))
 
             donate = (0,) if self.donate else ()
             fn = jax.jit(run, donate_argnums=donate)
             self._cache[key] = fn
         return fn
 
-    def execute(self, program: ContractionProgram, arrays: Sequence[Any]) -> np.ndarray:
+    def _device_buffers(self, arrays: Sequence[Any]) -> list[Any]:
         import jax.numpy as jnp
 
-        buffers = [
+        if self.split_complex:
+            from tnc_tpu.ops.split_complex import split_array
+
+            out = []
+            for a in arrays:
+                re, im = split_array(a, self.part_dtype)
+                out.append(
+                    (
+                        self._jax.device_put(jnp.asarray(re), self.device),
+                        self._jax.device_put(jnp.asarray(im), self.device),
+                    )
+                )
+            return out
+        return [
             self._jax.device_put(jnp.asarray(a, dtype=self.dtype), self.device)
             for a in arrays
         ]
+
+    def execute(self, program: ContractionProgram, arrays: Sequence[Any]) -> np.ndarray:
+        buffers = self._device_buffers(arrays)
         result = self._run(program, buffers)
+        if self.split_complex:
+            from tnc_tpu.ops.split_complex import combine_array
+
+            return combine_array(*result)
         return np.asarray(result)
 
     def _run(self, program: ContractionProgram, buffers: list[Any]):
@@ -104,17 +162,35 @@ class JaxBackend(Backend):
             )
             return self._compiled(program)(buffers)
 
+    def execute_sliced(self, sp, arrays: Sequence[Any]) -> np.ndarray:
+        """Run a sliced program; the slice loop executes on device."""
+
+        from tnc_tpu.ops.sliced import make_jax_sliced_fn
+
+        if sp.slicing.num_slices == 1:
+            return self.execute(sp.program, arrays)
+
+        key = ("sliced", sp.signature(), str(self.dtype), self.split_complex)
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = make_jax_sliced_fn(
+                sp, split_complex=self.split_complex, precision=self.precision
+            )
+            self._cache[key] = fn
+        buffers = self._device_buffers(arrays)
+        result = fn(buffers)
+        if self.split_complex:
+            from tnc_tpu.ops.split_complex import combine_array
+
+            return combine_array(*result)
+        return np.asarray(result)
+
     def execute_on_device(self, program: ContractionProgram, arrays: Sequence[Any]):
         """Like :meth:`execute` but leaves the result on device (no host
-        round-trip) — used for benchmarking and distributed fan-in.
+        round-trip; a (real, imag) pair in split mode) — used for
+        benchmarking and distributed fan-in.
         """
-        import jax.numpy as jnp
-
-        buffers = [
-            self._jax.device_put(jnp.asarray(a, dtype=self.dtype), self.device)
-            for a in arrays
-        ]
-        return self._run(program, buffers)
+        return self._run(program, self._device_buffers(arrays))
 
 
 _BACKENDS: dict[str, Backend] = {}

@@ -1,0 +1,76 @@
+"""Split-complex execution: complex tensors as (real, imag) float pairs.
+
+The TPU's MXU is a real-arithmetic systolic array, and this stack exposes
+no complex dtypes at all — so the TPU path represents every tensor as two
+float32 arrays and lowers each pairwise contraction to **three** real
+matmuls via the Gauss/Karatsuba identity (25% fewer flops than the naive
+four):
+
+    k1 = (ar + ai) @ br
+    k2 = ar @ (bi - br)
+    k3 = ai @ (br + bi)
+    real = k1 - k3,  imag = k1 + k2
+
+This is the "split real/imag representation" contingency the survey
+flagged for TPU complex support (SURVEY.md §7 hard parts), promoted to
+the primary device layout. Host-side data stays complex128; the split
+happens at the host→device boundary.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import numpy as np
+
+from tnc_tpu.ops.program import ContractionProgram, PairStep
+
+
+def split_array(array: np.ndarray, dtype: str = "float32") -> tuple[np.ndarray, np.ndarray]:
+    array = np.asarray(array)
+    return (
+        np.ascontiguousarray(array.real, dtype=dtype),
+        np.ascontiguousarray(array.imag, dtype=dtype),
+    )
+
+
+def combine_array(re: Any, im: Any) -> np.ndarray:
+    return np.asarray(re) + 1j * np.asarray(im)
+
+
+def gauss_matmul(xp, ar, ai, br, bi, precision=None):
+    """Complex matmul on split parts with 3 real matmuls."""
+    if precision is None:
+        k1 = xp.matmul(ar + ai, br)
+        k2 = xp.matmul(ar, bi - br)
+        k3 = xp.matmul(ai, br + bi)
+    else:
+        k1 = xp.matmul(ar + ai, br, precision=precision)
+        k2 = xp.matmul(ar, bi - br, precision=precision)
+        k3 = xp.matmul(ai, br + bi, precision=precision)
+    return k1 - k3, k1 + k2
+
+
+def _prep(xp, part, perm: tuple[int, ...], mat: tuple[int, int]):
+    return xp.transpose(part, perm).reshape(mat)
+
+
+def run_steps_split(
+    xp,
+    program: ContractionProgram,
+    buffers: list[tuple[Any, Any] | None],
+    precision=None,
+):
+    """Split-complex analogue of ``backends._run_steps``; ``buffers`` are
+    (real, imag) pairs and the result is a pair."""
+    for step in program.steps:
+        ar, ai = buffers[step.lhs]
+        br, bi = buffers[step.rhs]
+        ar = _prep(xp, ar, step.lhs_perm, step.lhs_mat)
+        ai = _prep(xp, ai, step.lhs_perm, step.lhs_mat)
+        br = _prep(xp, br, step.rhs_perm, step.rhs_mat)
+        bi = _prep(xp, bi, step.rhs_perm, step.rhs_mat)
+        re, im = gauss_matmul(xp, ar, ai, br, bi, precision)
+        buffers[step.lhs] = (re.reshape(step.out_shape), im.reshape(step.out_shape))
+        buffers[step.rhs] = None
+    return buffers[program.result_slot]

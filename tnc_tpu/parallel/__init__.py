@@ -1,0 +1,4 @@
+from tnc_tpu.parallel.sliced_parallel import (  # noqa: F401
+    distributed_sliced_contraction,
+    make_mesh,
+)

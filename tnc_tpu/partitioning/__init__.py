@@ -1,0 +1,2 @@
+from tnc_tpu.partitioning.hypergraph import Hypergraph  # noqa: F401
+from tnc_tpu.partitioning.bisect import bisect, partition_kway  # noqa: F401

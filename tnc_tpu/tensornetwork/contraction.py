@@ -42,3 +42,28 @@ def contract_tensor_network(
         list(program.result_shape),
         TensorData.matrix(result),
     )
+
+
+def contract_tensor_network_sliced(
+    tn: CompositeTensor,
+    contract_path: ContractionPath,
+    slicing,
+    backend: str | Backend | None = None,
+) -> LeafTensor:
+    """Contract a network with the given legs sliced: the path executes
+    once per slice-index combination and results are summed. Peak memory
+    drops by the product of sliced dims (the capability the reference
+    lists as future work; see ``tnc_tpu.contractionpath.slicing``).
+    """
+    from tnc_tpu.ops.sliced import build_sliced_program
+
+    backend_obj = get_backend(backend)
+    sp = build_sliced_program(tn, contract_path, slicing)
+    leaves = flat_leaf_tensors(tn)
+    arrays = [leaf.data.into_data() for leaf in leaves]
+    result = backend_obj.execute_sliced(sp, arrays)
+    return LeafTensor(
+        list(sp.program.result_legs),
+        list(sp.program.result_shape),
+        TensorData.matrix(result),
+    )

@@ -1,0 +1,91 @@
+"""Tensor-network partitioning.
+
+Public equivalent of ``tnc/src/tensornetwork/partitioning.rs``:
+
+- :func:`find_partitioning` — split a network into ``k`` balanced blocks
+  minimizing the (log-weighted) cut, via the native multilevel partitioner
+  (the reference calls KaHyPar here, ``partitioning.rs:31-90``; 3%
+  imbalance as in ``partitioning.rs:47``).
+- :func:`communication_partitioning` — same, but vertices are weighted by
+  intermediate-tensor cost supplied by the caller
+  (``partitioning.rs:100-160``).
+- :func:`partition_tensor_network` — regroup tensors into one nested
+  composite per block (``partitioning.rs:164-174``).
+
+In the distributed executor, top-level children map one-to-one onto mesh
+devices.
+"""
+
+from __future__ import annotations
+
+import enum
+import random
+from typing import Sequence
+
+from tnc_tpu.partitioning.bisect import partition_kway
+from tnc_tpu.partitioning.hypergraph import hypergraph_from_tensors
+from tnc_tpu.tensornetwork.tensor import CompositeTensor
+
+
+class PartitioningStrategy(enum.Enum):
+    """Partitioner configuration presets (``partition_config.rs:12-36``).
+
+    MIN_CUT maps to cut-minimizing bisection; COMMUNITY_FINDING biases
+    toward connectivity (km1-style) — with recursive bisection both
+    reduce to the same objective, kept as distinct presets for parity.
+    """
+
+    MIN_CUT = "min_cut"
+    COMMUNITY_FINDING = "community_finding"
+
+
+def find_partitioning(
+    tn: CompositeTensor,
+    k: int,
+    strategy: PartitioningStrategy = PartitioningStrategy.MIN_CUT,
+    balanced: bool = True,
+    imbalance: float = 0.03,
+    seed: int = 42,
+) -> list[int]:
+    """Block id per top-level tensor of ``tn``, in ``0..k``."""
+    if k <= 0:
+        raise ValueError("k must be positive")
+    if k == 1:
+        return [0] * len(tn)
+    hg = hypergraph_from_tensors(
+        tn.tensors, unit_vertex_weights=strategy is PartitioningStrategy.MIN_CUT
+    )
+    eps = imbalance if balanced else 0.3
+    return partition_kway(hg, k, eps, random.Random(seed))
+
+
+def communication_partitioning(
+    tn: CompositeTensor,
+    k: int,
+    tensor_weights: Sequence[float],
+    imbalance: float = 0.03,
+    seed: int = 42,
+) -> list[int]:
+    """Partitioning for communication scheduling: vertex weights are the
+    caller-supplied per-tensor costs (e.g. intermediate sizes)."""
+    hg = hypergraph_from_tensors(tn.tensors)
+    if len(tensor_weights) != hg.num_vertices:
+        raise ValueError("tensor_weights length must match tensor count")
+    hg.vertex_weights = [max(1.0, float(w)) for w in tensor_weights]
+    return partition_kway(hg, k, imbalance, random.Random(seed))
+
+
+def partition_tensor_network(
+    tn: CompositeTensor, partitioning: Sequence[int]
+) -> CompositeTensor:
+    """Regroup top-level tensors into one nested composite per block.
+
+    Blocks are ordered by block id; empty blocks are dropped. Tensor order
+    within a block follows the original order, as in the reference.
+    """
+    if len(partitioning) != len(tn):
+        raise ValueError("partitioning length must match tensor count")
+    blocks: dict[int, CompositeTensor] = {}
+    for tensor, block in zip(tn.tensors, partitioning):
+        blocks.setdefault(block, CompositeTensor()).push_tensor(tensor)
+    return CompositeTensor([blocks[b] for b in sorted(blocks)])

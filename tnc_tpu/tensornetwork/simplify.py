@@ -1,0 +1,100 @@
+"""Exact network preprocessing: absorb rank<=2 tensors numerically.
+
+Quantum-circuit networks are dominated by rank-1 kets/bras and rank-2
+single-qubit gates. Contracting them into their neighbours is exact,
+costs microseconds on host, and shrinks a Sycamore-53 network from ~1200
+tensors to ~250 rank>=3 cores. Doing this on the **host** before planning
+and device execution:
+
+- makes the partition-based pathfinder dramatically better (the cores are
+  what matters),
+- shrinks the XLA program from ~1200 unrolled steps to the few hundred
+  that carry all the FLOPs (compile time and memory scale with program
+  size),
+- keeps the MXU fed with real matmuls instead of 2x2 trivia.
+
+This is a TPU-first division of labour the reference doesn't need (TBLIS
+calls are cheap to issue one at a time; ``contraction.rs:52-57``).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+from tnc_tpu.tensornetwork.tensordata import TensorData
+
+
+def _contract_pair_np(a: LeafTensor, b: LeafTensor) -> LeafTensor:
+    """Pairwise contraction on host, legs ordered as ``a ^ b``."""
+    from tnc_tpu.ops.program import _pair_step
+
+    step, result = _pair_step(0, 1, a, b)
+    da = np.asarray(a.data.into_data(), dtype=np.complex128)
+    db = np.asarray(b.data.into_data(), dtype=np.complex128)
+    da = np.transpose(da, step.lhs_perm).reshape(step.lhs_mat)
+    db = np.transpose(db, step.rhs_perm).reshape(step.rhs_mat)
+    out = (da @ db).reshape(step.out_shape)
+    result.data = TensorData.matrix(out)
+    return result
+
+
+def simplify_network(tn: CompositeTensor, max_rank: int = 2) -> CompositeTensor:
+    """Contract every tensor of rank <= ``max_rank`` into a neighbour,
+    repeatedly, materializing data on host. Returns the reduced network
+    (flat; surviving tensors keep their relative order).
+
+    Disconnected low-rank tensors (no shared legs) are left in place.
+    The result is numerically identical to contracting the original
+    network: only exact pairwise contractions are applied.
+    """
+    tensors: dict[int, LeafTensor] = {i: t for i, t in enumerate(tn.tensors)}
+    if any(isinstance(t, CompositeTensor) for t in tn.tensors):
+        raise ValueError("simplify_network expects a flat network")
+
+    leg_owners: dict[int, set[int]] = {}
+    for i, t in tensors.items():
+        for leg in t.legs:
+            leg_owners.setdefault(leg, set()).add(i)
+
+    next_id = len(tn.tensors)
+    order: list[int] = list(tensors)  # insertion order for stable output
+
+    queue = deque(i for i, t in tensors.items() if t.dims() <= max_rank)
+    while queue:
+        i = queue.popleft()
+        if i not in tensors or tensors[i].dims() > max_rank:
+            continue
+        if len(tensors) <= 2:
+            break
+        neighbour = -1
+        neighbour_rank = 1 << 30
+        for leg in tensors[i].legs:
+            for j in leg_owners.get(leg, ()):
+                if j != i and j in tensors and tensors[j].dims() < neighbour_rank:
+                    neighbour = j
+                    neighbour_rank = tensors[j].dims()
+        if neighbour < 0:
+            continue  # disconnected; leave it
+
+        merged = _contract_pair_np(tensors[i], tensors[neighbour])
+        for leg in set(tensors[i].legs) | set(tensors[neighbour].legs):
+            owners = leg_owners.get(leg)
+            if owners is not None:
+                owners.discard(i)
+                owners.discard(neighbour)
+        del tensors[i], tensors[neighbour]
+
+        new_id = next_id
+        next_id += 1
+        tensors[new_id] = merged
+        order.append(new_id)
+        for leg in merged.legs:
+            leg_owners.setdefault(leg, set()).add(new_id)
+        if merged.dims() <= max_rank:
+            queue.append(new_id)
+
+    surviving = [tensors[i] for i in order if i in tensors]
+    return CompositeTensor(surviving)
