@@ -45,7 +45,7 @@ def main() -> None:
     from tnc_tpu.builders.sycamore_circuit import sycamore_circuit
     from tnc_tpu.contractionpath.paths.hyper import Hyperoptimizer
     from tnc_tpu.contractionpath.slicing import find_slicing, sliced_flops
-    from tnc_tpu.ops.backends import JaxBackend, NumpyBackend
+    from tnc_tpu.ops.backends import JaxBackend
     from tnc_tpu.ops.program import flat_leaf_tensors
     from tnc_tpu.ops.sliced import build_sliced_program
 
@@ -104,14 +104,11 @@ def main() -> None:
     log(f"[bench] amplitude: {amplitude} | runs: {[round(t, 3) for t in times]}")
 
     # -- CPU baseline: same program, subset of slices, extrapolated --------
-    from tnc_tpu.contractionpath.slicing import Slicing
     from tnc_tpu.ops.sliced import execute_sliced_numpy
 
     n_sub = max(1, min(cpu_slices, slicing.num_slices))
-    # time numpy on n_sub slices by shrinking the slice loop
-    sub = Slicing(slicing.legs, slicing.dims)
     t0 = time.monotonic()
-    _partial_baseline(sp, arrays, n_sub)
+    execute_sliced_numpy(sp, arrays, dtype=np.complex64, max_slices=n_sub)
     cpu_sub_s = time.monotonic() - t0
     cpu_s = cpu_sub_s * (slicing.num_slices / n_sub)
     log(
@@ -130,26 +127,6 @@ def main() -> None:
             }
         )
     )
-
-
-def _partial_baseline(sp, arrays, n_sub: int) -> None:
-    """Run the first ``n_sub`` slices of ``sp`` with numpy."""
-    from tnc_tpu.ops.backends import _run_steps
-    from tnc_tpu.ops.sliced import _slice_indices
-
-    full = [np.asarray(a, dtype=np.complex64) for a in arrays]
-    acc = np.zeros(sp.program.result_shape, dtype=np.complex64)
-    for s in range(n_sub):
-        indices = _slice_indices(sp.slicing, s)
-        buffers = []
-        for arr, info in zip(full, sp.slot_slices):
-            view = arr
-            offset = 0
-            for axis, pos in info:
-                view = np.take(view, indices[pos], axis=axis - offset)
-                offset += 1
-            buffers.append(view)
-        acc = acc + _run_steps(np, sp.program, buffers)
 
 
 if __name__ == "__main__":

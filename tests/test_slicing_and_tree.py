@@ -124,3 +124,37 @@ def test_hyperoptimizer_correctness():
     a = complex(contract_tensor_network(tn, hyper.replace_path()).data.into_data())
     b = complex(contract_tensor_network(tn, greedy.replace_path()).data.into_data())
     assert a == pytest.approx(b, rel=1e-10, abs=1e-13)
+
+
+def test_deep_caterpillar_tree_no_recursion_limit():
+    """A chain network's greedy path is a depth-n caterpillar; the tree
+    walkers must be iterative (Python's recursion limit is ~1000)."""
+    from tnc_tpu.contractionpath.contraction_tree import ContractionTree
+    from tnc_tpu.tensornetwork.tensor import LeafTensor
+
+    n = 1500
+    bd = {i: 2 for i in range(n + 1)}
+    inputs = [LeafTensor.from_map([i, i + 1], bd) for i in range(n)]
+    ssa = [(0, 1)] + [(n + k, k + 2) for k in range(n - 2)]
+    tree = ContractionTree.from_ssa_path(inputs, ssa)
+    weights = tree.tree_weights()
+    pairs = tree.to_ssa_path()
+    assert len(pairs) == n - 1
+    assert len(weights) == 2 * n - 1
+    assert pairs == ssa  # round-trip preserves emission order
+
+
+def test_sa_models_reject_single_partition():
+    import pytest
+
+    from tnc_tpu.contractionpath.repartitioning.simulated_annealing import (
+        NaiveIntermediatePartitioningModel,
+        NaivePartitioningModel,
+    )
+    from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+
+    tn = CompositeTensor([LeafTensor.from_const([0], 2)])
+    with pytest.raises(ValueError):
+        NaivePartitioningModel(tn, 1)
+    with pytest.raises(ValueError):
+        NaiveIntermediatePartitioningModel(tn, 1)

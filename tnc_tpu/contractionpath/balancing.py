@@ -12,8 +12,10 @@ Schemes:
 
 - ``BEST_WORST`` — move the best-scoring leaf from the most expensive
   partition to the least expensive one.
-- ``TENSOR`` / ``TENSORS`` — move the single best (or a batch of) leaf
-  tensor(s) from the critical partition to the best target partition.
+- ``TENSOR`` — move the single best leaf tensor from the critical
+  partition to the best target partition.
+- ``TENSORS`` — additionally consider moving connected leaf *pairs*
+  (tensors sharing a leg) in one shift.
 - ``ALTERNATING_TENSORS`` — alternate donor between the most expensive
   and the most memory-heavy partition.
 - ``INTERMEDIATE_TENSORS(height_limit)`` — move an intermediate subtree
@@ -34,13 +36,12 @@ from typing import Callable, Sequence
 
 from tnc_tpu.contractionpath.communication_schemes import CommunicationScheme
 from tnc_tpu.contractionpath.contraction_cost import (
-    communication_path_op_costs,
     compute_memory_requirements,
     contract_path_cost,
     contract_size_tensors_bytes,
 )
 from tnc_tpu.contractionpath.contraction_path import ContractionPath
-from tnc_tpu.contractionpath.paths.greedy import Greedy, OptMethod
+from tnc_tpu.contractionpath.repartitioning import compute_solution
 from tnc_tpu.contractionpath.repartitioning.simulated_annealing import (
     _local_greedy_path,
     _subtree_leaves,
@@ -126,22 +127,9 @@ def _evaluate(
     settings: BalanceSettings,
     rng: random.Random,
 ) -> tuple[float, CompositeTensor, ContractionPath]:
-    partitioned = partition_tensor_network(
-        CompositeTensor(list(tensor.tensors)), state.partitioning
+    partitioned, full_path, parallel, _ = compute_solution(
+        tensor, state.partitioning, settings.communication_scheme, rng
     )
-    result = Greedy(OptMethod.GREEDY).find_path(partitioned)
-    path = result.replace_path()
-
-    latency = {i: 0.0 for i in range(len(partitioned))}
-    for i, local in path.nested.items():
-        cost, _ = contract_path_cost(partitioned[i].tensors, local, True)
-        latency[i] = cost
-    externals = [child.external_tensor() for child in partitioned]
-    comm = settings.communication_scheme.communication_path(externals, latency, rng)
-    costs = [latency[i] for i in range(len(externals))]
-    (parallel, _), _ = communication_path_op_costs(externals, comm, True, costs)
-    full_path = ContractionPath(path.nested, comm)
-
     if settings.memory_limit is not None:
         mem = compute_memory_requirements(
             partitioned.tensors, full_path, contract_size_tensors_bytes
@@ -182,7 +170,18 @@ def _movable_groups(
                 groups.append([donor_indices[k] for k in sorted(leaves)])
         if groups:
             return groups
-    # leaf moves (also the fallback for subtree schemes)
+    if scheme in (BalancingScheme.TENSORS, BalancingScheme.ALTERNATING_TENSORS):
+        # batch moves: connected leaf pairs (sharing a leg) in addition to
+        # single leaves, so a bonded cluster can migrate in one shift
+        groups = [[g] for g in donor_indices]
+        if len(donor_indices) > 2:
+            legs_of = {g: set(tensor.tensors[g].legs) for g in donor_indices}
+            for a_pos, a in enumerate(donor_indices):
+                for b in donor_indices[a_pos + 1 :]:
+                    if legs_of[a] & legs_of[b]:
+                        groups.append([a, b])
+        return groups
+    # single-leaf moves (also the fallback for subtree schemes)
     return [[g] for g in donor_indices]
 
 

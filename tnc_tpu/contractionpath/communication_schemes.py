@@ -30,17 +30,12 @@ import random
 from typing import Sequence
 
 from tnc_tpu.contractionpath.contraction_cost import communication_path_cost
-from tnc_tpu.contractionpath.contraction_path import (
-    ContractionPath,
-    ssa_replace_ordering,
-)
+from tnc_tpu.contractionpath.contraction_path import SimplePath  # noqa: F401
 from tnc_tpu.contractionpath.paths.branchbound import WeightedBranchBound
 from tnc_tpu.contractionpath.paths.greedy import Greedy, OptMethod
 from tnc_tpu.partitioning.bisect import bisect
 from tnc_tpu.partitioning.hypergraph import hypergraph_from_tensors
 from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
-
-SimplePath = list
 
 
 class CommunicationScheme(enum.Enum):

@@ -111,21 +111,33 @@ class ContractionTree:
             stack.append(nd.right)
         return flops, peak
 
+    def _postorder(self) -> list[int]:
+        """Iterative post-order over the subtree of ``root`` (deep
+        caterpillar trees exceed Python's recursion limit)."""
+        order: list[int] = []
+        stack = [self.root]
+        while stack:
+            i = stack.pop()
+            order.append(i)
+            nd = self.nodes[i]
+            if not nd.is_leaf:
+                stack.append(nd.left)
+                stack.append(nd.right)
+        order.reverse()
+        return order
+
     def tree_weights(self) -> dict[int, float]:
         """Accumulated contraction cost per node
         (``contraction_tree.rs:303-314``)."""
         weights: dict[int, float] = {}
-
-        def walk(i: int) -> float:
+        for i in self._postorder():
             nd = self.nodes[i]
             if nd.is_leaf:
                 weights[i] = 0.0
-                return 0.0
-            w = walk(nd.left) + walk(nd.right) + self.node_cost(i)
-            weights[i] = w
-            return w
-
-        walk(self.root)
+            else:
+                weights[i] = (
+                    weights[nd.left] + weights[nd.right] + self.node_cost(i)
+                )
         return weights
 
     def to_ssa_path(self) -> list[tuple[int, int]]:
@@ -133,20 +145,14 @@ class ContractionTree:
         ssa_of: dict[int, int] = {}
         next_id = self.num_leaves
         pairs: list[tuple[int, int]] = []
-
-        def walk(i: int) -> int:
-            nonlocal next_id
+        for i in self._postorder():
             nd = self.nodes[i]
             if nd.is_leaf:
-                return i
-            a = walk(nd.left)
-            b = walk(nd.right)
-            pairs.append((a, b))
-            out = next_id
+                ssa_of[i] = i
+                continue
+            pairs.append((ssa_of[nd.left], ssa_of[nd.right]))
+            ssa_of[i] = next_id
             next_id += 1
-            return out
-
-        walk(self.root)
         return pairs
 
     # -- subtree reconfiguration -------------------------------------------

@@ -71,6 +71,15 @@ class OptModel:
     def evaluate(self, solution, rng: random.Random) -> float:
         raise NotImplementedError
 
+    def _require_multiple_partitions(self) -> None:
+        # A 1-partition model has no moves: the trial loops that pick a
+        # different target partition would spin forever.
+        if self.num_partitions < 2:
+            raise ValueError(
+                f"{type(self).__name__} needs num_partitions >= 2, "
+                f"got {self.num_partitions}"
+            )
+
 
 @dataclass
 class NaivePartitioningModel(OptModel):
@@ -78,6 +87,9 @@ class NaivePartitioningModel(OptModel):
     num_partitions: int
     communication_scheme: CommunicationScheme = CommunicationScheme.GREEDY
     memory_limit: float | None = None
+
+    def __post_init__(self) -> None:
+        self._require_multiple_partitions()
 
     def initial_solution(self, partitioning: Sequence[int]) -> list[int]:
         return list(partitioning)
@@ -171,6 +183,9 @@ class NaiveIntermediatePartitioningModel(OptModel):
     num_partitions: int
     communication_scheme: CommunicationScheme = CommunicationScheme.GREEDY
     memory_limit: float | None = None
+
+    def __post_init__(self) -> None:
+        self._require_multiple_partitions()
 
     def initial_solution(
         self, partitioning: Sequence[int]

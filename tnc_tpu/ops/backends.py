@@ -48,6 +48,51 @@ def _run_steps(xp, program: ContractionProgram, buffers: list[Any]) -> Any:
     return buffers[program.result_slot]
 
 
+_PROGRAM_JIT_CACHE: dict[tuple, Any] = {}
+
+
+def jit_program(
+    program: ContractionProgram,
+    split_complex: bool,
+    precision: str | None = None,
+    donate: bool = True,
+):
+    """Program → jitted ``fn(buffers)`` with donated inputs; one traced
+    function per (program, mode), one XLA executable per input placement.
+    Shared by :class:`JaxBackend` and the distributed executors."""
+    import jax
+
+    key = (program.signature(), split_complex, precision, donate)
+    fn = _PROGRAM_JIT_CACHE.get(key)
+    if fn is None:
+        import jax.numpy as jnp
+
+        if split_complex:
+            from tnc_tpu.ops.split_complex import run_steps_split
+
+            def run(buffers):
+                return run_steps_split(jnp, program, list(buffers), precision)
+
+        else:
+
+            def run(buffers):
+                return _run_steps(jnp, program, list(buffers))
+
+        jitted = jax.jit(run, donate_argnums=(0,) if donate else ())
+
+        def fn(buffers, _jitted=jitted):
+            with warnings.catch_warnings():
+                # Tiny gate inputs routinely can't back larger intermediates;
+                # XLA's per-buffer donation warning is pure noise here.
+                warnings.filterwarnings(
+                    "ignore", message="Some donated buffers were not usable"
+                )
+                return _jitted(buffers)
+
+        _PROGRAM_JIT_CACHE[key] = fn
+    return fn
+
+
 class NumpyBackend(Backend):
     name = "numpy"
 
@@ -99,29 +144,8 @@ class JaxBackend(Backend):
         self._cache: dict[tuple, Any] = {}
 
     def _compiled(self, program: ContractionProgram):
-        key = (program.signature(), str(self.dtype), self.split_complex)
-        fn = self._cache.get(key)
-        if fn is None:
-            jax = self._jax
-            import jax.numpy as jnp
-
-            if self.split_complex:
-                from tnc_tpu.ops.split_complex import run_steps_split
-
-                precision = self.precision
-
-                def run(buffers: list[Any]) -> Any:
-                    return run_steps_split(jnp, program, list(buffers), precision)
-
-            else:
-
-                def run(buffers: list[Any]) -> Any:
-                    return _run_steps(jnp, program, list(buffers))
-
-            donate = (0,) if self.donate else ()
-            fn = jax.jit(run, donate_argnums=donate)
-            self._cache[key] = fn
-        return fn
+        precision = self.precision if self.split_complex else None
+        return jit_program(program, self.split_complex, precision, self.donate)
 
     def _device_buffers(self, arrays: Sequence[Any]) -> list[Any]:
         import jax.numpy as jnp
@@ -154,13 +178,7 @@ class JaxBackend(Backend):
         return np.asarray(result)
 
     def _run(self, program: ContractionProgram, buffers: list[Any]):
-        with warnings.catch_warnings():
-            # Tiny gate inputs are routinely not reusable for larger
-            # intermediates; XLA's per-buffer warning is pure noise here.
-            warnings.filterwarnings(
-                "ignore", message="Some donated buffers were not usable"
-            )
-            return self._compiled(program)(buffers)
+        return self._compiled(program)(buffers)
 
     def execute_sliced(self, sp, arrays: Sequence[Any]) -> np.ndarray:
         """Run a sliced program; the slice loop executes on device."""

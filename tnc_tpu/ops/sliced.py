@@ -99,12 +99,22 @@ def _slice_indices(slicing: Slicing, s: int) -> list[int]:
 
 
 def execute_sliced_numpy(
-    sp: SlicedProgram, arrays: Sequence[np.ndarray], dtype=np.complex128
+    sp: SlicedProgram,
+    arrays: Sequence[np.ndarray],
+    dtype=np.complex128,
+    max_slices: int | None = None,
 ) -> np.ndarray:
-    """CPU oracle: python loop over slices, sum of program results."""
+    """CPU oracle: python loop over slices, sum of program results.
+
+    ``max_slices`` caps the loop (partial sum) — used by benchmark
+    baselines that extrapolate from a slice subset.
+    """
     full = [np.asarray(a, dtype=dtype) for a in arrays]
     acc = np.zeros(sp.program.result_shape, dtype=dtype)
-    for s in range(sp.slicing.num_slices):
+    num = sp.slicing.num_slices
+    if max_slices is not None:
+        num = min(num, max_slices)
+    for s in range(num):
         indices = _slice_indices(sp.slicing, s)
         buffers: list[Any] = []
         for arr, info in zip(full, sp.slot_slices):

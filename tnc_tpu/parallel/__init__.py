@@ -1,3 +1,11 @@
+from tnc_tpu.parallel.partitioned import (  # noqa: F401
+    Communication,
+    DeviceTensorMapping,
+    distributed_partitioned_contraction,
+    intermediate_reduce,
+    local_contract_partitions,
+    scatter_partitions,
+)
 from tnc_tpu.parallel.sliced_parallel import (  # noqa: F401
     distributed_sliced_contraction,
     make_mesh,

@@ -45,7 +45,14 @@ def make_mesh(n_devices: int | None = None, axis: str = "slices"):
     return Mesh(np.asarray(devices), (axis,))
 
 
-def _make_spmd_fn(sp: SlicedProgram, mesh, axis: str, dtype, split_complex: bool):
+def _make_spmd_fn(
+    sp: SlicedProgram,
+    mesh,
+    axis: str,
+    dtype,
+    split_complex: bool,
+    precision: str | None = "float32",
+):
     """fn(full_buffers) replicated over the mesh; each device sums its
     slice chunk, then one psum over the mesh axis."""
     import jax
@@ -96,7 +103,7 @@ def _make_spmd_fn(sp: SlicedProgram, mesh, axis: str, dtype, split_complex: bool
                     )
                     for (re, im), info in zip(full_buffers, sp.slot_slices)
                 ]
-                re, im = run_steps_split(jnp, sp.program, buffers)
+                re, im = run_steps_split(jnp, sp.program, buffers, precision)
                 return acc[0] + re, acc[1] + im
 
             acc0 = (
@@ -140,6 +147,7 @@ def distributed_sliced_contraction(
     dtype: str = "complex64",
     axis: str = "slices",
     split_complex: bool | None = None,
+    precision: str | None = "float32",
 ) -> LeafTensor:
     """Contract ``tn`` with slices distributed over a device mesh.
 
@@ -159,7 +167,7 @@ def distributed_sliced_contraction(
 
     sp = build_sliced_program(tn, contract_path, slicing)
     leaves = flat_leaf_tensors(tn)
-    fn = _make_spmd_fn(sp, mesh, axis, dtype, split_complex)
+    fn = _make_spmd_fn(sp, mesh, axis, dtype, split_complex, precision)
     if split_complex:
         from tnc_tpu.ops.split_complex import combine_array, split_array
 
