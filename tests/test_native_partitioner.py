@@ -1,0 +1,105 @@
+"""Native C++ partitioner: build, correctness, and parity with the
+pure-Python oracle (the reference's analogous component is the KaHyPar
+C++ library behind the ``kahypar`` crate)."""
+
+import random
+
+import pytest
+
+from tnc_tpu.partitioning.bisect import partition_kway
+from tnc_tpu.partitioning.hypergraph import Hypergraph
+from tnc_tpu.partitioning.native_binding import (
+    load_native,
+    native_partition_kway,
+)
+
+pytestmark = pytest.mark.skipif(
+    load_native() is None, reason="native partitioner unavailable"
+)
+
+
+def _ring(n):
+    edges = [[i, (i + 1) % n] for i in range(n)]
+    return Hypergraph(n, [1.0] * n, edges, [1.0] * n)
+
+
+def _two_cliques():
+    edges = []
+    for base in (0, 8):
+        for i in range(8):
+            for j in range(i + 1, 8):
+                edges.append([base + i, base + j])
+    edges.append([0, 8])
+    return Hypergraph(16, [1.0] * 16, edges, [1.0] * len(edges))
+
+
+def test_native_ring_bisection():
+    hg = _ring(32)
+    part = native_partition_kway(hg, 2, 0.05, seed=0)
+    assert part is not None and len(part) == 32
+    sizes = [part.count(0), part.count(1)]
+    assert min(sizes) >= 14
+    assert hg.cut_weight(part) == 2.0
+
+
+def test_native_two_cliques_min_cut():
+    hg = _two_cliques()
+    part = native_partition_kway(hg, 2, 0.05, seed=1)
+    assert hg.cut_weight(part) == 1.0
+    assert {part[i] for i in range(8)} != {part[i] for i in range(8, 16)}
+
+
+def test_native_kway_balance():
+    hg = _ring(64)
+    for k in (2, 4, 8):
+        part = native_partition_kway(hg, k, 0.1, seed=2)
+        counts = [part.count(b) for b in range(k)]
+        assert len([c for c in counts if c > 0]) == k
+        assert max(counts) <= (64 / k) * 1.35
+
+
+def test_native_deterministic():
+    hg = _ring(48)
+    a = native_partition_kway(hg, 4, 0.05, seed=7)
+    b = native_partition_kway(hg, 4, 0.05, seed=7)
+    assert a == b
+
+
+def test_partition_kway_dispatches_to_native(monkeypatch):
+    """The public entry uses native when available, Python otherwise,
+    and both satisfy the same quality contract."""
+    hg = _two_cliques()
+    via_native = partition_kway(hg, 2, 0.05, random.Random(3))
+    assert hg.cut_weight(via_native) == 1.0
+
+    monkeypatch.setenv("TNC_TPU_NO_NATIVE", "1")
+    via_python = partition_kway(hg, 2, 0.05, random.Random(3))
+    assert hg.cut_weight(via_python) == 1.0
+
+
+def test_native_cut_quality_parity_random_graphs(monkeypatch):
+    """Best-of-seeds native cut must be comparable to the Python oracle's
+    (single-seed results are luck-dominated on random graphs for both
+    implementations; multi-trial is how partitioners are run in practice,
+    cf. the reference's seeded sweeps)."""
+    rng = random.Random(11)
+    for trial in range(4):
+        n = 40
+        edges = []
+        for _ in range(90):
+            a = rng.randrange(n)
+            b = rng.randrange(n)
+            if a != b:
+                edges.append([a, b])
+        hg = Hypergraph(n, [1.0] * n, edges, [1.0] * len(edges))
+        native_best = min(
+            hg.cut_weight(native_partition_kway(hg, 4, 0.1, seed=s))
+            for s in range(6)
+        )
+        with monkeypatch.context() as m:
+            m.setenv("TNC_TPU_NO_NATIVE", "1")
+            py_best = min(
+                hg.cut_weight(partition_kway(hg, 4, 0.1, random.Random(s)))
+                for s in range(6)
+            )
+        assert native_best <= py_best * 1.5 + 5.0

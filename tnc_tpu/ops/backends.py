@@ -19,6 +19,7 @@ nothing.
 from __future__ import annotations
 
 import warnings
+from collections import OrderedDict
 from typing import Any, Sequence
 
 import numpy as np
@@ -48,7 +49,8 @@ def _run_steps(xp, program: ContractionProgram, buffers: list[Any]) -> Any:
     return buffers[program.result_slot]
 
 
-_PROGRAM_JIT_CACHE: dict[tuple, Any] = {}
+_PROGRAM_JIT_CACHE: "OrderedDict[tuple, Any]" = OrderedDict()
+_PROGRAM_JIT_CACHE_MAX = 256
 
 
 def jit_program(
@@ -59,11 +61,17 @@ def jit_program(
 ):
     """Program → jitted ``fn(buffers)`` with donated inputs; one traced
     function per (program, mode), one XLA executable per input placement.
-    Shared by :class:`JaxBackend` and the distributed executors."""
+    Shared by :class:`JaxBackend` and the distributed executors.
+    LRU-bounded so long sweeps over many distinct networks don't pin
+    every executable for the process lifetime."""
     import jax
 
+    if not split_complex:
+        precision = None  # only the split path consumes it: one cache key
     key = (program.signature(), split_complex, precision, donate)
     fn = _PROGRAM_JIT_CACHE.get(key)
+    if fn is not None:
+        _PROGRAM_JIT_CACHE.move_to_end(key)
     if fn is None:
         import jax.numpy as jnp
 
@@ -90,7 +98,40 @@ def jit_program(
                 return _jitted(buffers)
 
         _PROGRAM_JIT_CACHE[key] = fn
+        while len(_PROGRAM_JIT_CACHE) > _PROGRAM_JIT_CACHE_MAX:
+            _PROGRAM_JIT_CACHE.popitem(last=False)
     return fn
+
+
+def place_buffers(
+    arrays: Sequence[Any],
+    dtype,
+    split_complex: bool,
+    device=None,
+) -> list[Any]:
+    """Host arrays → device buffers: complex arrays as-is, or (real, imag)
+    float pairs in split mode. Shared by :class:`JaxBackend` and the
+    distributed executors (the placement rule must not diverge)."""
+    import jax
+    import jax.numpy as jnp
+
+    if split_complex:
+        from tnc_tpu.ops.split_complex import split_array
+
+        part_dtype = "float64" if "128" in str(dtype) else "float32"
+        out = []
+        for a in arrays:
+            re, im = split_array(a, part_dtype)
+            out.append(
+                (
+                    jax.device_put(jnp.asarray(re), device),
+                    jax.device_put(jnp.asarray(im), device),
+                )
+            )
+        return out
+    return [
+        jax.device_put(jnp.asarray(a, dtype=dtype), device) for a in arrays
+    ]
 
 
 class NumpyBackend(Backend):
@@ -140,7 +181,6 @@ class JaxBackend(Backend):
             split_complex = platform != "cpu"
         self.split_complex = split_complex
         self.precision = precision
-        self.part_dtype = "float64" if "128" in str(dtype) else "float32"
         self._cache: dict[tuple, Any] = {}
 
     def _compiled(self, program: ContractionProgram):
@@ -148,25 +188,7 @@ class JaxBackend(Backend):
         return jit_program(program, self.split_complex, precision, self.donate)
 
     def _device_buffers(self, arrays: Sequence[Any]) -> list[Any]:
-        import jax.numpy as jnp
-
-        if self.split_complex:
-            from tnc_tpu.ops.split_complex import split_array
-
-            out = []
-            for a in arrays:
-                re, im = split_array(a, self.part_dtype)
-                out.append(
-                    (
-                        self._jax.device_put(jnp.asarray(re), self.device),
-                        self._jax.device_put(jnp.asarray(im), self.device),
-                    )
-                )
-            return out
-        return [
-            self._jax.device_put(jnp.asarray(a, dtype=self.dtype), self.device)
-            for a in arrays
-        ]
+        return place_buffers(arrays, self.dtype, self.split_complex, self.device)
 
     def execute(self, program: ContractionProgram, arrays: Sequence[Any]) -> np.ndarray:
         buffers = self._device_buffers(arrays)

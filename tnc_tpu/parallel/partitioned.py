@@ -40,7 +40,7 @@ from typing import Any, Sequence
 import numpy as np
 
 from tnc_tpu.contractionpath.contraction_path import ContractionPath
-from tnc_tpu.ops.backends import jit_program
+from tnc_tpu.ops.backends import jit_program, place_buffers
 from tnc_tpu.ops.program import (
     ContractionProgram,
     _pair_step,
@@ -128,9 +128,6 @@ def scatter_partitions(
     """Compile per-partition programs and place each partition's leaves on
     its device (``scatter_tensor_network``, ``communication.rs:125-195``).
     """
-    import jax
-    import jax.numpy as jnp
-
     children = list(tn.tensors)
     k = len(children)
     for i, child in enumerate(children):
@@ -142,7 +139,6 @@ def scatter_partitions(
         raise ValueError(f"{k} partitions but only {len(devices)} devices")
 
     mapping = DeviceTensorMapping.for_path(k, contract_path.toplevel)
-    part_dtype = "float64" if "128" in str(dtype) else "float32"
 
     programs: list[ContractionProgram] = []
     metas: list[LeafTensor] = []
@@ -153,26 +149,12 @@ def scatter_partitions(
         metas.append(
             LeafTensor(list(program.result_legs), list(program.result_shape))
         )
-        device = devices[mapping.device(i)]
-        arrays = _leaf_arrays(child)
-        if split_complex:
-            from tnc_tpu.ops.split_complex import split_array
-
-            placed = []
-            for a in arrays:
-                re, im = split_array(a, part_dtype)
-                placed.append(
-                    (
-                        jax.device_put(jnp.asarray(re), device),
-                        jax.device_put(jnp.asarray(im), device),
-                    )
-                )
-        else:
-            placed = [
-                jax.device_put(jnp.asarray(a, dtype=dtype), device)
-                for a in arrays
-            ]
-        buffers.append(placed)
+        buffers.append(
+            place_buffers(
+                _leaf_arrays(child), dtype, split_complex,
+                devices[mapping.device(i)],
+            )
+        )
 
     comm = Communication(mapping, list(devices), programs, metas)
     return comm, buffers

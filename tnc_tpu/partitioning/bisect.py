@@ -257,9 +257,21 @@ def partition_kway(
     imbalance: float = 0.03,
     rng: random.Random | None = None,
 ) -> list[int]:
-    """Recursive-bisection k-way partitioning (KaHyPar's RB mode)."""
+    """Recursive-bisection k-way partitioning (KaHyPar's RB mode).
+
+    Dispatches to the native C++ partitioner when available (same
+    algorithm family, much faster on large networks); this Python
+    implementation is the oracle and fallback.
+    """
     if rng is None:
         rng = random.Random(42)
+
+    from tnc_tpu.partitioning.native_binding import native_partition_kway
+
+    native = native_partition_kway(hg, k, imbalance, rng.getrandbits(63))
+    if native is not None:
+        return native
+
     part = [0] * hg.num_vertices
 
     def recurse(vertices: list[int], k_local: int, base: int) -> None:
