@@ -1,0 +1,105 @@
+"""Tree-refinement pathfinders (TreeAnnealing / TreeReconfigure /
+TreeTempering — reference: ``paths/tree_annealing.rs`` etc., which bridge
+to cotengra; these are native implementations)."""
+
+import numpy as np
+import pytest
+
+from tnc_tpu.builders.connectivity import ConnectivityLayout
+from tnc_tpu.builders.random_circuit import random_circuit
+from tnc_tpu.contractionpath.paths import (
+    Greedy,
+    OptMethod,
+    TreeAnnealing,
+    TreeReconfigure,
+    TreeTempering,
+)
+from tnc_tpu.tensornetwork.contraction import contract_tensor_network
+
+FINDERS = [
+    TreeAnnealing(seed=1),
+    TreeReconfigure(),
+    TreeTempering(num_replicas=3, rounds=4, seed=1),
+]
+
+
+def _network(qubits=8, depth=4, seed=5):
+    rng = np.random.default_rng(seed)
+    return random_circuit(qubits, depth, 0.9, 0.8, rng, ConnectivityLayout.LINE)
+
+
+@pytest.mark.parametrize("finder", FINDERS, ids=lambda f: type(f).__name__)
+def test_refined_path_contracts_correctly(finder):
+    """Refined paths must stay valid: same contraction value as greedy."""
+    tn = _network()
+    want = complex(
+        contract_tensor_network(
+            tn, Greedy(OptMethod.GREEDY).find_path(tn).replace_path()
+        ).data.into_data()
+    )
+    result = finder.find_path(tn)
+    got = complex(
+        contract_tensor_network(tn, result.replace_path()).data.into_data()
+    )
+    assert got == pytest.approx(want, rel=1e-10, abs=1e-12)
+
+
+@pytest.mark.parametrize("finder", FINDERS, ids=lambda f: type(f).__name__)
+def test_refinement_does_not_regress_greedy(finder):
+    """Refiners start from the greedy tree; predicted flops must not get
+    meaningfully worse (they return the best tree seen)."""
+    tn = _network(qubits=10, depth=5, seed=9)
+    greedy = Greedy(OptMethod.GREEDY).find_path(tn)
+    refined = finder.find_path(tn)
+    assert refined.flops <= greedy.flops * 1.05
+
+
+def test_annealing_improves_on_chain_worst_case():
+    """A bad initial association on a chain must be fixable by rotations:
+    anneal a caterpillar over increasing bond dims."""
+    from tnc_tpu.contractionpath.contraction_tree import ContractionTree
+    from tnc_tpu.contractionpath.paths.tree_refine import _anneal
+    import random
+
+    from tnc_tpu.tensornetwork.tensor import LeafTensor
+
+    # chain of matrices with a huge middle bond: the left-to-right
+    # caterpillar is far from optimal
+    bd = {0: 2, 1: 64, 2: 64, 3: 2}
+    inputs = [
+        LeafTensor.from_map([0, 1], bd),
+        LeafTensor.from_map([1, 2], bd),
+        LeafTensor.from_map([2, 3], bd),
+    ]
+    # worst association: ((t0 t2) t1) -- outer product first
+    ssa = [(0, 2), (3, 1)]
+    tree = ContractionTree.from_ssa_path(inputs, ssa)
+    before = tree.total_cost()[0]
+    _anneal(tree, random.Random(0), 400, 2.0, 0.05, "flops")
+    after = tree.total_cost()[0]
+    assert after < before
+
+
+def test_refiners_handle_nested_composites():
+    """The shared Pathfinder recursion applies: partitioned networks get
+    nested paths from the same refiner."""
+    from tnc_tpu import CompositeTensor
+    from tnc_tpu.tensornetwork.partitioning import (
+        find_partitioning,
+        partition_tensor_network,
+    )
+
+    tn = _network()
+    part = find_partitioning(tn, 2)
+    grouped = partition_tensor_network(CompositeTensor(list(tn.tensors)), part)
+    result = TreeReconfigure().find_path(grouped)
+    assert set(result.ssa_path.nested) == {0, 1}
+    want = complex(
+        contract_tensor_network(
+            tn, Greedy(OptMethod.GREEDY).find_path(tn).replace_path()
+        ).data.into_data()
+    )
+    got = complex(
+        contract_tensor_network(grouped, result.replace_path()).data.into_data()
+    )
+    assert got == pytest.approx(want, rel=1e-10, abs=1e-12)

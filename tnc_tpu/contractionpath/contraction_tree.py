@@ -74,6 +74,17 @@ class ContractionTree:
         self.nodes[b].parent = new_id
         return new_id
 
+    def copy(self) -> "ContractionTree":
+        """Deep copy (used by the tempering replicas)."""
+        out = ContractionTree.__new__(ContractionTree)
+        out.dims = self.dims
+        out.nodes = [
+            _Node(nd.left, nd.right, nd.parent, nd.legs) for nd in self.nodes
+        ]
+        out.num_leaves = self.num_leaves
+        out.root = self.root
+        return out
+
     # -- queries ------------------------------------------------------------
 
     def _size(self, legs: frozenset[int]) -> float:

@@ -6,3 +6,8 @@ from tnc_tpu.contractionpath.paths.base import (  # noqa: F401
 )
 from tnc_tpu.contractionpath.paths.greedy import Greedy, OptMethod  # noqa: F401
 from tnc_tpu.contractionpath.paths.optimal import Optimal  # noqa: F401
+from tnc_tpu.contractionpath.paths.tree_refine import (  # noqa: F401
+    TreeAnnealing,
+    TreeReconfigure,
+    TreeTempering,
+)
