@@ -1,0 +1,140 @@
+"""Benchmark harness: protocol crash-resume, artifact cache, sweep/run
+end-to-end (reference: ``benchmark/src/{protocol,main,results}.rs``)."""
+
+import json
+
+import pytest
+
+from tnc_tpu.benchmark import (
+    ArtifactCache,
+    METHODS,
+    Protocol,
+    ResultWriter,
+)
+from tnc_tpu.benchmark.driver import Scenario, do_run, do_sweep
+from tnc_tpu.io.qasm import import_qasm
+
+GHZ4 = """
+OPENQASM 2.0;
+include "qelib1.inc";
+qreg q[4];
+h q[0];
+cx q[0], q[1];
+cx q[1], q[2];
+cx q[2], q[3];
+"""
+
+
+def test_protocol_crash_resume(tmp_path):
+    p = tmp_path / "protocol.jsonl"
+    proto = Protocol(p)
+    assert proto.should_run("a")
+    proto.trying("a")
+    proto.done("a")
+    proto.trying("b")  # crashes here — no done record
+
+    # restart: "a" done, "b" converted to error; both skipped
+    proto2 = Protocol(p)
+    assert not proto2.should_run("a")
+    assert not proto2.should_run("b")
+    assert proto2.completed == {"a"}
+    assert proto2.failed == {"b"}
+    assert proto2.should_run("c")
+
+
+def test_artifact_cache_roundtrip(tmp_path):
+    from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+
+    circuit = import_qasm(GHZ4)
+    tn, _ = circuit.into_statevector_network()
+    path = Greedy(OptMethod.GREEDY).find_path(tn).replace_path()
+
+    cache = ArtifactCache(tmp_path / "cache")
+    assert not cache.has("k")
+    cache.store("k", tn, path)
+    assert cache.has("k")
+    tn2, path2 = cache.load("k")
+    assert len(tn2) == len(tn)
+    assert path2.toplevel == path.toplevel
+
+
+@pytest.mark.parametrize("method", ["greedy", "sa-intermediate", "tree-temper"])
+def test_sweep_then_run_end_to_end(tmp_path, method):
+    circuit = import_qasm(GHZ4)
+    tn, _ = circuit.into_statevector_network()
+
+    scenario = Scenario(
+        circuit_name="ghz4",
+        circuit_text=GHZ4,
+        partitions=2,
+        seed=0,
+        method=method,
+    )
+    cache = ArtifactCache(tmp_path / "cache")
+    writer = ResultWriter(tmp_path / "results.jsonl")
+    protocol = Protocol(tmp_path / "protocol.jsonl")
+
+    record = do_sweep(scenario, tn, cache, writer, protocol, time_budget=2.0)
+    assert record is not None
+    assert record.serial_flops > 0
+    assert record.flops > 0
+    assert record.memory > 0
+    assert cache.has(scenario.key())
+
+    # second sweep is skipped by the protocol
+    assert do_sweep(scenario, tn, cache, writer, protocol) is None
+
+    run = do_run(scenario, cache, writer, protocol, backend="numpy")
+    assert run is not None
+    assert run.time_to_solution > 0
+
+    records = writer.read_all()
+    kinds = [r["kind"] for r in records]
+    assert kinds == ["OptimizationResult", "RunResult"]
+
+
+def test_run_requires_cached_artifact(tmp_path):
+    scenario = Scenario("x", "nope", 2, 0, "greedy")
+    cache = ArtifactCache(tmp_path / "cache")
+    writer = ResultWriter(tmp_path / "results.jsonl")
+    protocol = Protocol(tmp_path / "protocol.jsonl")
+    with pytest.raises(FileNotFoundError):
+        do_run(scenario, cache, writer, protocol)
+
+
+def test_cli_scenario_enumeration(tmp_path):
+    from tnc_tpu.benchmark.cli import build_parser, enumerate_scenarios
+
+    (tmp_path / "a.qasm").write_text(GHZ4)
+    (tmp_path / "b.qasm").write_text(GHZ4)
+    args = build_parser().parse_args(
+        [
+            "sweep",
+            "--circuits-dir", str(tmp_path),
+            "--partitions", "2", "4",
+            "--seeds", "0", "1",
+            "--methods", "greedy",
+        ]
+    )
+    scenarios = enumerate_scenarios(args)
+    assert len(scenarios) == 8  # 2 circuits x 2 partitions x 2 seeds
+    ids = [s.run_id for s in scenarios]
+    assert len(set(ids)) == 8
+
+    args2 = build_parser().parse_args(
+        [
+            "sweep", "--circuits-dir", str(tmp_path),
+            "--partitions", "2", "4", "--seeds", "0", "1",
+            "--methods", "greedy", "--include", "0", "3",
+        ]
+    )
+    assert len(enumerate_scenarios(args2)) == 3
+
+
+def test_all_methods_registered():
+    expected = {
+        "greedy", "sa-naive", "sa-naive-intermediate", "sa-leaf",
+        "sa-intermediate", "genetic", "greedy-balance", "tree-anneal",
+        "tree-temper", "hyper",
+    }
+    assert expected == set(METHODS)

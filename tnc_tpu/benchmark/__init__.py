@@ -1,0 +1,25 @@
+"""Research benchmark driver — the reference ``benchmark`` crate rebuilt.
+
+Two phases decoupled across job submissions, exactly as in the reference
+(``benchmark/src/main.rs:195-219,267-353``):
+
+- **Sweep** — run a path/partitioning optimizer on a circuit, record an
+  :class:`~tnc_tpu.benchmark.results.OptimizationResult` (predicted
+  serial/parallel flops, memory, optimization time) and cache the
+  optimized partitioned network + path as a compressed artifact.
+- **Run** — load the cached artifact and contract it (single device or
+  distributed over the mesh), recording ``time_to_solution``.
+
+Crash-resume comes from the :class:`~tnc_tpu.benchmark.protocol.Protocol`
+journal (``Trying``/``Done`` records; stale ``Trying`` entries become
+``Error`` on restart and are skipped — ``benchmark/src/protocol.rs:22-66``).
+"""
+
+from tnc_tpu.benchmark.cache import ArtifactCache  # noqa: F401
+from tnc_tpu.benchmark.methods import METHODS, MethodRun  # noqa: F401
+from tnc_tpu.benchmark.protocol import Protocol  # noqa: F401
+from tnc_tpu.benchmark.results import (  # noqa: F401
+    OptimizationResult,
+    ResultWriter,
+    RunResult,
+)

@@ -1,0 +1,5 @@
+import sys
+
+from tnc_tpu.benchmark.cli import main
+
+sys.exit(main())

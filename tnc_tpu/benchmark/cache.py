@@ -1,0 +1,58 @@
+"""Artifact cache: optimized partitioned network + path, compressed.
+
+Mirror of the reference's bincode+zlib cache
+(``benchmark/src/main.rs:184-187,223-242``): the expensive Sweep phase
+writes its result keyed by ``{scheme}_{circuit_hash}_{seed}_{partitions}_
+{method}``, and the Run phase — possibly a separate job submission on
+different hardware — loads it back without re-optimizing.
+
+Tensor *data* is not stored: leaf tensors carry symbolic
+:class:`TensorData` (gates / file refs), so artifacts stay small and the
+Run phase materializes data on its own device, just as the reference
+scatters metadata and lets ranks materialize.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import pickle
+import zlib
+from pathlib import Path
+
+from tnc_tpu.contractionpath.contraction_path import ContractionPath
+from tnc_tpu.tensornetwork.tensor import CompositeTensor
+
+
+def cache_key(
+    scheme: str, circuit_text: str, seed: int, partitions: int, method: str
+) -> str:
+    digest = hashlib.sha256(circuit_text.encode()).hexdigest()[:16]
+    return f"{scheme}_{digest}_{seed}_{partitions}_{method}"
+
+
+class ArtifactCache:
+    def __init__(self, directory: str | Path):
+        self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+
+    def _path(self, key: str) -> Path:
+        return self.directory / key
+
+    def store(
+        self, key: str, tn: CompositeTensor, path: ContractionPath
+    ) -> None:
+        blob = zlib.compress(pickle.dumps((tn, path)), level=6)
+        target = self._path(key)
+        tmp = target.with_suffix(".tmp")
+        tmp.write_bytes(blob)
+        tmp.replace(target)  # atomic: concurrent runs see all or nothing
+
+    def load(self, key: str) -> tuple[CompositeTensor, ContractionPath] | None:
+        target = self._path(key)
+        if not target.exists():
+            return None
+        tn, path = pickle.loads(zlib.decompress(target.read_bytes()))
+        return tn, path
+
+    def has(self, key: str) -> bool:
+        return self._path(key).exists()

@@ -1,0 +1,184 @@
+"""Sweep / Run phases (``benchmark/src/main.rs:267-353,355-405``)."""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+from pathlib import Path
+
+from tnc_tpu.benchmark.cache import ArtifactCache, cache_key
+from tnc_tpu.benchmark.methods import METHODS, MethodContext
+from tnc_tpu.benchmark.protocol import Protocol
+from tnc_tpu.benchmark.results import (
+    OptimizationResult,
+    ResultWriter,
+    RunResult,
+)
+from tnc_tpu.contractionpath.communication_schemes import CommunicationScheme
+from tnc_tpu.contractionpath.contraction_cost import (
+    communication_path_op_costs,
+    compute_memory_requirements,
+    contract_path_cost,
+    contract_size_tensors_bytes,
+)
+from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+from tnc_tpu.tensornetwork.contraction import contract_tensor_network
+from tnc_tpu.tensornetwork.tensor import CompositeTensor
+
+log = logging.getLogger("tnc_tpu.benchmark")
+
+
+@dataclass
+class Scenario:
+    """One (circuit, partitions, seed, method) cell of a sweep."""
+
+    circuit_name: str
+    circuit_text: str  # QASM source (hashed into the cache key)
+    partitions: int
+    seed: int
+    method: str
+    scheme: str = "greedy"
+
+    @property
+    def run_id(self) -> str:
+        return (
+            f"{self.method}_{self.circuit_name}_p{self.partitions}"
+            f"_s{self.seed}"
+        )
+
+    def key(self) -> str:
+        return cache_key(
+            self.scheme, self.circuit_text, self.seed, self.partitions,
+            self.method,
+        )
+
+
+def _serial_cost(tn: CompositeTensor) -> tuple[float, float]:
+    """Greedy single-device baseline (memoized upstream in the reference,
+    ``main.rs:246-264``)."""
+    result = Greedy(OptMethod.GREEDY).find_path(tn)
+    return result.flops, result.size
+
+
+def do_sweep(
+    scenario: Scenario,
+    tn: CompositeTensor,
+    cache: ArtifactCache,
+    writer: ResultWriter,
+    protocol: Protocol,
+    time_budget: float = 600.0,
+) -> OptimizationResult | None:
+    """Optimize one scenario, cache the artifact, append the record.
+
+    Returns None when the protocol says this cell already ran (or
+    crashed last time) — the crash-resume behavior of the reference.
+    """
+    run_id = "sweep/" + scenario.run_id
+    if not protocol.should_run(run_id):
+        log.info("skipping %s (already done or failed)", run_id)
+        return None
+    protocol.trying(run_id)
+
+    method = METHODS[scenario.method]
+    serial_flops, serial_memory = _serial_cost(tn)
+
+    ctx = MethodContext(
+        tn=tn,
+        partitions=scenario.partitions,
+        seed=scenario.seed,
+        time_budget=time_budget,
+        communication_scheme=CommunicationScheme.GREEDY,
+    )
+    t0 = time.monotonic()
+    out_tn, out_path = method.run(ctx)
+    optimization_time = time.monotonic() - t0
+
+    # characterize: critical-path + sum cost, memory
+    if out_path.nested:
+        latency = {}
+        for i, local in out_path.nested.items():
+            cost, _ = contract_path_cost(out_tn[i].tensors, local, True)
+            latency[i] = cost
+        externals = [child.external_tensor() for child in out_tn.tensors]
+        costs = [latency.get(i, 0.0) for i in range(len(externals))]
+        (flops, flops_sum), _ = communication_path_op_costs(
+            externals, out_path.toplevel, True, costs
+        )
+    else:
+        flops, _ = contract_path_cost(out_tn.tensors, out_path, True)
+        flops_sum = flops
+    memory = compute_memory_requirements(
+        out_tn.tensors, out_path, contract_size_tensors_bytes
+    )
+
+    cache.store(scenario.key(), out_tn, out_path)
+    record = OptimizationResult(
+        id=run_id,
+        method=scenario.method,
+        circuit=scenario.circuit_name,
+        partitions=scenario.partitions,
+        seed=scenario.seed,
+        serial_flops=serial_flops,
+        serial_memory=serial_memory,
+        flops=flops,
+        flops_sum=flops_sum,
+        memory=memory,
+        optimization_time=optimization_time,
+    )
+    writer.write(record)
+    protocol.done(run_id)
+    log.info(
+        "sweep %s: flops %.3g (serial %.3g), %.1fs",
+        run_id, flops, serial_flops, optimization_time,
+    )
+    return record
+
+
+def do_run(
+    scenario: Scenario,
+    cache: ArtifactCache,
+    writer: ResultWriter,
+    protocol: Protocol,
+    backend: str = "jax",
+    distributed: bool = False,
+    repeats: int = 1,
+) -> RunResult | None:
+    """Contract a cached artifact, timing only the contraction (the
+    reference barriers before timing, ``main.rs:365-405``)."""
+    run_id = f"run-{backend}/" + scenario.run_id
+    if not protocol.should_run(run_id):
+        log.info("skipping %s (already done or failed)", run_id)
+        return None
+    loaded = cache.load(scenario.key())
+    if loaded is None:
+        raise FileNotFoundError(
+            f"no cached artifact for {scenario.key()}; run the sweep first"
+        )
+    protocol.trying(run_id)
+    tn, path = loaded
+
+    times = []
+    for _ in range(max(1, repeats)):
+        t0 = time.monotonic()
+        if distributed and path.nested:
+            from tnc_tpu.parallel import distributed_partitioned_contraction
+
+            distributed_partitioned_contraction(tn, path)
+        else:
+            contract_tensor_network(tn, path, backend=backend)
+        times.append(time.monotonic() - t0)
+
+    record = RunResult(
+        id=run_id,
+        method=scenario.method,
+        circuit=scenario.circuit_name,
+        partitions=scenario.partitions,
+        seed=scenario.seed,
+        time_to_solution=min(times),
+        backend=backend,
+    )
+    writer.write(record)
+    protocol.done(run_id)
+    log.info("run %s: %.4fs", run_id, record.time_to_solution)
+    return record

@@ -1,0 +1,55 @@
+"""Structured JSON logging, one file per process.
+
+Mirror of the reference's flexi_logger setup — JSON records, a log file
+discriminated per MPI rank, Info+ duplicated to stderr
+(``benchmark/src/utils.rs:12-24``). Here the discriminant is the jax
+process index (multi-host) or the PID.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+from pathlib import Path
+
+
+class JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        payload = {
+            "ts": self.formatTime(record),
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        if record.exc_info:
+            payload["exc"] = self.formatException(record.exc_info)
+        return json.dumps(payload)
+
+
+def setup_logging(log_dir: str | Path | None = None, level=logging.INFO) -> None:
+    """Configure the ``tnc_tpu`` logger tree: JSON file per process plus
+    human-readable stderr."""
+    root = logging.getLogger("tnc_tpu")
+    root.setLevel(level)
+    root.handlers.clear()
+
+    stream = logging.StreamHandler(sys.stderr)
+    stream.setFormatter(
+        logging.Formatter("[%(asctime)s %(levelname)s %(name)s] %(message)s")
+    )
+    root.addHandler(stream)
+
+    if log_dir is not None:
+        try:
+            import jax
+
+            discriminant = f"proc{jax.process_index()}"
+        except Exception:
+            discriminant = f"pid{os.getpid()}"
+        path = Path(log_dir)
+        path.mkdir(parents=True, exist_ok=True)
+        fh = logging.FileHandler(path / f"benchmark_{discriminant}.jsonl")
+        fh.setFormatter(JsonFormatter())
+        root.addHandler(fh)

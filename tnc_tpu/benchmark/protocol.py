@@ -1,0 +1,66 @@
+"""Crash-resumable experiment journal (``benchmark/src/protocol.rs:22-66``).
+
+Before each unit of work the driver appends ``Trying(id)``; after success
+it appends ``Done(id)``. On restart, ``Trying`` entries without a matching
+``Done`` mean the process died mid-run: they are recorded as ``Error`` and
+skipped, so a crashing configuration cannot wedge a sweep loop.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+
+class Protocol:
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._done: set[str] = set()
+        self._error: set[str] = set()
+        self._load()
+
+    def _load(self) -> None:
+        trying: set[str] = set()
+        if self.path.exists():
+            with open(self.path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    rec = json.loads(line)
+                    state, run_id = rec["state"], rec["id"]
+                    if state == "trying":
+                        trying.add(run_id)
+                    elif state == "done":
+                        trying.discard(run_id)
+                        self._done.add(run_id)
+                    elif state == "error":
+                        self._error.add(run_id)
+        # stale Trying entries -> Error (the run crashed last time)
+        for run_id in sorted(trying):
+            self._error.add(run_id)
+            self._append("error", run_id)
+
+    def _append(self, state: str, run_id: str) -> None:
+        with open(self.path, "a") as f:
+            f.write(json.dumps({"state": state, "id": run_id}) + "\n")
+
+    def should_run(self, run_id: str) -> bool:
+        """False for runs already done or known to crash."""
+        return run_id not in self._done and run_id not in self._error
+
+    def trying(self, run_id: str) -> None:
+        self._append("trying", run_id)
+
+    def done(self, run_id: str) -> None:
+        self._done.add(run_id)
+        self._append("done", run_id)
+
+    @property
+    def completed(self) -> set[str]:
+        return set(self._done)
+
+    @property
+    def failed(self) -> set[str]:
+        return set(self._error)

@@ -1,0 +1,68 @@
+"""Result records, mirroring ``benchmark/src/results.rs:5-26``.
+
+Results are append-only JSON lines so concurrent/restarted sweeps never
+clobber earlier records (the reference appends serde-JSON records the
+same way).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass
+from pathlib import Path
+
+
+@dataclass
+class OptimizationResult:
+    """Per-sweep record (``results.rs:5-16``)."""
+
+    id: str
+    method: str
+    circuit: str
+    partitions: int
+    seed: int
+    serial_flops: float
+    serial_memory: float
+    flops: float  # critical-path (parallel) cost
+    flops_sum: float  # sum cost over all partitions
+    memory: float  # bytes
+    optimization_time: float  # seconds
+
+
+@dataclass
+class RunResult:
+    """Per-run record (``results.rs:19-26``)."""
+
+    id: str
+    method: str
+    circuit: str
+    partitions: int
+    seed: int
+    time_to_solution: float  # seconds, contraction only
+    backend: str = "jax"
+
+
+class ResultWriter:
+    """Append-only JSON-lines writer."""
+
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+
+    def write(self, record: OptimizationResult | RunResult) -> None:
+        payload = dataclasses.asdict(record)
+        payload["kind"] = type(record).__name__
+        with open(self.path, "a") as f:
+            f.write(json.dumps(payload) + "\n")
+
+    def read_all(self) -> list[dict]:
+        if not self.path.exists():
+            return []
+        out = []
+        with open(self.path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    out.append(json.loads(line))
+        return out

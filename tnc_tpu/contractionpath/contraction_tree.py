@@ -193,13 +193,17 @@ class ContractionTree:
         return frontier
 
     def _optimal_order(
-        self, leg_sets: list[frozenset[int]]
+        self, leg_sets: list[frozenset[int]], minimize: str = "flops"
     ) -> tuple[float, list[tuple[int, int]]] | None:
-        """Subset-DP optimal pairwise order over ``leg_sets``;
-        returns (flops, local ssa pairs) or None if too large."""
+        """Subset-DP optimal pairwise order over ``leg_sets``; returns
+        (cost, local ssa pairs) or None if too large. ``minimize`` is
+        ``"flops"`` (sum of naive op counts) or ``"size"`` (max
+        intermediate tensor size — a max-objective composes over splits
+        just like a sum does)."""
         n = len(leg_sets)
         if n > 12:
             return None
+        by_size = minimize == "size"
         full = (1 << n) - 1
         legs_of: dict[int, frozenset[int]] = {}
         best: dict[int, tuple[float, int]] = {}
@@ -222,12 +226,16 @@ class ContractionTree:
                         if hi:
                             c_lo, _ = best[sub]
                             c_hi, _ = best[hi]
-                            union = legs_of[sub] | legs_of[hi]
-                            cost = c_lo + c_hi + self._size(union)
+                            out = legs_of[sub] ^ legs_of[hi]
+                            if by_size:
+                                cost = max(c_lo, c_hi, self._size(out))
+                            else:
+                                union = legs_of[sub] | legs_of[hi]
+                                cost = c_lo + c_hi + self._size(union)
                             if cost < best_cost:
                                 best_cost = cost
                                 best_split = sub
-                                best_legs = legs_of[sub] ^ legs_of[hi]
+                                best_legs = out
                     sub = (sub - 1) & mask
                 assert best_legs is not None
                 best[mask] = (best_cost, best_split)
@@ -251,9 +259,12 @@ class ContractionTree:
         build(full)
         return best[full][0], pairs
 
-    def _subtree_cost(self, top: int, frontier: set[int]) -> float:
+    def _subtree_cost(
+        self, top: int, frontier: set[int], minimize: str = "flops"
+    ) -> float:
         """Cost of the internal nodes of ``top``'s subtree down to
-        ``frontier``."""
+        ``frontier`` (sum of flops, or max intermediate size)."""
+        by_size = minimize == "size"
         cost = 0.0
         stack = [top]
         while stack:
@@ -261,7 +272,10 @@ class ContractionTree:
             if i in frontier:
                 continue
             nd = self.nodes[i]
-            cost += self.node_cost(i)
+            if by_size:
+                cost = max(cost, self._size(nd.legs))
+            else:
+                cost += self.node_cost(i)
             stack.append(nd.left)
             stack.append(nd.right)
         return cost
@@ -331,11 +345,13 @@ class ContractionTree:
                 frontier = self._collect_frontier(top, subtree_size)
                 if len(frontier) < 3:
                     continue
-                result = self._optimal_order([self.nodes[f].legs for f in frontier])
+                result = self._optimal_order(
+                    [self.nodes[f].legs for f in frontier], minimize
+                )
                 if result is None:
                     continue
                 new_cost, pairs = result
-                old_cost = self._subtree_cost(top, set(frontier))
+                old_cost = self._subtree_cost(top, set(frontier), minimize)
                 if new_cost < old_cost * (1 - 1e-12):
                     self._splice(top, frontier, pairs)
                     improved = True

@@ -45,6 +45,23 @@ def _initial_tree(inputs: Sequence[LeafTensor]) -> ContractionTree:
     return ContractionTree.from_ssa_path(inputs, ssa)
 
 
+def _check_minimize(minimize: str) -> str:
+    if minimize not in ("flops", "size"):
+        raise ValueError("minimize must be 'flops' or 'size'")
+    return minimize
+
+
+def _tree_objective(tree: ContractionTree, minimize: str) -> float:
+    """Global objective matching the SA accept rule: total flops, or the
+    largest intermediate tensor size."""
+    if minimize == "size":
+        return max(
+            (tree._size(nd.legs) for nd in tree.nodes if not nd.is_leaf),
+            default=0.0,
+        )
+    return tree.total_cost()[0]
+
+
 def _local_cost(tree: ContractionTree, i: int, minimize: str) -> float:
     nd = tree.nodes[i]
     if nd.is_leaf:
@@ -147,7 +164,7 @@ class TreeAnnealing(Pathfinder):
         self.iterations = iterations
         self.t_start = t_start
         self.t_end = t_end
-        self.minimize = minimize
+        self.minimize = _check_minimize(minimize)
         self.seed = seed
 
     def _solve_toplevel(self, inputs: list) -> list[tuple[int, int]]:
@@ -156,7 +173,7 @@ class TreeAnnealing(Pathfinder):
         rng = random.Random(self.seed)
         tree = _initial_tree(inputs)
         best = tree.copy()
-        best_cost = tree.total_cost()[0]
+        best_cost = _tree_objective(tree, self.minimize)
         steps = max(64, self.iterations * len(inputs))
         chunks = 8
         for _ in range(chunks):
@@ -164,7 +181,7 @@ class TreeAnnealing(Pathfinder):
                 tree, rng, steps // chunks, self.t_start, self.t_end,
                 self.minimize,
             )
-            cost = tree.total_cost()[0]
+            cost = _tree_objective(tree, self.minimize)
             if cost < best_cost:
                 best_cost = cost
                 best = tree.copy()
@@ -180,12 +197,11 @@ class TreeReconfigure(Pathfinder):
         subtree_size: int = 8,
         max_rounds: int = 4,
         minimize: str = "flops",
-        seed: int = DEFAULT_SEED,
     ):
+        # no seed: reconfiguration is fully deterministic (exact DP walk)
         self.subtree_size = subtree_size
         self.max_rounds = max_rounds
-        self.minimize = minimize
-        self.seed = seed
+        self.minimize = _check_minimize(minimize)
 
     def _solve_toplevel(self, inputs: list) -> list[tuple[int, int]]:
         if len(inputs) <= 1:
@@ -219,7 +235,7 @@ class TreeTempering(Pathfinder):
         self.steps_per_round = steps_per_round
         self.t_min = t_min
         self.t_max = t_max
-        self.minimize = minimize
+        self.minimize = _check_minimize(minimize)
         self.seed = seed
 
     def _solve_toplevel(self, inputs: list) -> list[tuple[int, int]]:
@@ -235,7 +251,7 @@ class TreeTempering(Pathfinder):
         steps = self.steps_per_round or max(32, 10 * len(inputs))
 
         best = replicas[0].copy()
-        best_cost = best.total_cost()[0]
+        best_cost = _tree_objective(best, self.minimize)
         for _ in range(self.rounds):
             costs = []
             for i in range(r):
@@ -243,7 +259,7 @@ class TreeTempering(Pathfinder):
                 _anneal(
                     replicas[i], rng, steps, temps[i], temps[i], self.minimize
                 )
-                cost = replicas[i].total_cost()[0]
+                cost = _tree_objective(replicas[i], self.minimize)
                 costs.append(cost)
                 if cost < best_cost:
                     best_cost = cost
