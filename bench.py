@@ -44,7 +44,7 @@ def main() -> None:
 
     from tnc_tpu.builders.sycamore_circuit import sycamore_circuit
     from tnc_tpu.contractionpath.paths.hyper import Hyperoptimizer
-    from tnc_tpu.contractionpath.slicing import find_slicing, sliced_flops
+    from tnc_tpu.contractionpath.slicing import sliced_flops
     from tnc_tpu.ops.backends import JaxBackend
     from tnc_tpu.ops.program import flat_leaf_tensors
     from tnc_tpu.ops.sliced import build_sliced_program
@@ -66,9 +66,14 @@ def main() -> None:
     )
 
     # -- plan (excluded from timing, like the reference's Sweep phase) ------
+    from tnc_tpu.contractionpath.contraction_path import ContractionPath
+    from tnc_tpu.contractionpath.slicing import slice_and_reconfigure
+
+    target = 2.0**target_log2
     t0 = time.monotonic()
-    result = Hyperoptimizer(ntrials=ntrials, seed=seed).find_path(tn)
-    replace = result.replace_path()
+    result = Hyperoptimizer(
+        ntrials=ntrials, seed=seed, target_size=target
+    ).find_path(tn)
     plan_s = time.monotonic() - t0
     log(
         f"[bench] path: flops={result.flops:.3e} "
@@ -76,11 +81,16 @@ def main() -> None:
     )
 
     inputs = list(tn.tensors)
-    slicing = find_slicing(inputs, replace.toplevel, 2.0**target_log2)
+    t0 = time.monotonic()
+    replace_pairs, slicing = slice_and_reconfigure(
+        inputs, result.ssa_path.toplevel, target
+    )
+    replace = ContractionPath.simple(replace_pairs)
     total_flops = sliced_flops(inputs, replace.toplevel, slicing)
     log(
         f"[bench] slicing: {len(slicing.legs)} legs, {slicing.num_slices} slices, "
-        f"total flops {total_flops:.3e}"
+        f"total flops {total_flops:.3e} "
+        f"(slice+reconfigure in {time.monotonic() - t0:.1f}s)"
     )
 
     sp = build_sliced_program(tn, replace, slicing)

@@ -158,3 +158,118 @@ def test_sa_models_reject_single_partition():
         NaivePartitioningModel(tn, 1)
     with pytest.raises(ValueError):
         NaiveIntermediatePartitioningModel(tn, 1)
+
+
+def test_slice_and_reconfigure_meets_target_and_matches():
+    """slice_and_reconfigure hits the peak target and the (path, slicing)
+    it returns contracts to the same value as the unsliced network."""
+    from tnc_tpu.contractionpath.contraction_path import ContractionPath
+    from tnc_tpu.contractionpath.slicing import (
+        _replay_sizes,
+        slice_and_reconfigure,
+    )
+
+    tn = _sycamore_network(qubits=18, depth=8, seed=3)
+    res = Greedy(OptMethod.GREEDY).find_path(tn)
+    inputs = list(tn.tensors)
+    peak0, _ = _replay_sizes(inputs, res.replace_path().toplevel, set())
+    assert peak0 > 4096
+    target = peak0 / 16
+    replace_pairs, slicing = slice_and_reconfigure(
+        inputs,
+        res.ssa_path.toplevel,
+        target,
+        step_budget=1.0,
+        final_budget=2.0,
+    )
+    assert slicing.num_slices > 1
+    peak, _ = _replay_sizes(inputs, replace_pairs, set(slicing.legs))
+    assert peak <= target
+
+    rp = ContractionPath.simple(replace_pairs)
+    want = complex(
+        contract_tensor_network(tn, res.replace_path()).data.into_data()
+    )
+    got = complex(
+        contract_tensor_network_sliced(tn, rp, slicing).data.into_data()
+    )
+    assert got == pytest.approx(want, rel=1e-8, abs=1e-14)
+
+
+def test_native_treedp_matches_python_dp():
+    """The C++ subset-DP and the pure-Python DP agree on cost for random
+    small networks, for both objectives."""
+    import os
+    import random
+
+    import tnc_tpu.partitioning.native_binding as nb
+    from tnc_tpu.partitioning.native_binding import native_optimal_order
+
+    if nb.load_native() is None or not hasattr(
+        nb.load_native(), "tnc_optimal_order"
+    ):
+        pytest.skip("native library unavailable")
+
+    rng = random.Random(7)
+    for _ in range(60):
+        n = rng.randint(3, 8)
+        nlegs = rng.randint(n, 3 * n)
+        dims = {l: rng.choice([2, 2, 3, 4]) for l in range(nlegs)}
+        leg_sets = [set() for _ in range(n)]
+        for l in range(nlegs):
+            for o in rng.sample(range(n), rng.choice([1, 2])):
+                leg_sets[o].add(l)
+        sets = [frozenset(s) for s in leg_sets]
+        if any(not s for s in sets):
+            continue
+        tree = ContractionTree.__new__(ContractionTree)
+        tree.dims = dims
+        for minimize in ("flops", "size"):
+            nat = native_optimal_order(sets, dims, minimize)
+            assert nat is not None
+            os.environ["TNC_TPU_NO_NATIVE"] = "1"
+            nb._lib, nb._load_failed = None, False
+            try:
+                py = tree._optimal_order(list(sets), minimize)
+            finally:
+                del os.environ["TNC_TPU_NO_NATIVE"]
+                nb._lib, nb._load_failed = None, False
+            assert py is not None
+            assert nat[0] == pytest.approx(py[0], rel=1e-9)
+            # the native pair list must be a valid local SSA ordering
+            seen = set(range(len(sets)))
+            nxt = len(sets)
+            for a, b in nat[1]:
+                assert a in seen and b in seen and a != b
+                seen.discard(a)
+                seen.discard(b)
+                seen.add(nxt)
+                nxt += 1
+
+
+def test_native_treedp_size_cap():
+    """With a logsize cap the DP never forms an intermediate above the
+    cap, and returns None when the cap is unsatisfiable."""
+    import math as _math
+
+    import tnc_tpu.partitioning.native_binding as nb
+    from tnc_tpu.partitioning.native_binding import native_optimal_order
+
+    lib = nb.load_native()
+    if lib is None or not hasattr(lib, "tnc_optimal_order"):
+        pytest.skip("native library unavailable")
+
+    # chain a-b-c-d with bond dim 4: optimal order has intermediates of
+    # size 16; capping at log2(16) is satisfiable, log2(4) is not
+    # (every pairwise intermediate has >= 2 legs of dim 4).
+    dims = {0: 4, 1: 4, 2: 4, 3: 4, 4: 4}
+    sets = [
+        frozenset({0, 1}),
+        frozenset({1, 2}),
+        frozenset({2, 3}),
+        frozenset({3, 4}),
+    ]
+    ok = native_optimal_order(sets, dims, "flops", logsize_cap=4.0)
+    assert ok is not None
+    none = native_optimal_order(sets, dims, "flops", logsize_cap=_math.log2(4))
+    assert none is not None and _math.isinf(none[0])

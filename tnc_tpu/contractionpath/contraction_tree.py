@@ -23,6 +23,13 @@ from typing import Sequence
 from tnc_tpu.tensornetwork.tensor import LeafTensor
 
 
+def _has_native_dp() -> bool:
+    from tnc_tpu.partitioning.native_binding import load_native
+
+    lib = load_native()
+    return lib is not None and hasattr(lib, "tnc_optimal_order")
+
+
 @dataclass
 class _Node:
     left: int = -1
@@ -193,32 +200,55 @@ class ContractionTree:
         return frontier
 
     def _optimal_order(
-        self, leg_sets: list[frozenset[int]], minimize: str = "flops"
+        self,
+        leg_sets: list[frozenset[int]],
+        minimize: str = "flops",
+        logsize_cap: float = -1.0,
     ) -> tuple[float, list[tuple[int, int]]] | None:
         """Subset-DP optimal pairwise order over ``leg_sets``; returns
-        (cost, local ssa pairs) or None if too large. ``minimize`` is
-        ``"flops"`` (sum of naive op counts) or ``"size"`` (max
-        intermediate tensor size — a max-objective composes over splits
-        just like a sum does)."""
+        (cost, local ssa pairs) or None if too large / no order satisfies
+        ``logsize_cap``. ``minimize`` is ``"flops"`` (sum of naive op
+        counts) or ``"size"`` (max intermediate tensor size — a
+        max-objective composes over splits just like a sum does). When
+        ``logsize_cap`` >= 0, intermediates larger than ``2**logsize_cap``
+        elements are forbidden (slice-aware refinement). Dispatches to the
+        native C++ kernel when available."""
         n = len(leg_sets)
+        if n >= 5:
+            from tnc_tpu.partitioning.native_binding import native_optimal_order
+
+            native = native_optimal_order(
+                leg_sets, self.dims, minimize, logsize_cap
+            )
+            if native is not None:
+                if math.isinf(native[0]):
+                    return None  # proven infeasible under the cap
+                return native
         if n > 12:
             return None
         by_size = minimize == "size"
+        cap_size = math.inf if logsize_cap < 0 else 2.0**logsize_cap
         full = (1 << n) - 1
-        legs_of: dict[int, frozenset[int]] = {}
+        # Result legs of any subset are the XOR of its members' legs (a leg
+        # joins at most two tensors) — split-independent, precompute.
+        legs_of: dict[int, frozenset[int]] = {0: frozenset()}
+        for mask in range(1, full + 1):
+            low = mask & (-mask)
+            legs_of[mask] = legs_of[mask ^ low] ^ leg_sets[low.bit_length() - 1]
         best: dict[int, tuple[float, int]] = {}
         for i in range(n):
-            legs_of[1 << i] = leg_sets[i]
             best[1 << i] = (0.0, 0)
         order = [[] for _ in range(n + 1)]
         for mask in range(1, full + 1):
             order[mask.bit_count()].append(mask)
         for count in range(2, n + 1):
             for mask in order[count]:
+                if mask != full and self._size(legs_of[mask]) > cap_size:
+                    best[mask] = (math.inf, 0)
+                    continue
                 lowest = mask & (-mask)
                 best_cost = math.inf
                 best_split = 0
-                best_legs: frozenset[int] | None = None
                 sub = (mask - 1) & mask
                 while sub:
                     if sub & lowest:
@@ -226,20 +256,21 @@ class ContractionTree:
                         if hi:
                             c_lo, _ = best[sub]
                             c_hi, _ = best[hi]
-                            out = legs_of[sub] ^ legs_of[hi]
-                            if by_size:
-                                cost = max(c_lo, c_hi, self._size(out))
-                            else:
-                                union = legs_of[sub] | legs_of[hi]
-                                cost = c_lo + c_hi + self._size(union)
-                            if cost < best_cost:
-                                best_cost = cost
-                                best_split = sub
-                                best_legs = out
+                            if not (c_lo == math.inf or c_hi == math.inf):
+                                if by_size:
+                                    cost = max(
+                                        c_lo, c_hi, self._size(legs_of[mask])
+                                    )
+                                else:
+                                    union = legs_of[sub] | legs_of[hi]
+                                    cost = c_lo + c_hi + self._size(union)
+                                if cost < best_cost:
+                                    best_cost = cost
+                                    best_split = sub
                     sub = (sub - 1) & mask
-                assert best_legs is not None
                 best[mask] = (best_cost, best_split)
-                legs_of[mask] = best_legs
+        if best[full][0] == math.inf:
+            return None
 
         pairs: list[tuple[int, int]] = []
         next_local = n
@@ -316,6 +347,7 @@ class ContractionTree:
         max_rounds: int = 4,
         minimize: str = "flops",
         time_budget: float | None = None,
+        logsize_cap: float = -1.0,
     ) -> None:
         """Iterative subtree reconfiguration, in place.
 
@@ -337,7 +369,13 @@ class ContractionTree:
                 if not nd.is_leaf and self._reachable(i)
             ]
             internal.sort(key=self.node_cost, reverse=True)
-            for top in internal[: max(16, len(internal) // 4)]:
+            # With the native DP each subtree solve is sub-millisecond, so
+            # every round can afford to visit every internal node; the
+            # pure-Python DP is ~1000x slower, so cap its per-round work
+            # as before.
+            if not _has_native_dp():
+                internal = internal[: max(16, len(internal) // 4)]
+            for top in internal:
                 if deadline is not None and time.monotonic() > deadline:
                     return
                 if not self._reachable(top):
@@ -346,7 +384,7 @@ class ContractionTree:
                 if len(frontier) < 3:
                     continue
                 result = self._optimal_order(
-                    [self.nodes[f].legs for f in frontier], minimize
+                    [self.nodes[f].legs for f in frontier], minimize, logsize_cap
                 )
                 if result is None:
                     continue

@@ -44,10 +44,17 @@ class Hyperoptimizer(Pathfinder):
         cutoff: int = 12,
         imbalance_range: tuple[float, float] = (0.02, 0.40),
         minimize: str = "flops",
-        reconfigure_size: int = 10,
+        reconfigure_size: int = 12,
         reconfigure_rounds: int = 6,
         reconfigure_budget: float | None = 60.0,
+        reconfigure_top: int = 4,
+        target_size: float | None = None,
     ) -> None:
+        """``target_size``: when set, the final candidate selection is
+        slicing-aware — candidates are scored by their *total sliced
+        flops* after greedy slicing to ``target_size`` peak elements,
+        not by raw flops (a slightly worse raw path that slices well is
+        the better plan on HBM-bound networks)."""
         if minimize not in ("flops", "size"):
             raise ValueError("minimize must be 'flops' or 'size'")
         self.ntrials = ntrials
@@ -58,6 +65,8 @@ class Hyperoptimizer(Pathfinder):
         self.reconfigure_size = reconfigure_size
         self.reconfigure_rounds = reconfigure_rounds
         self.reconfigure_budget = reconfigure_budget
+        self.reconfigure_top = reconfigure_top
+        self.target_size = target_size
 
     def _solve_toplevel(self, inputs: list[LeafTensor]) -> list[tuple[int, int]]:
         n = len(inputs)
@@ -99,29 +108,68 @@ class Hyperoptimizer(Pathfinder):
             )
             return flops if self.minimize == "flops" else size
 
-        best_path: list[tuple[int, int]] | None = None
-        best_key = math.inf
-        for candidate in candidates:
-            key = evaluate(candidate)
-            if key < best_key:
-                best_key = key
-                best_path = candidate
-        assert best_path is not None
+        def sliced_score(candidate: list[tuple[int, int]]) -> float:
+            """Total flops after slicing to the HBM target *with repair*:
+            a light slice-and-reconfigure pass. Plain greedy slicing
+            without repair wildly misranks low-flops candidates (their
+            naive slicing overhead is enormous, but reconfiguration
+            recovers most of it)."""
+            from tnc_tpu.contractionpath.slicing import (
+                slice_and_reconfigure,
+                sliced_flops,
+            )
 
-        # Refine the winner by exact-DP subtree reconfiguration
-        # (the reference's TreeReconfigure capability, natively).
+            assert self.target_size is not None
+            try:
+                replace, slicing = slice_and_reconfigure(
+                    inputs,
+                    candidate,
+                    self.target_size,
+                    reconf_rounds=1,
+                    step_budget=2.0,
+                    final_rounds=2,
+                    final_budget=10.0,
+                )
+            except ValueError:
+                return math.inf
+            return sliced_flops(inputs, replace, slicing)
+
+        ranked = sorted(candidates, key=evaluate)
+
+        # Refine the best few candidates by exact-DP subtree
+        # reconfiguration (the reference's TreeReconfigure capability,
+        # natively): different bisection trees settle into different
+        # local minima, so refining several beats refining one.
+        finalists = ranked[: max(1, self.reconfigure_top)]
         if self.reconfigure_rounds > 0:
             from tnc_tpu.contractionpath.contraction_tree import ContractionTree
 
-            tree = ContractionTree.from_ssa_path(inputs, best_path)
-            tree.reconfigure(
-                self.reconfigure_size,
-                self.reconfigure_rounds,
-                time_budget=self.reconfigure_budget,
-            )
-            refined = tree.to_ssa_path()
-            if evaluate(refined) < best_key:
-                best_path = refined
+            refined: list[list[tuple[int, int]]] = []
+            for candidate in finalists:
+                tree = ContractionTree.from_ssa_path(inputs, candidate)
+                tree.reconfigure(
+                    self.reconfigure_size,
+                    self.reconfigure_rounds,
+                    minimize=self.minimize,
+                    time_budget=self.reconfigure_budget,
+                )
+                refined.append(tree.to_ssa_path())
+            # The refined trees dominate their raw versions on both raw
+            # and sliced scores; keep the best raw candidate as a guard.
+            finalists = refined + [ranked[0]]
+
+        # Dedup (reconfigure often leaves a good tree unchanged) so the
+        # expensive sliced_score never runs twice on the same path.
+        seen: set[tuple] = set()
+        unique = []
+        for candidate in finalists:
+            key = tuple(candidate)
+            if key not in seen:
+                seen.add(key)
+                unique.append(candidate)
+
+        score = sliced_score if self.target_size is not None else evaluate
+        best_path = min(unique, key=score)
         return best_path
 
     def _bisection_path(

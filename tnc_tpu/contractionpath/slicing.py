@@ -136,19 +136,10 @@ def sliced_flops(
     slicing: Slicing,
 ) -> float:
     """Total naive op cost across all slices."""
-    removed = set(slicing.legs)
-    tensors = [
-        LeafTensor(
-            [l for l in t.legs if l not in removed],
-            [d for l, d in t.edges() if l not in removed],
-        )
-        for t in inputs
-    ]
-    per_slice = 0.0
-    for i, j in replace_path:
-        per_slice += (tensors[i] | tensors[j]).size()
-        tensors[i] = tensors[i] ^ tensors[j]
-    return per_slice * slicing.num_slices
+    return (
+        _reduced_flops(inputs, replace_path, set(slicing.legs))
+        * slicing.num_slices
+    )
 
 
 def flat_replace_path(path_: ContractionPath) -> list[tuple[int, int]]:
@@ -156,3 +147,147 @@ def flat_replace_path(path_: ContractionPath) -> list[tuple[int, int]]:
     if path_.nested:
         raise ValueError("Slicing expects a flat (non-nested) path")
     return list(path_.toplevel)
+
+
+def slice_and_reconfigure(
+    inputs: Sequence[LeafTensor],
+    ssa_path: Sequence[tuple[int, int]],
+    target_size: float,
+    subtree_size: int = 12,
+    reconf_rounds: int = 1,
+    final_rounds: int = 8,
+    step_budget: float = 4.0,
+    final_budget: float = 45.0,
+    max_slices: int = 1 << 26,
+    max_leg_candidates: int = 48,
+) -> tuple[list[tuple[int, int]], Slicing]:
+    """Interleaved slicing + subtree reconfiguration (cotengra's
+    ``slicing_reconf`` approach): repeatedly slice a leg of the peak
+    step, then repair the flops overhead by re-solving subtrees *in the
+    sliced size model* (sliced legs have dim 1).
+
+    Leg selection replays the path once per candidate leg of the peak
+    step and picks the (post-slice peak, post-slice flops) minimum —
+    pure step-size heuristics pick legs that shrink one wide step while
+    a plateau of equally wide steps with different legs survives.
+
+    The repair passes run *uncapped*: flops minimization in the reduced
+    model naturally deflates wide intermediates (they dominate the op
+    count), while a hard size cap would forbid the DP from touching
+    exactly the near-peak subtrees it must repair. The outer loop keeps
+    slicing until the genuine replayed peak meets the target, and the
+    final deep pass is accepted only if it preserves that bound.
+
+    Returns (replace_path, slicing); the path is valid for the unsliced
+    network (slicing only pins index values, it never reorders legs).
+    """
+    from tnc_tpu.contractionpath.contraction_path import (
+        ContractionPath,
+        ssa_replace_ordering,
+    )
+    from tnc_tpu.contractionpath.contraction_tree import ContractionTree
+
+    tree = ContractionTree.from_ssa_path(inputs, list(ssa_path))
+    tree.dims = dict(tree.dims)  # private copy: sliced legs become dim 1
+
+    open_legs: set[int] = set()
+    for t in inputs:
+        for leg in t.legs:
+            open_legs.symmetric_difference_update((leg,))
+
+    dims: dict[int, int] = {}
+    for t in inputs:
+        for leg, dim in t.edges():
+            dims[leg] = dim
+
+    removed: set[int] = set()
+    num_slices = 1
+    while True:
+        replace = ssa_replace_ordering(
+            ContractionPath.simple(tree.to_ssa_path())
+        ).toplevel
+        peak, leg_peak = _replay_sizes(inputs, replace, removed)
+        if peak <= target_size:
+            break
+        candidates = [
+            leg
+            for leg, size in leg_peak.items()
+            if size >= peak * 0.99
+            and leg not in removed
+            and leg not in open_legs
+            and dims[leg] > 1
+        ]
+        if not candidates:
+            # no sliceable leg in the peak step: fall back to any leg
+            candidates = [
+                leg
+                for leg in leg_peak
+                if leg not in removed and leg not in open_legs and dims[leg] > 1
+            ]
+        if not candidates:
+            raise ValueError(
+                f"No sliceable legs left but peak {peak:.3e} > "
+                f"target {target_size:.3e}"
+            )
+        best_leg = -1
+        best_key: tuple[float, float] | None = None
+        for leg in candidates[:max_leg_candidates]:
+            trial = removed | {leg}
+            trial_peak, _ = _replay_sizes(inputs, replace, trial)
+            key = (trial_peak, _reduced_flops(inputs, replace, trial))
+            if best_key is None or key < best_key:
+                best_key = key
+                best_leg = leg
+        leg = best_leg
+        removed.add(leg)
+        num_slices *= dims[leg]
+        if num_slices > max_slices:
+            raise ValueError(
+                f"Slicing needs more than {max_slices} slices to reach "
+                f"target {target_size:.3e}"
+            )
+        tree.dims[leg] = 1
+        if reconf_rounds > 0:
+            tree.reconfigure(
+                subtree_size, reconf_rounds, time_budget=step_budget
+            )
+
+    if final_rounds > 0 and removed:
+        # Deep repair on a copy; keep it only if the peak bound survives.
+        refined = tree.copy()
+        refined.reconfigure(subtree_size, final_rounds, time_budget=final_budget)
+        refined_replace = ssa_replace_ordering(
+            ContractionPath.simple(refined.to_ssa_path())
+        ).toplevel
+        refined_peak, _ = _replay_sizes(inputs, refined_replace, removed)
+        if refined_peak <= target_size:
+            tree = refined
+
+    replace = ssa_replace_ordering(
+        ContractionPath.simple(tree.to_ssa_path())
+    ).toplevel
+    ordered = sorted(removed)
+    return list(replace), Slicing(
+        tuple(ordered), tuple(dims[l] for l in ordered)
+    )
+
+
+def _reduced_flops(
+    inputs: Sequence[LeafTensor],
+    replace_path: Sequence[tuple[int, int]],
+    removed: set[int],
+) -> float:
+    """Per-slice naive op cost of a replace path with ``removed`` legs
+    pinned (helper for slice-leg scoring)."""
+    tensors = [
+        LeafTensor(
+            [l for l in t.legs if l not in removed],
+            [d for l, d in t.edges() if l not in removed],
+        )
+        for t in inputs
+    ]
+    total = 0.0
+    for i, j in replace_path:
+        total += (tensors[i] | tensors[j]).size()
+        tensors[i] = tensors[i] ^ tensors[j]
+    return total

@@ -13,6 +13,7 @@ Set ``TNC_TPU_NO_NATIVE=1`` to force the Python path.
 from __future__ import annotations
 
 import ctypes
+import math
 import os
 import subprocess
 import sys
@@ -22,7 +23,8 @@ from pathlib import Path
 from tnc_tpu.partitioning.hypergraph import Hypergraph
 
 _NATIVE_DIR = Path(__file__).parent / "native"
-_SRC = _NATIVE_DIR / "partitioner.cpp"
+_SOURCES = [_NATIVE_DIR / "partitioner.cpp", _NATIVE_DIR / "treedp.cpp"]
+_SRC = _SOURCES[0]  # kept for back-compat with external callers
 _LIB_PATH = _NATIVE_DIR / "_partitioner.so"
 
 _lib: ctypes.CDLL | None = None
@@ -43,7 +45,7 @@ def _build_library() -> bool:
         "-std=c++17",
         "-shared",
         "-fPIC",
-        str(_SRC),
+        *[str(s) for s in _SOURCES if s.exists()],
         "-o",
         tmp,
     ]
@@ -79,10 +81,10 @@ def load_native() -> ctypes.CDLL | None:
     if _lib is not None:
         return _lib
     try:
-        if _SRC.exists():
-            stale = (
-                not _LIB_PATH.exists()
-                or _LIB_PATH.stat().st_mtime < _SRC.stat().st_mtime
+        sources = [s for s in _SOURCES if s.exists()]
+        if sources:
+            stale = not _LIB_PATH.exists() or _LIB_PATH.stat().st_mtime < max(
+                s.stat().st_mtime for s in sources
             )
         else:
             # source stripped from the install: use a prebuilt .so as-is
@@ -113,6 +115,18 @@ def load_native() -> ctypes.CDLL | None:
             ctypes.POINTER(ctypes.c_double),
             ctypes.POINTER(ctypes.c_int),
         ]
+        if hasattr(lib, "tnc_optimal_order"):
+            lib.tnc_optimal_order.restype = ctypes.c_int
+            lib.tnc_optimal_order.argtypes = [
+                ctypes.c_int,
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.c_int,
+                ctypes.c_double,
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.POINTER(ctypes.c_int),
+            ]
         _lib = lib
         return _lib
     except OSError:
@@ -171,3 +185,58 @@ def native_partition_kway(
         out = np.empty(n, dtype=np.int32)
     assert best is not None
     return best.tolist()
+
+
+def native_optimal_order(
+    leg_sets: "list[frozenset[int]]",
+    dims: "dict[int, int]",
+    minimize: str = "flops",
+    logsize_cap: float = -1.0,
+) -> tuple[float, list[tuple[int, int]]] | None:
+    """Exact subset-DP ordering over ``leg_sets`` via the C++ kernel.
+
+    Native engine of ``ContractionTree.reconfigure``; returns
+    (cost, local ssa pairs) like the Python ``_optimal_order``;
+    ``(inf, [])`` when the DP *proved* no ordering satisfies
+    ``logsize_cap`` (callers must not fall back to the Python DP — it
+    would only reproduce the proof slowly); None when native is
+    unavailable or n is out of range.
+    """
+    import numpy as np
+
+    lib = load_native()
+    n = len(leg_sets)
+    if lib is None or not hasattr(lib, "tnc_optimal_order") or not 2 <= n <= 20:
+        return None
+    all_legs = sorted(set().union(*leg_sets))
+    index = {leg: i for i, leg in enumerate(all_legs)}
+    nlegs = len(all_legs)
+    nwords = max(1, (nlegs + 63) // 64)
+    masks = np.zeros((n, nwords), dtype=np.uint64)
+    for i, legs in enumerate(leg_sets):
+        for leg in legs:
+            j = index[leg]
+            masks[i, j // 64] |= np.uint64(1 << (j % 64))
+    logdims = np.array(
+        [math.log2(max(1, dims[leg])) for leg in all_legs], dtype=np.float64
+    )
+    out_cost = ctypes.c_double(0.0)
+    out_pairs = np.empty(2 * (n - 1), dtype=np.int32)
+    rc = lib.tnc_optimal_order(
+        n,
+        nlegs,
+        masks.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        logdims.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        0 if minimize == "flops" else 1,
+        ctypes.c_double(logsize_cap),
+        ctypes.byref(out_cost),
+        out_pairs.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+    )
+    if rc == 1:
+        return math.inf, []
+    if rc != 0:
+        return None
+    pairs = [
+        (int(out_pairs[2 * k]), int(out_pairs[2 * k + 1])) for k in range(n - 1)
+    ]
+    return float(out_cost.value), pairs
