@@ -1,5 +1,6 @@
 from tnc_tpu.contractionpath.contraction_path import (  # noqa: F401
     ContractionPath,
+    SimplePath,
     path,
     ssa_ordering,
     ssa_replace_ordering,

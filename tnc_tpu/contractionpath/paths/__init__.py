@@ -4,7 +4,12 @@ from tnc_tpu.contractionpath.paths.base import (  # noqa: F401
     CostType,
     Pathfinder,
 )
+from tnc_tpu.contractionpath.paths.branchbound import (  # noqa: F401
+    BranchBound,
+    WeightedBranchBound,
+)
 from tnc_tpu.contractionpath.paths.greedy import Greedy, OptMethod  # noqa: F401
+from tnc_tpu.contractionpath.paths.hyper import Hyperoptimizer  # noqa: F401
 from tnc_tpu.contractionpath.paths.optimal import Optimal  # noqa: F401
 from tnc_tpu.contractionpath.paths.tree_refine import (  # noqa: F401
     TreeAnnealing,

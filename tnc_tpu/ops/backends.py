@@ -38,15 +38,17 @@ class Backend:
 
 
 def _run_steps(xp, program: ContractionProgram, buffers: list[Any]) -> Any:
+    # Intermediates stay matrix-shaped between steps; the fused
+    # pre-shape/macro-perm keeps every device array low-rank (rank-25+
+    # logical shapes break the TPU compiler — see PairStep docstring).
     for step in program.steps:
         a = buffers[step.lhs]
         b = buffers[step.rhs]
-        a = xp.transpose(a, step.lhs_perm).reshape(step.lhs_mat)
-        b = xp.transpose(b, step.rhs_perm).reshape(step.rhs_mat)
-        out = xp.matmul(a, b)
-        buffers[step.lhs] = out.reshape(step.out_shape)
+        a = xp.transpose(a.reshape(step.lhs_pre), step.lhs_mperm).reshape(step.lhs_mat)
+        b = xp.transpose(b.reshape(step.rhs_pre), step.rhs_mperm).reshape(step.rhs_mat)
+        buffers[step.lhs] = xp.matmul(a, b)
         buffers[step.rhs] = None  # free eagerly
-    return buffers[program.result_slot]
+    return buffers[program.result_slot].reshape(program.result_shape)
 
 
 _PROGRAM_JIT_CACHE: "OrderedDict[tuple, Any]" = OrderedDict()

@@ -30,7 +30,18 @@ from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor, Tensor
 
 @dataclass(frozen=True)
 class PairStep:
-    """One pairwise contraction, fully shape-resolved."""
+    """One pairwise contraction, fully shape-resolved.
+
+    ``*_perm`` are the logical (per-leg) permutations; executors use the
+    fused ``*_pre``/``*_mperm`` forms instead: the logical permutation
+    with runs of consecutive source axes that stay consecutive collapsed
+    into single macro axes. Quantum-circuit tensors are high-rank with
+    all-dim-2 legs (rank 25+ after slicing Sycamore-53), and the TPU
+    compiler blows up on rank-20+ transposes, while the fused macro
+    transpose is typically rank <= 8 over the same elements. Device
+    buffers hold each intermediate as its (m, n) matmul result — the
+    high-rank logical shape never materializes on device.
+    """
 
     lhs: int  # slot of left input (result replaces this slot)
     rhs: int  # slot of right input (freed after the step)
@@ -39,6 +50,36 @@ class PairStep:
     lhs_mat: tuple[int, int]  # (m, k) matmul view of lhs
     rhs_mat: tuple[int, int]  # (k, n) matmul view of rhs
     out_shape: tuple[int, ...]  # final result shape for this step
+    lhs_pre: tuple[int, ...] = ()  # fused reshape before macro transpose
+    lhs_mperm: tuple[int, ...] = ()  # macro transpose
+    rhs_pre: tuple[int, ...] = ()
+    rhs_mperm: tuple[int, ...] = ()
+
+
+def _fuse_perm(
+    dims: tuple[int, ...], perm: tuple[int, ...]
+) -> tuple[tuple[int, ...], tuple[int, ...]]:
+    """Run-length fuse a permutation: maximal runs of consecutive source
+    axes that appear consecutively in ``perm`` become one macro axis.
+    Returns (pre_shape in source order, macro permutation)."""
+    if not perm:
+        return (1,), (0,)
+    runs: list[list[int]] = [[perm[0]]]
+    for p in perm[1:]:
+        if p == runs[-1][-1] + 1:
+            runs[-1].append(p)
+        else:
+            runs.append([p])
+    source_order = sorted(range(len(runs)), key=lambda r: runs[r][0])
+    pre_shape = []
+    for ri in source_order:
+        d = 1
+        for p in runs[ri]:
+            d *= dims[p]
+        pre_shape.append(d)
+    pos_in_source = {ri: k for k, ri in enumerate(source_order)}
+    macro_perm = tuple(pos_in_source[ri] for ri in range(len(runs)))
+    return tuple(pre_shape), macro_perm
 
 
 @dataclass(frozen=True)
@@ -56,7 +97,22 @@ class ContractionProgram:
         return (self.num_inputs, self.steps, self.result_slot)
 
 
-def _pair_step(lhs: int, rhs: int, ta: LeafTensor, tb: LeafTensor) -> tuple[PairStep, LeafTensor]:
+def _pair_step(
+    lhs: int,
+    rhs: int,
+    ta: LeafTensor,
+    tb: LeafTensor,
+    next_shared: set[int] | None = None,
+) -> tuple[PairStep, LeafTensor]:
+    """Build one contraction step.
+
+    ``next_shared``: the legs of this step's *output* that its consumer
+    step will contract away. When known, both keep-groups are emitted as
+    [kept-by-consumer…, contracted-by-consumer…] (sorted by leg id within
+    each), so the consumer's transpose degrades from a per-leg
+    interleave (rank ~ tensor rank) to a handful of contiguous segments
+    — the reorder is free here because it rides this step's transpose.
+    """
     b_leg_set = set(tb.legs)
     a_leg_set = set(ta.legs)
 
@@ -64,9 +120,30 @@ def _pair_step(lhs: int, rhs: int, ta: LeafTensor, tb: LeafTensor) -> tuple[Pair
     a_shared = [(pos, leg, dim) for pos, (leg, dim) in enumerate(ta.edges()) if leg in b_leg_set]
     b_keep = [(pos, leg, dim) for pos, (leg, dim) in enumerate(tb.edges()) if leg not in a_leg_set]
 
-    # Order b's shared axes to match a's shared-leg order.
+    if next_shared is not None:
+        group = lambda item: (item[1] in next_shared, item[1])  # noqa: E731
+        a_keep.sort(key=group)
+        b_keep.sort(key=group)
+
+    # The k-dimension needs one common shared-leg order. Follow the
+    # *larger* operand's axis order: its shared segment then stays
+    # contiguous (cheap transpose on the expensive tensor) and only the
+    # smaller operand pays the interleaved reorder.
     b_pos_of_leg = {leg: pos for pos, leg in enumerate(tb.legs)}
-    b_shared = [(b_pos_of_leg[leg], leg, dim) for (_, leg, dim) in a_shared]
+    if tb.size() > ta.size():
+        b_shared = [
+            (pos, leg, dim)
+            for pos, (leg, dim) in enumerate(tb.edges())
+            if leg in a_leg_set
+        ]
+        a_pos_of_leg = {leg: pos for pos, leg in enumerate(ta.legs)}
+        a_dim_of_leg = {leg: dim for leg, dim in ta.edges()}
+        a_shared = [
+            (a_pos_of_leg[leg], leg, a_dim_of_leg[leg])
+            for (_, leg, _) in b_shared
+        ]
+    else:
+        b_shared = [(b_pos_of_leg[leg], leg, dim) for (_, leg, dim) in a_shared]
 
     m = 1
     for _, _, d in a_keep:
@@ -85,6 +162,11 @@ def _pair_step(lhs: int, rhs: int, ta: LeafTensor, tb: LeafTensor) -> tuple[Pair
     out_dims = [dim for _, _, dim in a_keep] + [dim for _, _, dim in b_keep]
     result = LeafTensor(out_legs, out_dims)
 
+    a_dims = tuple(d for _, d in ta.edges())
+    b_dims = tuple(d for _, d in tb.edges())
+    lhs_pre, lhs_mperm = _fuse_perm(a_dims, lhs_perm)
+    rhs_pre, rhs_mperm = _fuse_perm(b_dims, rhs_perm)
+
     step = PairStep(
         lhs=lhs,
         rhs=rhs,
@@ -93,6 +175,10 @@ def _pair_step(lhs: int, rhs: int, ta: LeafTensor, tb: LeafTensor) -> tuple[Pair
         lhs_mat=(m, k),
         rhs_mat=(k, n),
         out_shape=tuple(out_dims),
+        lhs_pre=lhs_pre,
+        lhs_mperm=lhs_mperm,
+        rhs_pre=rhs_pre,
+        rhs_mperm=rhs_mperm,
     )
     return step, result
 
@@ -105,7 +191,9 @@ def build_program(tn: CompositeTensor, contract_path: ContractionPath) -> Contra
     (``contraction.rs:42-49``).
     """
     flat_slots: list[LeafTensor] = []
-    steps: list[PairStep] = []
+    # (lhs_slot, rhs_slot, lhs_legs, rhs_legs) per step, for the
+    # consumer-alignment pass (leg sets are layout-independent).
+    step_plan: list[tuple[int, int, frozenset[int], frozenset[int]]] = []
 
     def compile_composite(
         tensors: list[Tensor], cpath: ContractionPath
@@ -145,9 +233,17 @@ def build_program(tn: CompositeTensor, contract_path: ContractionPath) -> Contra
             ta, tb = current[i], current[j]
             if ta is None or tb is None:
                 raise ValueError(f"path step ({i}, {j}) uses a consumed tensor")
-            step, result = _pair_step(slot_of[i], slot_of[j], ta, tb)
-            steps.append(step)
-            current[i] = result
+            step_plan.append(
+                (
+                    slot_of[i],
+                    slot_of[j],
+                    frozenset(ta.legs),
+                    frozenset(tb.legs),
+                )
+            )
+            # metadata only — the real PairSteps are built in the
+            # consumer-aligned pass below (leg order there is free)
+            current[i] = ta ^ tb
             current[j] = None
 
         survivors = [idx for idx, t in enumerate(current) if t is not None]
@@ -161,6 +257,34 @@ def build_program(tn: CompositeTensor, contract_path: ContractionPath) -> Contra
         return slot_of[survivor], result
 
     result_slot, final = compile_composite(list(tn.tensors), contract_path)
+
+    # Consumer-alignment pass: each step's output is consumed by exactly
+    # one later step (the path is a tree); knowing which of its legs that
+    # consumer contracts lets _pair_step group them contiguously, keeping
+    # every transpose low-rank after run fusion (see PairStep docstring).
+    n_steps = len(step_plan)
+    next_shared: list[set[int] | None] = [None] * n_steps
+    producer: dict[int, int] = {}  # slot -> step index of current content
+    for t, (t_lhs, t_rhs, t_la, t_lb) in enumerate(step_plan):
+        s = producer.get(t_lhs)
+        if s is not None:
+            next_shared[s] = set((step_plan[s][2] ^ step_plan[s][3]) & t_lb)
+        s = producer.get(t_rhs)
+        if s is not None:
+            next_shared[s] = set((step_plan[s][2] ^ step_plan[s][3]) & t_la)
+        producer[t_lhs] = t
+
+    steps: list[PairStep] = []
+    meta: dict[int, LeafTensor] = {
+        slot: leaf for slot, leaf in enumerate(flat_slots)
+    }
+    for s, (lhs_slot, rhs_slot, _, _) in enumerate(step_plan):
+        step, result = _pair_step(
+            lhs_slot, rhs_slot, meta[lhs_slot], meta[rhs_slot], next_shared[s]
+        )
+        steps.append(step)
+        meta[lhs_slot] = result
+    final = meta[result_slot] if step_plan else final
 
     return ContractionProgram(
         num_inputs=len(flat_slots),

@@ -51,8 +51,9 @@ def gauss_matmul(xp, ar, ai, br, bi, precision=None):
     return k1 - k3, k1 + k2
 
 
-def _prep(xp, part, perm: tuple[int, ...], mat: tuple[int, int]):
-    return xp.transpose(part, perm).reshape(mat)
+def _prep(xp, part, pre: tuple[int, ...], mperm: tuple[int, ...], mat: tuple[int, int]):
+    # fused low-rank transpose (see PairStep docstring)
+    return xp.transpose(part.reshape(pre), mperm).reshape(mat)
 
 
 def run_steps_split(
@@ -62,15 +63,17 @@ def run_steps_split(
     precision=None,
 ):
     """Split-complex analogue of ``backends._run_steps``; ``buffers`` are
-    (real, imag) pairs and the result is a pair."""
+    (real, imag) pairs and the result is a pair. Intermediates stay
+    matrix-shaped between steps."""
     for step in program.steps:
         ar, ai = buffers[step.lhs]
         br, bi = buffers[step.rhs]
-        ar = _prep(xp, ar, step.lhs_perm, step.lhs_mat)
-        ai = _prep(xp, ai, step.lhs_perm, step.lhs_mat)
-        br = _prep(xp, br, step.rhs_perm, step.rhs_mat)
-        bi = _prep(xp, bi, step.rhs_perm, step.rhs_mat)
+        ar = _prep(xp, ar, step.lhs_pre, step.lhs_mperm, step.lhs_mat)
+        ai = _prep(xp, ai, step.lhs_pre, step.lhs_mperm, step.lhs_mat)
+        br = _prep(xp, br, step.rhs_pre, step.rhs_mperm, step.rhs_mat)
+        bi = _prep(xp, bi, step.rhs_pre, step.rhs_mperm, step.rhs_mat)
         re, im = gauss_matmul(xp, ar, ai, br, bi, precision)
-        buffers[step.lhs] = (re.reshape(step.out_shape), im.reshape(step.out_shape))
+        buffers[step.lhs] = (re, im)
         buffers[step.rhs] = None
-    return buffers[program.result_slot]
+    re, im = buffers[program.result_slot]
+    return re.reshape(program.result_shape), im.reshape(program.result_shape)

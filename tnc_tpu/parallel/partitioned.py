@@ -244,3 +244,44 @@ def distributed_partitioned_contraction(
     else:
         data = np.asarray(final)
     return LeafTensor(list(meta.legs), list(meta.bond_dims), TensorData.matrix(data))
+
+
+def broadcast_path(path_: ContractionPath, root: int = 0) -> ContractionPath:
+    """Share the planner's path with every host process
+    (``broadcast_path``, ``communication.rs:32-49``).
+
+    Under JAX's single-controller model a single process plans and
+    executes, so this is the identity; in a multi-process run
+    (``jax.distributed.initialize``) the path found by the ``root``
+    process is broadcast to all others as serialized bytes over the
+    global mesh, the analogue of the reference's two-phase MPI vec
+    broadcast (``communication.rs:14-28``).
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return path_
+
+    import pickle
+
+    from jax.experimental import multihost_utils
+
+    payload = pickle.dumps(path_) if jax.process_index() == root else b""
+    # length-prefix phase (the reference broadcasts the length first)
+    length = int(
+        multihost_utils.broadcast_one_to_all(
+            np.int64(len(payload)), is_source=jax.process_index() == root
+        )
+    )
+    buf = np.frombuffer(payload.ljust(length, b"\0"), dtype=np.uint8)
+    data = multihost_utils.broadcast_one_to_all(
+        buf, is_source=jax.process_index() == root
+    )
+    return pickle.loads(np.asarray(data).tobytes())
+
+
+# Reference-named aliases (``mpi/communication.rs:125,199``): the TPU
+# executor's scatter/reduce are the same pipeline stages under the
+# device-mesh model.
+scatter_tensor_network = scatter_partitions
+intermediate_reduce_tensor_network = intermediate_reduce

@@ -23,6 +23,11 @@ from typing import Any
 import numpy as np
 
 
+# Materialized tensor payload type (reference: ``DataTensor =
+# ArrayD<Complex64>``, ``tensordata.rs:13``).
+DataTensor = np.ndarray
+
+
 class DataKind(enum.Enum):
     NONE = "none"
     GATE = "gate"
