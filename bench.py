@@ -1,10 +1,10 @@
 #!/usr/bin/env python
-"""Benchmark driver: Sycamore-53 depth-14 single-amplitude contraction.
+"""Benchmark driver over the BASELINE.md configs.
 
-The north-star config from BASELINE.md (#3): build the Sycamore-53
-depth-14 amplitude network, plan a path with the native hyper-optimizer,
-slice it to fit single-chip HBM, and execute on the JAX backend (TPU when
-available). Prints ONE JSON line:
+Default config: Sycamore-53 depth-14 single-amplitude contraction (the
+north-star, BASELINE.md #3): build the amplitude network, plan with the
+native hyper-optimizer, slice-and-reconfigure to fit single-chip HBM,
+execute on the JAX backend (TPU when available). Prints ONE JSON line:
 
     {"metric": ..., "value": <wall-clock seconds>, "unit": "s",
      "vs_baseline": <speedup vs the CPU (numpy/BLAS) oracle>}
@@ -13,13 +13,13 @@ Methodology mirrors the reference benchmark's ``time_to_solution``
 (``benchmark/src/main.rs:365-405``): path optimization is excluded from
 the timed region; the contraction itself — all slices — is timed after a
 warmup run that triggers XLA compilation. The CPU baseline runs the SAME
-sliced program on a subset of slices with numpy and extrapolates linearly
-(slices are identical work by construction), because running every slice
-on CPU would take hours.
+program (subset of slices, extrapolated linearly for the sliced config —
+slices are identical work by construction).
 
-Configurable via env:
-  BENCH_QUBITS (53), BENCH_DEPTH (14), BENCH_SEED (42),
-  BENCH_TARGET_LOG2_PEAK (28), BENCH_NTRIALS (16),
+Env knobs:
+  BENCH_CONFIG  sycamore_amplitude (default) | ghz3 | random20 | qaoa30
+  BENCH_QUBITS / BENCH_DEPTH / BENCH_SEED
+  BENCH_TARGET_LOG2_PEAK (28), BENCH_NTRIALS (64),
   BENCH_CPU_SLICES (2), BENCH_REPS (3)
 """
 
@@ -30,35 +30,53 @@ import time
 
 import numpy as np
 
+log = lambda *a: print(*a, file=sys.stderr)  # noqa: E731
 
-def main() -> None:
-    qubits = int(os.environ.get("BENCH_QUBITS", "53"))
-    depth = int(os.environ.get("BENCH_DEPTH", "14"))
-    seed = int(os.environ.get("BENCH_SEED", "42"))
-    target_log2 = float(os.environ.get("BENCH_TARGET_LOG2_PEAK", "28"))
-    ntrials = int(os.environ.get("BENCH_NTRIALS", "16"))
-    cpu_slices = int(os.environ.get("BENCH_CPU_SLICES", "2"))
-    reps = int(os.environ.get("BENCH_REPS", "3"))
 
-    import jax
+def _env_int(name, default):
+    return int(os.environ.get(name, str(default)))
 
+
+def _time_backend(run, reps):
+    """Median wall-clock of ``run()`` over ``reps`` after one warmup."""
+    t0 = time.monotonic()
+    out = run()
+    log(f"[bench] warmup (incl. compile): {time.monotonic() - t0:.2f}s")
+    times = []
+    for _ in range(reps):
+        t0 = time.monotonic()
+        out = run()
+        times.append(time.monotonic() - t0)
+    log(f"[bench] runs: {[round(t, 4) for t in times]}")
+    return float(np.median(times)), out
+
+
+def bench_sycamore_amplitude():
+    """North-star: Sycamore-53 m=14 single amplitude, sliced (config #3)."""
     from tnc_tpu.builders.sycamore_circuit import sycamore_circuit
+    from tnc_tpu.contractionpath.contraction_path import ContractionPath
     from tnc_tpu.contractionpath.paths.hyper import Hyperoptimizer
-    from tnc_tpu.contractionpath.slicing import sliced_flops
+    from tnc_tpu.contractionpath.slicing import (
+        slice_and_reconfigure,
+        sliced_flops,
+    )
     from tnc_tpu.ops.backends import JaxBackend
     from tnc_tpu.ops.program import flat_leaf_tensors
-    from tnc_tpu.ops.sliced import build_sliced_program
-
-    device = jax.devices()[0]
-    log = lambda *a: print(*a, file=sys.stderr)  # noqa: E731
-    log(f"[bench] device: {device.platform} ({device.device_kind})")
-
-    # -- build network ------------------------------------------------------
+    from tnc_tpu.ops.sliced import build_sliced_program, execute_sliced_numpy
     from tnc_tpu.tensornetwork.simplify import simplify_network
 
+    qubits = _env_int("BENCH_QUBITS", 53)
+    depth = _env_int("BENCH_DEPTH", 14)
+    seed = _env_int("BENCH_SEED", 42)
+    target_log2 = float(os.environ.get("BENCH_TARGET_LOG2_PEAK", "28"))
+    ntrials = _env_int("BENCH_NTRIALS", 64)
+    cpu_slices = _env_int("BENCH_CPU_SLICES", 2)
+    reps = _env_int("BENCH_REPS", 3)
+
     rng = np.random.default_rng(seed)
-    circuit = sycamore_circuit(qubits, depth, rng)
-    raw, _ = circuit.into_amplitude_network("0" * qubits)
+    raw, _ = sycamore_circuit(qubits, depth, rng).into_amplitude_network(
+        "0" * qubits
+    )
     tn = simplify_network(raw)
     log(
         f"[bench] network: {len(raw)} tensors -> {len(tn)} cores after host "
@@ -66,18 +84,15 @@ def main() -> None:
     )
 
     # -- plan (excluded from timing, like the reference's Sweep phase) ------
-    from tnc_tpu.contractionpath.contraction_path import ContractionPath
-    from tnc_tpu.contractionpath.slicing import slice_and_reconfigure
-
     target = 2.0**target_log2
     t0 = time.monotonic()
     result = Hyperoptimizer(
         ntrials=ntrials, seed=seed, target_size=target
     ).find_path(tn)
-    plan_s = time.monotonic() - t0
     log(
         f"[bench] path: flops={result.flops:.3e} "
-        f"peak=2^{np.log2(max(result.size, 1)):.1f} (planned in {plan_s:.1f}s)"
+        f"peak=2^{np.log2(max(result.size, 1)):.1f} "
+        f"(planned in {time.monotonic() - t0:.1f}s)"
     )
 
     inputs = list(tn.tensors)
@@ -88,49 +103,174 @@ def main() -> None:
     replace = ContractionPath.simple(replace_pairs)
     total_flops = sliced_flops(inputs, replace.toplevel, slicing)
     log(
-        f"[bench] slicing: {len(slicing.legs)} legs, {slicing.num_slices} slices, "
-        f"total flops {total_flops:.3e} "
+        f"[bench] slicing: {len(slicing.legs)} legs, {slicing.num_slices} "
+        f"slices, total flops {total_flops:.3e} "
         f"(slice+reconfigure in {time.monotonic() - t0:.1f}s)"
     )
 
     sp = build_sliced_program(tn, replace, slicing)
-    leaves = flat_leaf_tensors(tn)
-    arrays = [leaf.data.into_data() for leaf in leaves]
+    arrays = [leaf.data.into_data() for leaf in flat_leaf_tensors(tn)]
 
-    # -- TPU/accelerator timing --------------------------------------------
     backend = JaxBackend(dtype="complex64")
-    t0 = time.monotonic()
-    amp_warm = backend.execute_sliced(sp, arrays)  # includes compile
-    compile_s = time.monotonic() - t0
-    log(f"[bench] warmup (incl. compile): {compile_s:.2f}s")
-
-    times = []
-    for _ in range(reps):
-        t0 = time.monotonic()
-        amp = backend.execute_sliced(sp, arrays)
-        times.append(time.monotonic() - t0)
-    tpu_s = float(np.median(times))
+    tpu_s, amp = _time_backend(lambda: backend.execute_sliced(sp, arrays), reps)
     amplitude = complex(np.asarray(amp).reshape(-1)[0])
-    log(f"[bench] amplitude: {amplitude} | runs: {[round(t, 3) for t in times]}")
+    log(f"[bench] amplitude: {amplitude}")
 
     # -- CPU baseline: same program, subset of slices, extrapolated --------
-    from tnc_tpu.ops.sliced import execute_sliced_numpy
-
     n_sub = max(1, min(cpu_slices, slicing.num_slices))
     t0 = time.monotonic()
     execute_sliced_numpy(sp, arrays, dtype=np.complex64, max_slices=n_sub)
-    cpu_sub_s = time.monotonic() - t0
-    cpu_s = cpu_sub_s * (slicing.num_slices / n_sub)
-    log(
-        f"[bench] cpu oracle: {cpu_sub_s:.2f}s for {n_sub}/{slicing.num_slices} "
-        f"slices -> {cpu_s:.1f}s extrapolated"
+    cpu_s = (time.monotonic() - t0) * (slicing.num_slices / n_sub)
+    log(f"[bench] cpu oracle extrapolated: {cpu_s:.1f}s")
+
+    return (
+        f"sycamore{qubits}_m{depth}_amplitude_wallclock",
+        tpu_s,
+        cpu_s / tpu_s if tpu_s > 0 else 0.0,
     )
 
-    vs_baseline = cpu_s / tpu_s if tpu_s > 0 else 0.0
+
+def bench_ghz3():
+    """Config #1: 3-qubit GHZ statevector from QASM (README example)."""
+    from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+    from tnc_tpu.io.qasm import import_qasm
+    from tnc_tpu.ops.backends import JaxBackend, NumpyBackend
+    from tnc_tpu.ops.program import build_program, flat_leaf_tensors
+
+    reps = _env_int("BENCH_REPS", 5)
+    qasm = """OPENQASM 2.0;\ninclude "qelib1.inc";\nqreg q[3];\nh q[0];\ncx q[0], q[1];\ncx q[1], q[2];\n"""
+    circuit = import_qasm(qasm)
+    tn, _ = circuit.into_statevector_network()
+    result = Greedy(OptMethod.GREEDY).find_path(tn)
+    program = build_program(tn, result.replace_path())
+    arrays = [leaf.data.into_data() for leaf in flat_leaf_tensors(tn)]
+
+    backend = JaxBackend(dtype="complex64")
+    tpu_s, out = _time_backend(lambda: backend.execute(program, arrays), reps)
+    sv = np.asarray(out).reshape(-1)
+    assert abs(abs(sv[0]) - 1 / np.sqrt(2)) < 1e-5
+
+    cpu = NumpyBackend(dtype=np.complex64)
+    t0 = time.monotonic()
+    cpu.execute(program, arrays)
+    cpu_s = time.monotonic() - t0
+    return "ghz3_statevector_wallclock", tpu_s, cpu_s / tpu_s if tpu_s else 0.0
+
+
+def bench_random20():
+    """Config #2: 20-qubit depth-12 random-circuit statevector, Greedy."""
+    from tnc_tpu.builders.random_circuit import random_circuit
+    from tnc_tpu.builders.connectivity import ConnectivityLayout
+    from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+    from tnc_tpu.ops.backends import JaxBackend, NumpyBackend
+    from tnc_tpu.ops.program import build_program, flat_leaf_tensors
+
+    seed = _env_int("BENCH_SEED", 42)
+    reps = _env_int("BENCH_REPS", 3)
+    rng = np.random.default_rng(seed)
+    tn = random_circuit(
+        20, 12, 0.4, 0.4, rng, ConnectivityLayout.SYCAMORE, bitstring="*" * 20
+    )
+    result = Greedy(OptMethod.GREEDY).find_path(tn)
+    log(f"[bench] random20: flops={result.flops:.3e} peak={result.size:.3e}")
+    program = build_program(tn, result.replace_path())
+    arrays = [leaf.data.into_data() for leaf in flat_leaf_tensors(tn)]
+
+    backend = JaxBackend(dtype="complex64")
+    tpu_s, out = _time_backend(lambda: backend.execute(program, arrays), reps)
+    sv = np.asarray(out).reshape(-1)
+    norm = float(np.vdot(sv, sv).real)
+    log(f"[bench] statevector norm: {norm:.6f}")
+    assert abs(norm - 1.0) < 1e-3
+
+    cpu = NumpyBackend(dtype=np.complex64)
+    t0 = time.monotonic()
+    cpu.execute(program, arrays)
+    cpu_s = time.monotonic() - t0
+    return "random20_d12_statevector_wallclock", tpu_s, cpu_s / tpu_s if tpu_s else 0.0
+
+
+def bench_qaoa30():
+    """Config #4: 30-qubit QAOA Pauli-expectation with the SA partitioner."""
+    import random as pyrandom
+
+    from tnc_tpu.builders.qaoa_circuit import qaoa_circuit
+    from tnc_tpu.contractionpath.repartitioning import compute_solution
+    from tnc_tpu.contractionpath.repartitioning.simulated_annealing import (
+        IntermediatePartitioningModel,
+        balance_partitions,
+    )
+    from tnc_tpu.ops.backends import JaxBackend, NumpyBackend
+    from tnc_tpu.ops.program import build_program, flat_leaf_tensors
+    from tnc_tpu.tensornetwork.partitioning import find_partitioning
+    from tnc_tpu.tensornetwork.simplify import simplify_network
+
+    qubits = _env_int("BENCH_QUBITS", 30)
+    rounds = _env_int("BENCH_DEPTH", 2)
+    seed = _env_int("BENCH_SEED", 42)
+    reps = _env_int("BENCH_REPS", 3)
+    k = _env_int("BENCH_PARTITIONS", 4)
+    sa_seconds = float(os.environ.get("BENCH_SA_SECONDS", "30"))
+
+    rng = np.random.default_rng(seed)
+    raw = qaoa_circuit(qubits, rounds, rng).into_expectation_value_network()
+    tn = simplify_network(raw)
+    log(f"[bench] qaoa{qubits} p={rounds}: {len(raw)} -> {len(tn)} cores")
+
+    partitioning = find_partitioning(tn, k)
+    sa_rng = pyrandom.Random(seed)
+    t0 = time.monotonic()
+    model = IntermediatePartitioningModel(tn)
+    best_solution, best_score = balance_partitions(
+        model,
+        model.initial_solution(partitioning),
+        sa_rng,
+        max_time=sa_seconds,
+    )
+    log(
+        f"[bench] SA partitioner: critical-path cost {best_score:.3e} "
+        f"in {time.monotonic() - t0:.1f}s"
+    )
+    ptn, ppath, parallel_cost, _ = compute_solution(
+        tn, best_solution[0], rng=sa_rng
+    )
+    program = build_program(ptn, ppath)
+    arrays = [leaf.data.into_data() for leaf in flat_leaf_tensors(ptn)]
+
+    backend = JaxBackend(dtype="complex64")
+    tpu_s, out = _time_backend(lambda: backend.execute(program, arrays), reps)
+    ev = complex(np.asarray(out).reshape(-1)[0])
+    log(f"[bench] <Z...Z> = {ev}")
+
+    cpu = NumpyBackend(dtype=np.complex64)
+    t0 = time.monotonic()
+    cpu.execute(program, arrays)
+    cpu_s = time.monotonic() - t0
+    return f"qaoa{qubits}_expectation_wallclock", tpu_s, cpu_s / tpu_s if tpu_s else 0.0
+
+
+CONFIGS = {
+    "sycamore_amplitude": bench_sycamore_amplitude,
+    "ghz3": bench_ghz3,
+    "random20": bench_random20,
+    "qaoa30": bench_qaoa30,
+}
+
+
+def main() -> None:
+    import jax
+
+    device = jax.devices()[0]
+    log(f"[bench] device: {device.platform} ({device.device_kind})")
+
+    config = os.environ.get("BENCH_CONFIG", "sycamore_amplitude")
+    if config not in CONFIGS:
+        sys.exit(f"unknown BENCH_CONFIG {config!r}; one of {sorted(CONFIGS)}")
+    metric, tpu_s, vs_baseline = CONFIGS[config]()
     print(
         json.dumps(
             {
-                "metric": f"sycamore{qubits}_m{depth}_amplitude_wallclock",
+                "metric": metric,
                 "value": round(tpu_s, 4),
                 "unit": "s",
                 "vs_baseline": round(vs_baseline, 2),

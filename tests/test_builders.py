@@ -144,3 +144,30 @@ def test_peps_validation():
         peps(1, 2, 2, 2, 1)
     with pytest.raises(ValueError):
         peps(2, 1, 2, 2, 1)
+
+
+def test_qaoa_expectation_matches_statevector_oracle():
+    """QAOA ⟨Z…Z⟩ network equals the value computed from the statevector."""
+    import numpy as np
+
+    from tnc_tpu.builders.qaoa_circuit import qaoa_circuit
+    from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+    from tnc_tpu.tensornetwork.contraction import contract_tensor_network
+
+    rng = np.random.default_rng(7)
+    tn = qaoa_circuit(4, 1, rng).into_expectation_value_network()
+    res = Greedy(OptMethod.GREEDY).find_path(tn)
+    ev = complex(contract_tensor_network(tn, res.replace_path()).data.into_data())
+
+    rng2 = np.random.default_rng(7)
+    circuit = qaoa_circuit(4, 1, rng2)
+    tn2, perm = circuit.into_statevector_network()
+    res2 = Greedy(OptMethod.GREEDY).find_path(tn2)
+    out = perm.apply(contract_tensor_network(tn2, res2.replace_path()))
+    sv = np.asarray(out.data.into_data()).reshape(-1)
+    z = np.array([1.0, -1.0])
+    zz = np.ones(1)
+    for _ in range(4):
+        zz = np.kron(zz, z)
+    want = np.vdot(sv, zz * sv)
+    assert abs(ev - want) < 1e-10

@@ -1,0 +1,62 @@
+"""QAOA circuit generation.
+
+The survey's benchmark config #4 is a 30-qubit QAOA Pauli-string
+expectation value (BASELINE.md, driver config table; the reference feeds
+such circuits in as QASM through its benchmark crate). This builder
+produces the standard QAOA ansatz for MaxCut on a given coupling graph:
+
+    |+…+>  then p rounds of  [ exp(-i γ Z_u Z_v) on every edge,
+                               exp(-i β X_q) on every qubit ]
+
+with ZZ interactions compiled to the cx–rz–cx pattern. The circuit
+closes as a ⟨ψ|Z…Z|ψ⟩ expectation network via
+``Circuit.into_expectation_value_network`` (reference finalizer:
+``builders/circuit_builder.rs:304-326``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tnc_tpu.builders.circuit_builder import Circuit
+from tnc_tpu.builders.connectivity import Connectivity, ConnectivityLayout
+from tnc_tpu.tensornetwork.tensordata import TensorData
+
+
+def qaoa_circuit(
+    qubits: int,
+    rounds: int,
+    rng: np.random.Generator,
+    layout: ConnectivityLayout = ConnectivityLayout.LINE,
+) -> Circuit:
+    """QAOA MaxCut ansatz with ``rounds`` (γ, β) layers of random angles
+    on the ``layout`` coupling graph (default: a line of ``qubits``).
+    """
+    graph = Connectivity.new(layout, qubits)
+    edges = [(u, v) for (u, v) in graph.connectivity if u < qubits and v < qubits]
+
+    circuit = Circuit()
+    reg = circuit.allocate_register(qubits)
+
+    for q in range(qubits):
+        circuit.append_gate(TensorData.gate("h"), [reg.qubit(q)])
+
+    for _ in range(rounds):
+        gamma = float(rng.uniform(0, 2 * np.pi))
+        beta = float(rng.uniform(0, np.pi))
+        for u, v in edges:
+            # exp(-i gamma Z_u Z_v) = cx(u,v) rz(2*gamma, v) cx(u,v)
+            circuit.append_gate(
+                TensorData.gate("cx"), [reg.qubit(u), reg.qubit(v)]
+            )
+            circuit.append_gate(
+                TensorData.gate("rz", [2.0 * gamma]), [reg.qubit(v)]
+            )
+            circuit.append_gate(
+                TensorData.gate("cx"), [reg.qubit(u), reg.qubit(v)]
+            )
+        for q in range(qubits):
+            circuit.append_gate(
+                TensorData.gate("rx", [2.0 * beta]), [reg.qubit(q)]
+            )
+    return circuit

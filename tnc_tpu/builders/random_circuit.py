@@ -46,8 +46,14 @@ def random_circuit(
     two_qubit_probability: float,
     rng: np.random.Generator,
     connectivity: ConnectivityLayout,
+    bitstring: str | None = None,
 ) -> CompositeTensor:
-    """Random circuit closed as a |0…0⟩ amplitude network."""
+    """Random circuit closed as an amplitude network.
+
+    ``bitstring`` defaults to |0…0⟩ (the reference's behavior,
+    ``random_circuit.rs:29-80``); pass ``"*" * qubits`` for an open
+    statevector network.
+    """
     connectivity_pairs = _filtered_connectivity(connectivity, qubits)
 
     circuit = Circuit()
@@ -64,7 +70,9 @@ def random_circuit(
                     TensorData.gate("fsim", _FSIM_ANGLES), [qr.qubit(i), qr.qubit(j)]
                 )
 
-    return circuit.into_amplitude_network("0" * qubits)[0]
+    if bitstring is None:
+        bitstring = "0" * qubits
+    return circuit.into_amplitude_network(bitstring)[0]
 
 
 def random_circuit_with_observable(

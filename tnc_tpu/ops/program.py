@@ -93,8 +93,10 @@ class ContractionProgram:
     result_shape: tuple[int, ...]
 
     def signature(self) -> tuple:
-        """Hashable identity for jit-compilation caching."""
-        return (self.num_inputs, self.steps, self.result_slot)
+        """Hashable identity for jit-compilation caching. ``result_shape``
+        matters: the jitted body reshapes the final buffer to it, so two
+        zero-step programs with different shapes must not share a key."""
+        return (self.num_inputs, self.steps, self.result_slot, self.result_shape)
 
 
 def _pair_step(
