@@ -111,7 +111,14 @@ def bench_sycamore_amplitude():
     sp = build_sliced_program(tn, replace, slicing)
     arrays = [leaf.data.into_data() for leaf in flat_leaf_tensors(tn)]
 
-    backend = JaxBackend(dtype="complex64")
+    strategy = os.environ.get("BENCH_EXEC", "chunked")
+    backend = JaxBackend(
+        dtype="complex64",
+        sliced_strategy=strategy,
+        slice_batch=_env_int("BENCH_BATCH", 8),
+        chunk_steps=_env_int("BENCH_CHUNK_STEPS", 48),
+    )
+    log(f"[bench] executor: {strategy}")
     tpu_s, amp = _time_backend(lambda: backend.execute_sliced(sp, arrays), reps)
     amplitude = complex(np.asarray(amp).reshape(-1)[0])
     log(f"[bench] amplitude: {amplitude}")

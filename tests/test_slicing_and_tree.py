@@ -273,3 +273,68 @@ def test_native_treedp_size_cap():
     assert ok is not None
     none = native_optimal_order(sets, dims, "flops", logsize_cap=_math.log2(4))
     assert none is not None and _math.isinf(none[0])
+
+
+def test_chunked_batched_executor_matches_oracle():
+    """Chunked slice-batched execution equals the numpy oracle for both
+    complex and split-complex modes, batched and unbatched."""
+    from tnc_tpu.contractionpath.contraction_path import ContractionPath
+    from tnc_tpu.contractionpath.slicing import (
+        _replay_sizes,
+        slice_and_reconfigure,
+    )
+    from tnc_tpu.ops.chunked import execute_sliced_batched_jax, split_program
+    from tnc_tpu.ops.program import flat_leaf_tensors
+    from tnc_tpu.ops.sliced import build_sliced_program, execute_sliced_numpy
+
+    tn = _sycamore_network(qubits=16, depth=8, seed=5)
+    res = Greedy(OptMethod.GREEDY).find_path(tn)
+    inputs = list(tn.tensors)
+    peak0, _ = _replay_sizes(inputs, res.replace_path().toplevel, set())
+    rep, sl = slice_and_reconfigure(
+        inputs, res.ssa_path.toplevel, peak0 / 32,
+        step_budget=0.5, final_budget=1.0,
+    )
+    assert sl.num_slices > 1
+    sp = build_sliced_program(tn, ContractionPath.simple(rep), sl)
+    arrays = [leaf.data.into_data() for leaf in flat_leaf_tensors(tn)]
+
+    chunks = split_program(sp.program, 16)
+    assert sum(len(c.steps) for c in chunks) == len(sp.program.steps)
+
+    want = complex(
+        np.asarray(
+            execute_sliced_numpy(sp, arrays, dtype=np.complex128)
+        ).reshape(-1)[0]
+    )
+    for split in (False, True):
+        batch = 2 if sl.num_slices % 2 == 0 else 1
+        got = execute_sliced_batched_jax(
+            sp, arrays, batch=batch, chunk_steps=16, split_complex=split
+        )
+        err = abs(complex(np.asarray(got).reshape(-1)[0]) - want)
+        assert err <= 1e-3 * max(1e-30, abs(want)), (split, got, want)
+
+
+def test_jax_backend_chunked_strategy():
+    from tnc_tpu.contractionpath.contraction_path import ContractionPath
+    from tnc_tpu.contractionpath.slicing import find_slicing
+    from tnc_tpu.ops.backends import JaxBackend
+    from tnc_tpu.ops.program import flat_leaf_tensors
+    from tnc_tpu.ops.sliced import build_sliced_program
+
+    tn = _sycamore_network(qubits=12, depth=6, seed=1)
+    res = Greedy(OptMethod.GREEDY).find_path(tn)
+    rp = res.replace_path()
+    slicing = find_slicing(list(tn.tensors), rp.toplevel, max(64.0, res.size / 8))
+    sp = build_sliced_program(tn, rp, slicing)
+    arrays = [leaf.data.into_data() for leaf in flat_leaf_tensors(tn)]
+
+    loop = JaxBackend(dtype="complex64", sliced_strategy="loop")
+    chunked = JaxBackend(
+        dtype="complex64", sliced_strategy="chunked", slice_batch=1,
+        chunk_steps=8,
+    )
+    a = complex(np.asarray(loop.execute_sliced(sp, arrays)).reshape(-1)[0])
+    b = complex(np.asarray(chunked.execute_sliced(sp, arrays)).reshape(-1)[0])
+    assert a == pytest.approx(b, rel=1e-4, abs=1e-7)

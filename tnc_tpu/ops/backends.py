@@ -37,16 +37,20 @@ class Backend:
         raise NotImplementedError
 
 
+def apply_step(xp, a: Any, b: Any, step) -> Any:
+    """One pairwise contraction on matrix-shaped buffers. The fused
+    pre-shape/macro-perm keeps every device array low-rank (rank-25+
+    logical shapes break the TPU compiler — see PairStep docstring);
+    the single source of truth for the step kernel, shared by the whole-
+    program, sliced-loop, and chunked executors."""
+    a = xp.transpose(a.reshape(step.lhs_pre), step.lhs_mperm).reshape(step.lhs_mat)
+    b = xp.transpose(b.reshape(step.rhs_pre), step.rhs_mperm).reshape(step.rhs_mat)
+    return xp.matmul(a, b)
+
+
 def _run_steps(xp, program: ContractionProgram, buffers: list[Any]) -> Any:
-    # Intermediates stay matrix-shaped between steps; the fused
-    # pre-shape/macro-perm keeps every device array low-rank (rank-25+
-    # logical shapes break the TPU compiler — see PairStep docstring).
     for step in program.steps:
-        a = buffers[step.lhs]
-        b = buffers[step.rhs]
-        a = xp.transpose(a.reshape(step.lhs_pre), step.lhs_mperm).reshape(step.lhs_mat)
-        b = xp.transpose(b.reshape(step.rhs_pre), step.rhs_mperm).reshape(step.rhs_mat)
-        buffers[step.lhs] = xp.matmul(a, b)
+        buffers[step.lhs] = apply_step(xp, buffers[step.lhs], buffers[step.rhs], step)
         buffers[step.rhs] = None  # free eagerly
     return buffers[program.result_slot].reshape(program.result_shape)
 
@@ -171,7 +175,15 @@ class JaxBackend(Backend):
         device=None,
         split_complex: bool | None = None,
         precision: str | None = "float32",
+        sliced_strategy: str = "loop",
+        slice_batch: int = 8,
+        chunk_steps: int = 64,
     ):
+        """``sliced_strategy``: 'loop' compiles the whole slice loop into
+        one on-device ``fori_loop`` program (lowest overhead, one big
+        compile); 'chunked' splits the program into slice-batched chunks
+        (K small compiles, batched matmuls — see
+        :mod:`tnc_tpu.ops.chunked`)."""
         import jax
 
         self._jax = jax
@@ -183,6 +195,11 @@ class JaxBackend(Backend):
             split_complex = platform != "cpu"
         self.split_complex = split_complex
         self.precision = precision
+        if sliced_strategy not in ("loop", "chunked"):
+            raise ValueError(f"unknown sliced_strategy {sliced_strategy!r}")
+        self.sliced_strategy = sliced_strategy
+        self.slice_batch = slice_batch
+        self.chunk_steps = chunk_steps
         self._cache: dict[tuple, Any] = {}
 
     def _compiled(self, program: ContractionProgram):
@@ -211,6 +228,20 @@ class JaxBackend(Backend):
 
         if sp.slicing.num_slices == 1:
             return self.execute(sp.program, arrays)
+
+        if self.sliced_strategy == "chunked":
+            from tnc_tpu.ops.chunked import execute_sliced_batched_jax
+
+            return execute_sliced_batched_jax(
+                sp,
+                arrays,
+                batch=self.slice_batch,
+                chunk_steps=self.chunk_steps,
+                split_complex=self.split_complex,
+                precision=self.precision,
+                dtype=self.dtype,
+                device=self.device,
+            )
 
         key = ("sliced", sp.signature(), str(self.dtype), self.split_complex)
         fn = self._cache.get(key)

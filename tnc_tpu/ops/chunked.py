@@ -1,0 +1,307 @@
+"""Chunked + slice-batched execution of sliced contraction programs.
+
+The whole-path-in-one-``fori_loop`` executor (:mod:`tnc_tpu.ops.sliced`)
+compiles one XLA program containing every step; on very large networks
+(Sycamore-53 class) the TPU compiler struggles with a 250-step body. This
+module trades one big compile for K small ones:
+
+- the program is **split into chunks** of at most ``chunk_steps`` steps,
+  each compiled separately (compile cost scales with the chunk, not the
+  whole program);
+- slices are processed in **batches of B** via ``jax.vmap`` over each
+  chunk: every matmul gains a leading batch axis, so narrow per-slice
+  matmuls become batched matmuls that keep the MXU busy, and host
+  dispatch overhead is divided by B;
+- batch results are summed on device and accumulated across batches.
+
+Memory: a batch keeps B copies of each live intermediate, so B must be
+chosen such that B x (peak live bytes of a chunk boundary) fits in HBM —
+slicing deeper (smaller per-slice peak) and batching wider is the
+TPU-friendly operating point.
+
+Per-step contraction kernels are shared with the other executors
+(``backends.apply_step`` / ``split_complex.apply_step_split``); compiled
+chunk functions are cached by program signature so repeated executions
+(benchmark reps, amplitude sweeps) compile nothing.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Any, Sequence
+
+import numpy as np
+
+from tnc_tpu.ops.backends import apply_step, place_buffers
+from tnc_tpu.ops.program import ContractionProgram, PairStep
+from tnc_tpu.ops.sliced import SlicedProgram, index_buffer
+
+
+@dataclass(frozen=True)
+class ProgramChunk:
+    steps: tuple[PairStep, ...]
+    in_slots: tuple[int, ...]  # slots read by this chunk (alive at entry)
+    out_slots: tuple[int, ...]  # slots written here and still alive at exit
+
+
+def split_program(
+    program: ContractionProgram, chunk_steps: int
+) -> list[ProgramChunk]:
+    """Split ``program.steps`` into chunks with entry/exit slot lists.
+
+    A slot is alive at step ``i`` if it will still be *read* at some step
+    >= ``i`` (or it is the result slot). Pass-through slots that a chunk
+    neither reads nor writes stay host-side and never enter the jit.
+    """
+    steps = program.steps
+    n = len(steps)
+    last_read: dict[int, int] = {program.result_slot: n}
+    for i, st in enumerate(steps):
+        last_read[st.lhs] = max(last_read.get(st.lhs, -1), i)
+        last_read[st.rhs] = max(last_read.get(st.rhs, -1), i)
+    last_read[program.result_slot] = n
+
+    chunks: list[ProgramChunk] = []
+    for a in range(0, n, chunk_steps):
+        b = min(a + chunk_steps, n)
+        read_here: list[int] = []
+        written: set[int] = set()
+        seen: set[int] = set()
+        for i in range(a, b):
+            st = steps[i]
+            # a read is "from outside" if the slot wasn't written earlier
+            # in this same chunk
+            for slot in (st.lhs, st.rhs):
+                if slot not in written and slot not in seen:
+                    read_here.append(slot)
+                    seen.add(slot)
+            written.add(st.lhs)
+        outs = tuple(
+            sorted(s for s in written if last_read.get(s, -1) >= b)
+        )
+        chunks.append(ProgramChunk(steps[a:b], tuple(read_here), outs))
+    return chunks
+
+
+def _run_chunk(xp, chunk: ProgramChunk, state: dict[int, Any]) -> None:
+    for step in chunk.steps:
+        state[step.lhs] = apply_step(xp, state[step.lhs], state[step.rhs], step)
+        del state[step.rhs]
+
+
+def _run_chunk_split(
+    xp, chunk: ProgramChunk, state: dict[int, Any], precision
+) -> None:
+    from tnc_tpu.ops.split_complex import apply_step_split
+
+    for step in chunk.steps:
+        state[step.lhs] = apply_step_split(
+            xp, state[step.lhs], state[step.rhs], step, precision
+        )
+        del state[step.rhs]
+
+
+# compiled plan cache: key -> (chunks, chunk_fns, gather, reduce_batch)
+_PLAN_CACHE: "OrderedDict[tuple, tuple]" = OrderedDict()
+_PLAN_CACHE_MAX = 64
+
+
+def _compiled_plan(
+    sp: SlicedProgram,
+    batch: int,
+    chunk_steps: int,
+    split_complex: bool,
+    precision: str | None,
+):
+    import jax
+    import jax.numpy as jnp
+
+    key = (
+        sp.signature(),
+        batch,
+        chunk_steps,
+        split_complex,
+        precision,
+    )
+    hit = _PLAN_CACHE.get(key)
+    if hit is not None:
+        _PLAN_CACHE.move_to_end(key)
+        return hit
+
+    chunks = split_program(sp.program, chunk_steps)
+
+    # which slots carry a batch axis (sliced leaves + anything computed
+    # from a batched slot)
+    batched: set[int] = {
+        slot for slot, info in enumerate(sp.slot_slices) if info
+    }
+    batched_after_chunk: list[set[int]] = []
+    current = set(batched)
+    for chunk in chunks:
+        for step in chunk.steps:
+            if step.lhs in current or step.rhs in current:
+                current.add(step.lhs)
+        batched_after_chunk.append(set(current))
+
+    def gather_slot(arr, info, idx_batch):
+        """arr: full buffer; idx_batch: [B, n_sliced_legs] -> [B, ...]."""
+        return jax.vmap(lambda idx: index_buffer(jnp, arr, info, idx))(
+            idx_batch
+        )
+
+    def gather_pair(pair, info, idx_batch):
+        return (
+            gather_slot(pair[0], info, idx_batch),
+            gather_slot(pair[1], info, idx_batch),
+        )
+
+    chunk_fns = []
+    for ci, chunk in enumerate(chunks):
+        pre_batched = batched if ci == 0 else batched_after_chunk[ci - 1]
+        in_axes_spec = []
+        for slot in chunk.in_slots:
+            ax = 0 if slot in pre_batched else None
+            in_axes_spec.append((ax, ax) if split_complex else ax)
+        post_batched = batched_after_chunk[ci]
+        out_axes_spec = []
+        for slot in chunk.out_slots:
+            ax = 0 if slot in post_batched else None
+            out_axes_spec.append((ax, ax) if split_complex else ax)
+
+        def single(ins, _chunk=chunk):
+            state = dict(zip(_chunk.in_slots, ins))
+            if split_complex:
+                _run_chunk_split(jnp, _chunk, state, precision)
+            else:
+                _run_chunk(jnp, _chunk, state)
+            return tuple(state[s] for s in _chunk.out_slots)
+
+        def _has_axis(spec):
+            return any(
+                (s is not None)
+                if not isinstance(s, tuple)
+                else any(x is not None for x in s)
+                for s in spec
+            )
+
+        if _has_axis(in_axes_spec):
+            fn = jax.jit(
+                jax.vmap(
+                    single,
+                    in_axes=(tuple(in_axes_spec),),
+                    out_axes=tuple(out_axes_spec),
+                )
+            )
+        else:
+            # chunk touches no sliced data: identical for every slice,
+            # run it unbatched (its outputs are unbatched too)
+            fn = jax.jit(single)
+        chunk_fns.append(fn)
+
+    result_shape = sp.program.result_shape
+
+    if split_complex:
+
+        @jax.jit
+        def reduce_batch(acc, out_pair):
+            re = jnp.sum(out_pair[0], axis=0).reshape(result_shape)
+            im = jnp.sum(out_pair[1], axis=0).reshape(result_shape)
+            return acc[0] + re, acc[1] + im
+
+    else:
+
+        @jax.jit
+        def reduce_batch(acc, out):
+            return acc + jnp.sum(out, axis=0).reshape(result_shape)
+
+    gather = jax.jit(
+        lambda full, idx: [
+            (
+                gather_pair(full[slot], info, idx)
+                if split_complex
+                else gather_slot(full[slot], info, idx)
+            )
+            if info
+            else full[slot]
+            for slot, info in enumerate(sp.slot_slices)
+        ]
+    )
+
+    plan = (chunks, chunk_fns, gather, reduce_batch)
+    _PLAN_CACHE[key] = plan
+    while len(_PLAN_CACHE) > _PLAN_CACHE_MAX:
+        _PLAN_CACHE.popitem(last=False)
+    return plan
+
+
+def execute_sliced_batched_jax(
+    sp: SlicedProgram,
+    arrays: Sequence[Any],
+    batch: int = 8,
+    chunk_steps: int = 64,
+    split_complex: bool = True,
+    precision: str | None = "float32",
+    dtype: str = "complex64",
+    device=None,
+):
+    """Run a sliced program as chunked, slice-batched jitted calls.
+
+    Returns the accumulated result: a complex ndarray (or a
+    (real, imag) pair is combined before returning). ``batch`` is
+    clamped to the largest divisor of the slice count <= the request.
+    """
+    import jax.numpy as jnp
+
+    num = sp.slicing.num_slices
+    if num <= 1:
+        raise ValueError(
+            "execute_sliced_batched_jax expects a sliced program; "
+            "use JaxBackend.execute for unsliced networks"
+        )
+    batch = max(1, min(batch, num))
+    while num % batch:  # largest divisor <= requested (dims are tiny)
+        batch -= 1
+
+    chunks, chunk_fns, gather, reduce_batch = _compiled_plan(
+        sp, batch, chunk_steps, split_complex, precision
+    )
+
+    # per-slot slice indices, shape [num, n_sliced_legs]
+    dims = sp.slicing.dims
+    all_indices = np.zeros((num, len(dims)), dtype=np.int32)
+    s = np.arange(num)
+    for pos in range(len(dims) - 1, -1, -1):
+        all_indices[:, pos] = s % dims[pos]
+        s //= dims[pos]
+
+    device_full = place_buffers(arrays, dtype, split_complex, device)
+
+    part_dtype = "float64" if "128" in str(dtype) else "float32"
+    result_shape = sp.program.result_shape
+    if split_complex:
+        acc = (
+            jnp.zeros(result_shape, dtype=part_dtype),
+            jnp.zeros(result_shape, dtype=part_dtype),
+        )
+    else:
+        acc = jnp.zeros(result_shape, dtype=dtype)
+
+    for start in range(0, num, batch):
+        idx = jnp.asarray(all_indices[start : start + batch])
+        sliced = gather(device_full, idx)
+        state = dict(enumerate(sliced))
+        for chunk, fn in zip(chunks, chunk_fns):
+            ins = tuple(state[s] for s in chunk.in_slots)
+            outs = fn(ins)
+            for slot, buf in zip(chunk.out_slots, outs):
+                state[slot] = buf
+            for step in chunk.steps:
+                state.pop(step.rhs, None)
+        acc = reduce_batch(acc, state[sp.program.result_slot])
+
+    if split_complex:
+        from tnc_tpu.ops.split_complex import combine_array
+
+        return combine_array(acc[0], acc[1])
+    return np.asarray(acc)

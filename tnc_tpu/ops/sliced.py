@@ -88,6 +88,20 @@ def build_sliced_program(
     return SlicedProgram(program, slicing, tuple(slot_slices))
 
 
+def index_buffer(xp, arr, info, indices):
+    """Pin ``arr``'s sliced axes to the given slice ``indices``.
+
+    ``info`` is the slot's ``slot_slices`` entry: ((axis, slice_pos), …)
+    ordered by axis. Shared by the on-device loop and chunked executors.
+    """
+    view = arr
+    offset = 0
+    for axis, pos in info:
+        view = xp.take(view, indices[pos], axis=axis - offset)
+        offset += 1
+    return view
+
+
 def _slice_indices(slicing: Slicing, s: int) -> list[int]:
     """Mixed-radix decomposition of flat slice id ``s``."""
     idx = []
@@ -116,14 +130,10 @@ def execute_sliced_numpy(
         num = min(num, max_slices)
     for s in range(num):
         indices = _slice_indices(sp.slicing, s)
-        buffers: list[Any] = []
-        for arr, info in zip(full, sp.slot_slices):
-            view = arr
-            offset = 0
-            for axis, pos in info:
-                view = np.take(view, indices[pos], axis=axis - offset)
-                offset += 1
-            buffers.append(view)
+        buffers = [
+            index_buffer(np, arr, info, indices)
+            for arr, info in zip(full, sp.slot_slices)
+        ]
         acc = acc + _run_steps(np, sp.program, buffers)
     return acc
 
@@ -151,14 +161,6 @@ def make_jax_sliced_fn(
         idx.reverse()
         return idx
 
-    def index_buffer(arr, info, indices):
-        view = arr
-        offset = 0
-        for axis, pos in info:
-            view = jnp.take(view, indices[pos], axis=axis - offset)
-            offset += 1
-        return view
-
     if split_complex:
         from tnc_tpu.ops.split_complex import run_steps_split
 
@@ -167,8 +169,8 @@ def make_jax_sliced_fn(
                 indices = decompose(s)
                 buffers = [
                     (
-                        index_buffer(re, info, indices),
-                        index_buffer(im, info, indices),
+                        index_buffer(jnp, re, info, indices),
+                        index_buffer(jnp, im, info, indices),
                     )
                     for (re, im), info in zip(full_buffers, sp.slot_slices)
                 ]
@@ -188,7 +190,7 @@ def make_jax_sliced_fn(
             def body(s, acc):
                 indices = decompose(s)
                 buffers = [
-                    index_buffer(arr, info, indices)
+                    index_buffer(jnp, arr, info, indices)
                     for arr, info in zip(full_buffers, sp.slot_slices)
                 ]
                 return acc + _run_steps(jnp, sp.program, list(buffers))

@@ -56,6 +56,17 @@ def _prep(xp, part, pre: tuple[int, ...], mperm: tuple[int, ...], mat: tuple[int
     return xp.transpose(part.reshape(pre), mperm).reshape(mat)
 
 
+def apply_step_split(xp, apair, bpair, step, precision=None):
+    """Split-complex analogue of ``backends.apply_step``: one pairwise
+    contraction of (real, imag) pairs via three real matmuls. The single
+    source of truth shared by every split-mode executor."""
+    ar = _prep(xp, apair[0], step.lhs_pre, step.lhs_mperm, step.lhs_mat)
+    ai = _prep(xp, apair[1], step.lhs_pre, step.lhs_mperm, step.lhs_mat)
+    br = _prep(xp, bpair[0], step.rhs_pre, step.rhs_mperm, step.rhs_mat)
+    bi = _prep(xp, bpair[1], step.rhs_pre, step.rhs_mperm, step.rhs_mat)
+    return gauss_matmul(xp, ar, ai, br, bi, precision)
+
+
 def run_steps_split(
     xp,
     program: ContractionProgram,
@@ -66,14 +77,9 @@ def run_steps_split(
     (real, imag) pairs and the result is a pair. Intermediates stay
     matrix-shaped between steps."""
     for step in program.steps:
-        ar, ai = buffers[step.lhs]
-        br, bi = buffers[step.rhs]
-        ar = _prep(xp, ar, step.lhs_pre, step.lhs_mperm, step.lhs_mat)
-        ai = _prep(xp, ai, step.lhs_pre, step.lhs_mperm, step.lhs_mat)
-        br = _prep(xp, br, step.rhs_pre, step.rhs_mperm, step.rhs_mat)
-        bi = _prep(xp, bi, step.rhs_pre, step.rhs_mperm, step.rhs_mat)
-        re, im = gauss_matmul(xp, ar, ai, br, bi, precision)
-        buffers[step.lhs] = (re, im)
+        buffers[step.lhs] = apply_step_split(
+            xp, buffers[step.lhs], buffers[step.rhs], step, precision
+        )
         buffers[step.rhs] = None
     re, im = buffers[program.result_slot]
     return re.reshape(program.result_shape), im.reshape(program.result_shape)
