@@ -1,0 +1,239 @@
+"""Tree-surgery balancing: shift mechanics and scheme behavior
+(mirrors ``balancing.rs:631-779`` fixtures and
+``balancing_schemes.rs`` semantics)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from tnc_tpu.contractionpath.balancing import (
+    BalanceSettings,
+    BalancingScheme,
+    _apply_shift,
+    _find_rebalance_node,
+    _PartitionForest,
+    _Shift,
+    balance_partitions_iter,
+)
+from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+
+
+BOND_DIMS = {
+    0: 27, 1: 18, 2: 12, 3: 15, 4: 5, 5: 3, 6: 18, 7: 22, 8: 45, 9: 65, 10: 5,
+}
+
+
+def _leaf(legs):
+    return LeafTensor(list(legs), [BOND_DIMS[l] for l in legs])
+
+
+@pytest.fixture()
+def complex_network():
+    """The reference's 6-tensor ``setup_complex`` network
+    (``balancing.rs:630-659``)."""
+    return CompositeTensor(
+        [
+            _leaf([4, 3, 2]),
+            _leaf([0, 1, 3, 2]),
+            _leaf([4, 5, 6]),
+            _leaf([6, 8, 9]),
+            _leaf([10, 8, 9]),
+            _leaf([5, 1, 0]),
+        ]
+    )
+
+
+def _make_forest(network, blocks):
+    """Forest with one subtree per block (block = list of global tensor
+    indices); returns (forest, [root ids])."""
+    from tnc_tpu.contractionpath.balancing import _characterize_from_leaves
+
+    forest = _PartitionForest(network)
+    data = []
+    for block in blocks:
+        leaves = [forest.leaf_of[g] for g in block]
+        data.append(_characterize_from_leaves(forest, leaves))
+    return forest, data
+
+
+def test_shift_leaf_node_between_subtrees(complex_network):
+    """Reference ``test_shift_leaf_node_between_subtrees``: moving leaf 3
+    out of partition {2,3,4} into {0,1,5} leaves {2,4} / {0,1,3,5}."""
+    forest, data = _make_forest(complex_network, [[0, 1, 5], [2, 3, 4]])
+    receiver, donor = data
+    moved = [forest.leaf_of[3]]
+    new_donor, new_receiver = _apply_shift(
+        forest, _Shift(donor.id, receiver.id, moved)
+    )
+    donor_globals = sorted(
+        forest.nodes[l].leaf_index for l in forest.leaf_ids(new_donor.id)
+    )
+    receiver_globals = sorted(
+        forest.nodes[l].leaf_index for l in forest.leaf_ids(new_receiver.id)
+    )
+    assert donor_globals == [2, 4]
+    assert receiver_globals == [0, 1, 3, 5]
+    # both re-pathed subtrees contract all their leaves
+    assert len(new_donor.contraction) == 1
+    assert len(new_receiver.contraction) == 3
+    # externals match a direct fold
+    want = LeafTensor()
+    for g in receiver_globals:
+        want = want ^ complex_network.tensors[g]
+    assert set(new_receiver.local_tensor.legs) == set(want.legs)
+
+
+def test_shift_subtree_between_subtrees(complex_network):
+    """Reference ``test_shift_subtree_between_subtrees``: moving the
+    {2,3} subtree leaves donor as the single leaf 4."""
+    forest, data = _make_forest(complex_network, [[0, 1, 5], [2, 3, 4]])
+    receiver, donor = data
+    moved = [forest.leaf_of[2], forest.leaf_of[3]]
+    new_donor, new_receiver = _apply_shift(
+        forest, _Shift(donor.id, receiver.id, moved)
+    )
+    donor_globals = [
+        forest.nodes[l].leaf_index for l in forest.leaf_ids(new_donor.id)
+    ]
+    receiver_globals = sorted(
+        forest.nodes[l].leaf_index for l in forest.leaf_ids(new_receiver.id)
+    )
+    assert donor_globals == [4]
+    assert new_donor.contraction == []
+    assert new_donor.flop_cost == 0.0
+    assert receiver_globals == [0, 1, 2, 3, 5]
+
+
+def test_shift_rejects_emptying_donor(complex_network):
+    forest, data = _make_forest(complex_network, [[0, 1, 5], [2, 3, 4]])
+    receiver, donor = data
+    moved = [forest.leaf_of[g] for g in (2, 3, 4)]
+    with pytest.raises(ValueError):
+        _apply_shift(forest, _Shift(donor.id, receiver.id, moved))
+
+
+def test_find_rebalance_node_exact():
+    """Reference ``test_find_rebalance_node``: shared-leg-count objective
+    picks node 2 with objective 2."""
+    dims = {0: 2, 1: 1, 2: 3, 3: 5, 4: 3, 5: 8, 6: 7}
+
+    def leaf(legs):
+        return LeafTensor(list(legs), [dims[l] for l in legs])
+
+    larger = {0: leaf([0, 1, 2]), 1: leaf([1, 2, 3]), 2: leaf([3, 4, 5])}
+    smaller = {3: leaf([4, 5, 6])}
+
+    def shared_legs(a, b):
+        return float(len(set(a.legs) & set(b.legs)))
+
+    node, cost = _find_rebalance_node(None, None, larger, smaller, shared_legs)
+    assert node == 2
+    assert cost == 2.0
+
+
+def test_find_rebalance_node_weighted_random_picks_top():
+    dims = {0: 2, 1: 1, 2: 3, 3: 5, 4: 3, 5: 8, 6: 7}
+
+    def leaf(legs):
+        return LeafTensor(list(legs), [dims[l] for l in legs])
+
+    larger = {0: leaf([0, 1, 2]), 1: leaf([1, 2, 6]), 2: leaf([3, 4, 5])}
+    smaller = {3: leaf([4, 5, 6])}
+
+    def shared_legs(a, b):
+        return float(len(set(a.legs) & set(b.legs)))
+
+    # top-2 by objective are nodes 2 (obj 2) and 1 (obj 1): a weighted
+    # random pick must come from those two
+    picks = set()
+    for seed in range(8):
+        node, cost = _find_rebalance_node(
+            random.Random(seed), 2, larger, smaller, shared_legs
+        )
+        picks.add(node)
+        assert node in (1, 2)
+    assert 2 in picks  # the top node is picked with the highest weight
+
+
+def test_subtree_tensor_map_height_limit(complex_network):
+    """height_limit=1 keeps only intermediates whose children are both
+    leaves (``contraction_tree.rs:426-431``)."""
+    forest, data = _make_forest(complex_network, [[0, 1, 5], [2, 3, 4]])
+    root = data[1].id  # partition over tensors 2,3,4 (3 leaves, 2 internals)
+    unlimited = forest.subtree_tensor_map(root, None)
+    assert len(unlimited) == 5  # 3 leaves + 2 intermediates
+    limited = forest.subtree_tensor_map(root, 1)
+    internal_ids = [i for i in limited if not forest.nodes[i].is_leaf]
+    assert len(internal_ids) == 1  # only the leaf-leaf pair node
+    nd = forest.nodes[internal_ids[0]]
+    assert forest.nodes[nd.left].is_leaf and forest.nodes[nd.right].is_leaf
+    # height_limit=0 is equivalent to leaves only (Tensors method)
+    zero = forest.subtree_tensor_map(root, 0)
+    assert all(forest.nodes[i].is_leaf for i in zero)
+
+
+@pytest.fixture(scope="module")
+def circuit_network():
+    from tnc_tpu.builders.connectivity import ConnectivityLayout
+    from tnc_tpu.builders.random_circuit import random_circuit
+
+    rng = np.random.default_rng(8)
+    return random_circuit(10, 5, 0.9, 0.8, rng, ConnectivityLayout.LINE)
+
+
+@pytest.mark.parametrize(
+    "scheme",
+    [
+        BalancingScheme.BEST_WORST,
+        BalancingScheme.TENSOR,
+        BalancingScheme.TENSORS,
+        BalancingScheme.ALTERNATING_TENSORS,
+        BalancingScheme.INTERMEDIATE_TENSORS,
+        BalancingScheme.ALTERNATING_INTERMEDIATE_TENSORS,
+        BalancingScheme.ALTERNATING_TREE_TENSORS,
+    ],
+)
+def test_every_scheme_balances_and_contracts(circuit_network, scheme):
+    """All 7 schemes run, return a valid history, and the balanced
+    network still contracts to the oracle value."""
+    from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+    from tnc_tpu.tensornetwork.contraction import contract_tensor_network
+    from tnc_tpu.tensornetwork.partitioning import find_partitioning
+
+    initial = find_partitioning(circuit_network, 4)
+    settings = BalanceSettings(iterations=5, scheme=scheme, height_limit=2)
+    best_iter, best_tn, best_path, history = balance_partitions_iter(
+        circuit_network, initial, settings, random.Random(0)
+    )
+    assert len(history) >= 1
+    assert min(history) == history[best_iter]
+
+    got = complex(
+        contract_tensor_network(best_tn, best_path).data.into_data()
+    )
+    flat = CompositeTensor(list(circuit_network.tensors))
+    res = Greedy(OptMethod.GREEDY).find_path(flat)
+    want = complex(
+        contract_tensor_network(flat, res.replace_path()).data.into_data()
+    )
+    assert got == pytest.approx(want, rel=1e-9, abs=1e-12), scheme
+
+    # the returned path really has the recorded best cost: nested paths
+    # must pair with the snapshot's child tensor order (regression for
+    # the leaf-order/path mismatch)
+    from tnc_tpu.contractionpath.contraction_cost import (
+        communication_path_op_costs,
+        contract_path_cost,
+    )
+
+    latencies = []
+    children = []
+    for i, child in enumerate(best_tn.tensors):
+        cost, _ = contract_path_cost(child.tensors, best_path.nested[i], True)
+        latencies.append(cost)
+        children.append(child.external_tensor())
+    (parallel, _), _ = communication_path_op_costs(
+        children, best_path.toplevel, True, latencies
+    )
+    assert parallel == pytest.approx(history[best_iter], rel=1e-9), scheme

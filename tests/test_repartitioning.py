@@ -111,6 +111,51 @@ def test_subtree_leaves_collection():
     assert _subtree_leaves(path, 0) == {0, 1}
 
 
+def test_cached_evaluate_matches_full(network, initial):
+    """The per-block caches (externals, local costs) maintained by moves
+    must score identically to a from-scratch evaluation of the same
+    partitioning with the same local paths."""
+    from tnc_tpu.contractionpath.repartitioning.simulated_annealing import (
+        evaluate_partitioning_with_paths,
+    )
+
+    model = IntermediatePartitioningModel(network)
+    solution = model.initial_solution(initial)
+    rng = random.Random(7)
+    for step in range(25):
+        solution = model.generate_trial_solution(solution, rng)
+        cached = model.evaluate(solution, random.Random(step))
+        full = evaluate_partitioning_with_paths(
+            network,
+            solution[0],
+            solution[2],
+            CommunicationScheme.GREEDY,
+            None,
+            random.Random(step),
+        )
+        assert cached == pytest.approx(full, rel=1e-12), step
+
+
+def test_sa_chains_worker_count_invariant(network, initial):
+    """Chains are pure functions of (seed, state, temperature): pooled
+    and inline execution must produce identical results (the reference's
+    fixed-thread-count reproducibility contract)."""
+    model = NaivePartitioningModel(network, 4)
+    results = []
+    for workers in (1, 2):
+        rng = random.Random(11)
+        best, score = balance_partitions(
+            model,
+            model.initial_solution(initial),
+            rng,
+            n_trials=2,
+            n_workers=workers,
+            max_rounds=3,
+        )
+        results.append((tuple(best), score))
+    assert results[0] == results[1]
+
+
 def test_genetic_balance(network, initial):
     rng = random.Random(3)
     score0 = evaluate_partitioning(

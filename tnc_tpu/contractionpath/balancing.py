@@ -1,57 +1,64 @@
-"""Greedy iterative partition balancing.
+"""Greedy iterative partition balancing by contraction-tree surgery.
 
 Mirror of ``tnc/src/contractionpath/contraction_tree/balancing.rs`` (the
-``balance_partitions_iter`` entry point, ``:98-210``) and its scheme
-catalogue (``balancing/balancing_schemes.rs:12-68``): iteratively shift
-leaf tensors or whole subtrees between partitions to minimize the
-critical-path cost of the partitioned contraction, re-running the greedy
-finder on the two touched partitions after every shift and re-scheduling
-the fan-in with a :class:`CommunicationScheme`.
+``balance_partitions_iter`` entry point, ``:98-210``; node shifting
+``:517-613``) and its scheme catalogue
+(``balancing/balancing_schemes.rs:83-613``): each iteration picks a
+donor/receiver pair of partition subtrees, selects the leaf *or
+intermediate* node whose move maximizes the objective, detaches that
+node's leaves from the donor subtree, re-runs Greedy on both touched
+partitions, rebuilds their subtrees in the tree, re-schedules the fan-in
+with a :class:`CommunicationScheme`, and scores the critical path.
 
-Schemes:
+The tree here is a **forest of partition subtrees** over persistent leaf
+nodes (leaf node ids survive rebuilds, internal nodes are replaced —
+exactly the reference's ``remove_subtree`` + ``add_path_as_subtree``
+behavior, ``contraction_tree.rs:160-222``). The fan-in levels above the
+partition roots are represented as the communication path itself rather
+than as tree nodes; the reference rebuilds those nodes every iteration
+anyway (``replace_communication_path``, ``contraction_tree.rs:234-258``).
+Divergence from the reference (deliberate): the returned path's toplevel
+is the *recomputed* communication path of the best iteration — the
+reference returns the original toplevel while scoring with the new one
+(``balancing.rs:192-196``).
 
-- ``BEST_WORST`` — move the best-scoring leaf from the most expensive
-  partition to the least expensive one.
-- ``TENSOR`` — move the single best leaf tensor from the critical
-  partition to the best target partition.
-- ``TENSORS`` — additionally consider moving connected leaf *pairs*
-  (tensors sharing a leg) in one shift.
-- ``ALTERNATING_TENSORS`` — alternate donor between the most expensive
-  and the most memory-heavy partition.
-- ``INTERMEDIATE_TENSORS(height_limit)`` — move an intermediate subtree
-  (bounded leaf count) instead of single leaves.
-- ``ALTERNATING_INTERMEDIATE_TENSORS`` / ``ALTERNATING_TREE_TENSORS`` —
-  alternating donor selection for subtree moves.
+Schemes (``balancing_schemes.rs:12-68``):
 
-The cost history of every iteration is returned along with the best
-iteration's network and path, as in the reference.
+- ``BEST_WORST`` — best leaf of the costliest subtree vs leaves of the
+  cheapest subtree.
+- ``TENSOR`` — best leaf of the costliest subtree vs *all nodes* of every
+  other subtree (receiver chosen by objective).
+- ``TENSORS`` — the ``TENSOR`` shift, plus the symmetric shift into the
+  cheapest subtree from the best middle donor.
+- ``ALTERNATING_TENSORS`` — odd iterations: leaf out of the costliest
+  subtree (receiver = externals only); even: leaf into the cheapest.
+- ``INTERMEDIATE_TENSORS`` — like ``TENSORS`` but donor candidates are
+  height-limited *intermediate* nodes: whole subtrees move at once.
+- ``ALTERNATING_INTERMEDIATE_TENSORS`` — odd/even halves of the above.
+- ``ALTERNATING_TREE_TENSORS`` — intermediate moves scored against the
+  receiver's external only, with a required positive objective.
 """
 
 from __future__ import annotations
 
-import math
+import logging
 import random
 from dataclasses import dataclass, field
 from typing import Callable, Sequence
 
 from tnc_tpu.contractionpath.communication_schemes import CommunicationScheme
 from tnc_tpu.contractionpath.contraction_cost import (
-    compute_memory_requirements,
-    contract_path_cost,
-    contract_size_tensors_bytes,
+    communication_path_op_costs,
 )
 from tnc_tpu.contractionpath.contraction_path import ContractionPath
-from tnc_tpu.contractionpath.repartitioning import compute_solution
-from tnc_tpu.contractionpath.repartitioning.simulated_annealing import (
-    _local_greedy_path,
-    _subtree_leaves,
-)
-from tnc_tpu.tensornetwork.partitioning import partition_tensor_network
+from tnc_tpu.contractionpath.paths.greedy import Greedy, OptMethod
 from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+
+logger = logging.getLogger(__name__)
 
 
 class BalancingScheme:
-    """Scheme tags; ``INTERMEDIATE_TENSORS`` carries a height limit."""
+    """Scheme tags; the intermediate schemes honor ``height_limit``."""
 
     BEST_WORST = "best_worst"
     TENSOR = "tensor"
@@ -62,12 +69,11 @@ class BalancingScheme:
     ALTERNATING_TREE_TENSORS = "alternating_tree_tensors"
 
 
-def _default_objective(
-    shifted: LeafTensor, target_external: LeafTensor
-) -> float:
-    """Memory-reduction objective: growth of the target's external tensor
-    (lower is better)."""
-    return (shifted ^ target_external).size() - target_external.size()
+def _default_objective(shifted: LeafTensor, target: LeafTensor) -> float:
+    """Memory-reduction objective, maximized
+    (``benchmark/src/main.rs:689-691``): how much total size shrinks when
+    ``shifted`` merges into ``target``."""
+    return shifted.size() + target.size() - (shifted ^ target).size()
 
 
 @dataclass
@@ -76,8 +82,11 @@ class BalanceSettings:
 
     iterations: int = 20
     scheme: str = BalancingScheme.BEST_WORST
-    height_limit: int = 4  # for intermediate-subtree schemes
+    height_limit: int | None = 4  # for intermediate-subtree schemes
     communication_scheme: CommunicationScheme = CommunicationScheme.GREEDY
+    # Peak memory bound in ELEMENTS over the fan-in of partition externals
+    # (the reference compares ``communication_path_op_costs``'s mem_cost
+    # and stops balancing when exceeded, ``balancing.rs:198-200``)
     memory_limit: float | None = None
     objective: Callable[[LeafTensor, LeafTensor], float] = field(
         default=_default_objective
@@ -85,127 +94,522 @@ class BalanceSettings:
     weighted_random_top: int | None = None  # pick randomly among top-N moves
 
 
+# ---------------------------------------------------------------------------
+# Partition forest
+
+
 @dataclass
-class _State:
-    partitioning: list[int]
-    local_paths: list[list[tuple[int, int]]]
-    num_partitions: int
+class _BNode:
+    id: int
+    left: int = -1
+    right: int = -1
+    parent: int = -1
+    legs: frozenset = frozenset()
+    leaf_index: int | None = None  # global tensor index for leaves
+
+    @property
+    def is_leaf(self) -> bool:
+        return self.left < 0
 
 
-def _partition_cost(
-    tensor: CompositeTensor, state: _State, p: int
-) -> float:
-    members = [
-        t for t, b in zip(tensor.tensors, state.partitioning) if b == p
+class _PartitionForest:
+    """One binary subtree per partition over persistent leaf nodes.
+
+    Leaf node ids survive subtree rebuilds; internal node ids are fresh
+    per rebuild (``contraction_tree.rs:160-222`` semantics).
+    """
+
+    def __init__(self, tensor: CompositeTensor):
+        self.tensor = tensor
+        self.nodes: dict[int, _BNode] = {}
+        self._next_id = 0
+        # leaf node id per global tensor index
+        self.leaf_of: list[int] = []
+        for g, t in enumerate(tensor.tensors):
+            node = _BNode(
+                id=self._fresh(), legs=frozenset(t.legs), leaf_index=g
+            )
+            self.nodes[node.id] = node
+            self.leaf_of.append(node.id)
+
+    def _fresh(self) -> int:
+        self._next_id += 1
+        return self._next_id - 1
+
+    def build_subtree(
+        self, leaf_node_ids: Sequence[int], local_path: Sequence[tuple[int, int]]
+    ) -> int:
+        """Create internal nodes for ``local_path`` (replace-path over the
+        positions of ``leaf_node_ids``); returns the subtree root id."""
+        if not leaf_node_ids:
+            raise ValueError("cannot build a subtree over zero leaves")
+        slots = list(leaf_node_ids)
+        for nid in slots:
+            self.nodes[nid].parent = -1
+        for a, b in local_path:
+            na, nb = slots[a], slots[b]
+            node = _BNode(
+                id=self._fresh(),
+                left=na,
+                right=nb,
+                legs=self.nodes[na].legs ^ self.nodes[nb].legs,
+            )
+            self.nodes[node.id] = node
+            self.nodes[na].parent = node.id
+            self.nodes[nb].parent = node.id
+            slots[a] = node.id
+        # replace-path: the result replaces the last pair's left slot
+        return slots[local_path[-1][0]] if local_path else slots[0]
+
+    def remove_internal(self, root: int) -> None:
+        """Drop the internal nodes of ``root``'s subtree, keep leaves."""
+        stack = [root]
+        while stack:
+            i = stack.pop()
+            nd = self.nodes[i]
+            if nd.is_leaf:
+                nd.parent = -1
+                continue
+            stack.append(nd.left)
+            stack.append(nd.right)
+            del self.nodes[i]
+
+    def leaf_ids(self, node_id: int) -> list[int]:
+        out: list[int] = []
+        stack = [node_id]
+        while stack:
+            i = stack.pop()
+            nd = self.nodes[i]
+            if nd.is_leaf:
+                out.append(i)
+            else:
+                stack.append(nd.right)
+                stack.append(nd.left)
+        out.reverse()
+        return out
+
+    def node_tensor(self, node_id: int) -> LeafTensor:
+        """The (symbolic) tensor a node represents, from its legs."""
+        nd = self.nodes[node_id]
+        if nd.is_leaf:
+            return self.tensor.tensors[nd.leaf_index]
+        out = LeafTensor()
+        for lid in self.leaf_ids(node_id):
+            out = out ^ self.tensor.tensors[self.nodes[lid].leaf_index]
+        return out
+
+    def leaf_node_tensor_map(self, root: int) -> dict[int, LeafTensor]:
+        """``populate_leaf_node_tensor_map``
+        (``contraction_tree.rs:476-489``)."""
+        return {
+            lid: self.tensor.tensors[self.nodes[lid].leaf_index]
+            for lid in self.leaf_ids(root)
+        }
+
+    def subtree_tensor_map(
+        self, root: int, height_limit: int | None
+    ) -> dict[int, LeafTensor]:
+        """All leaf + intermediate node tensors of ``root``'s subtree, an
+        intermediate included only when both children's heights are below
+        ``height_limit`` (``contraction_tree.rs:393-465``)."""
+        out: dict[int, LeafTensor] = {}
+
+        def walk(i: int) -> tuple[LeafTensor, int]:
+            nd = self.nodes[i]
+            if nd.is_leaf:
+                t = self.tensor.tensors[nd.leaf_index]
+                out[i] = t
+                return t, 0
+            t1, h1 = walk(nd.left)
+            t2, h2 = walk(nd.right)
+            t12 = t1 ^ t2
+            if height_limit is None or (h1 < height_limit and h2 < height_limit):
+                out[i] = t12
+            return t12, max(h1, h2) + 1
+
+        walk(root)
+        return out
+
+
+@dataclass
+class _PartitionData:
+    """Per-partition bookkeeping (``balancing.rs:88-96``)."""
+
+    id: int  # subtree root node id
+    flop_cost: float
+    mem_cost: float
+    contraction: list[tuple[int, int]]  # local replace path over `leaves`
+    local_tensor: LeafTensor  # external tensor of the partition
+    # leaf node ids in the exact order `contraction` was built over —
+    # tree-traversal order is a different permutation, so the path must
+    # always be paired with this list
+    leaves: list[int] = field(default_factory=list)
+
+
+@dataclass
+class _Shift:
+    """A move of leaves between subtrees (``balancing_schemes.rs:72-80``)."""
+
+    from_subtree_id: int
+    to_subtree_id: int
+    moved_leaf_ids: list[int]
+
+
+# ---------------------------------------------------------------------------
+# Node selection
+
+
+def _find_rebalance_node(
+    rng: random.Random | None,
+    weighted_random_top: int | None,
+    larger_nodes: dict[int, LeafTensor],
+    smaller_nodes: dict[int, LeafTensor],
+    objective: Callable[[LeafTensor, LeafTensor], float],
+) -> tuple[int, float]:
+    """Best-objective node of ``larger_nodes`` against any of
+    ``smaller_nodes`` (``balancing.rs:482-513``); optionally a weighted
+    random pick among the top-N."""
+    comparisons = [
+        (larger_id, objective(larger_tensor, smaller_tensor))
+        for larger_id, larger_tensor in larger_nodes.items()
+        for smaller_tensor in smaller_nodes.values()
     ]
-    if len(members) <= 1:
-        return 0.0
-    local = CompositeTensor(members)
-    flops, _ = contract_path_cost(local, ContractionPath.simple(state.local_paths[p]), True)
-    return flops
+    if weighted_random_top and rng is not None:
+        options = sorted(comparisons, key=lambda c: -c[1])[:weighted_random_top]
+        top = options[0][1]
+        if top <= 0:
+            return options[0]
+        weights = [max(c[1] / top, 0.0) for c in options]
+        total = sum(weights)
+        pick = rng.random() * total
+        acc = 0.0
+        for option, w in zip(options, weights):
+            acc += w
+            if pick <= acc:
+                return option
+        return options[-1]
+    return max(comparisons, key=lambda c: c[1])
 
 
-def _partition_external(tensor: CompositeTensor, state: _State, p: int) -> LeafTensor:
-    external = LeafTensor()
-    for t, b in zip(tensor.tensors, state.partitioning):
-        if b == p:
-            external = external ^ t
-    return external
+# ---------------------------------------------------------------------------
+# The ten scheme functions (``balancing_schemes.rs:83-613``).
+# ``partition_data`` is sorted ascending by flop cost on entry: first =
+# cheapest ("smaller"), last = costliest ("larger").
 
 
-def _partition_memory(tensor: CompositeTensor, state: _State, p: int) -> float:
-    total = 0.0
-    for t, b in zip(tensor.tensors, state.partitioning):
-        if b == p:
-            total += t.size()
-    return total
-
-
-def _evaluate(
-    tensor: CompositeTensor,
-    state: _State,
-    settings: BalanceSettings,
-    rng: random.Random,
-) -> tuple[float, CompositeTensor, ContractionPath]:
-    partitioned, full_path, parallel, _ = compute_solution(
-        tensor, state.partitioning, settings.communication_scheme, rng
+def _best_worst(data, forest, settings, rng) -> list[_Shift]:
+    larger = data[-1].id
+    smaller = data[0].id
+    node, _ = _find_rebalance_node(
+        rng,
+        settings.weighted_random_top,
+        forest.leaf_node_tensor_map(larger),
+        forest.leaf_node_tensor_map(smaller),
+        settings.objective,
     )
-    if settings.memory_limit is not None:
-        mem = compute_memory_requirements(
-            partitioned.tensors, full_path, contract_size_tensors_bytes
+    return [_Shift(larger, smaller, forest.leaf_ids(node))]
+
+
+def _best_receiver(data, forest, settings, rng, donor_id, donor_nodes):
+    """Scan receivers (all but the donor): receiver subtree scored with
+    its full node map; returns (receiver_id, node, objective)."""
+    best = None
+    for part in data:
+        if part.id == donor_id:
+            continue
+        receiver_nodes = forest.subtree_tensor_map(part.id, None)
+        node, obj = _find_rebalance_node(
+            rng,
+            settings.weighted_random_top,
+            donor_nodes,
+            receiver_nodes,
+            settings.objective,
         )
-        if mem > settings.memory_limit:
-            parallel = math.inf
-    return parallel, partitioned, full_path
+        if best is None or obj > best[2]:
+            best = (part.id, node, obj)
+    return best
 
 
-def _movable_groups(
-    tensor: CompositeTensor,
-    state: _State,
-    donor: int,
-    settings: BalanceSettings,
-    rng: random.Random,
-) -> list[list[int]]:
-    """Candidate move groups (lists of global tensor indices) from the
-    donor partition, per scheme."""
-    donor_indices = [
-        g for g, b in enumerate(state.partitioning) if b == donor
-    ]
-    if len(donor_indices) <= 1:
+def _best_tensor(data, forest, settings, rng) -> list[_Shift]:
+    larger = data[-1].id
+    donor_nodes = forest.leaf_node_tensor_map(larger)
+    best = _best_receiver(data[:-1], forest, settings, rng, larger, donor_nodes)
+    if best is None:
         return []
+    receiver, node, _ = best
+    return [_Shift(larger, receiver, forest.leaf_ids(node))]
 
+
+def _best_donor_into(data, forest, settings, rng, receiver_id, receiver_nodes, donor_map):
+    """Scan donors (all but the receiver): returns (donor_id, node, obj).
+    ``donor_map(part)`` yields the donor's candidate node map."""
+    best = None
+    for part in data:
+        if part.id == receiver_id:
+            continue
+        donor_nodes = donor_map(part)
+        if not donor_nodes:
+            continue
+        node, obj = _find_rebalance_node(
+            rng,
+            settings.weighted_random_top,
+            donor_nodes,
+            receiver_nodes,
+            settings.objective,
+        )
+        if best is None or obj > best[2]:
+            best = (part.id, node, obj)
+    return best
+
+
+def _best_tensors(data, forest, settings, rng) -> list[_Shift]:
+    shifts = _best_tensor(data, forest, settings, rng)
+    smaller = data[0].id
+    receiver_nodes = forest.subtree_tensor_map(smaller, None)
+    best = _best_donor_into(
+        data[1:-1],
+        forest,
+        settings,
+        rng,
+        smaller,
+        receiver_nodes,
+        lambda part: forest.leaf_node_tensor_map(part.id),
+    )
+    if best is not None:
+        donor, node, _ = best
+        shifts.append(_Shift(donor, smaller, forest.leaf_ids(node)))
+    return shifts
+
+
+def _tensors_odd(data, forest, settings, rng) -> list[_Shift]:
+    larger = data[-1].id
+    donor_nodes = forest.leaf_node_tensor_map(larger)
+    best = None
+    for part in data[:-1]:
+        node, obj = _find_rebalance_node(
+            rng,
+            settings.weighted_random_top,
+            donor_nodes,
+            {0: part.local_tensor},
+            settings.objective,
+        )
+        if best is None or obj > best[2]:
+            best = (part.id, node, obj)
+    if best is None:
+        return []
+    receiver, node, _ = best
+    return [_Shift(larger, receiver, forest.leaf_ids(node))]
+
+
+def _tensors_even(data, forest, settings, rng) -> list[_Shift]:
+    smaller = data[0]
+    receiver_nodes = {0: smaller.local_tensor}
+    best = _best_donor_into(
+        data[1:],
+        forest,
+        settings,
+        rng,
+        smaller.id,
+        receiver_nodes,
+        lambda part: forest.leaf_node_tensor_map(part.id),
+    )
+    if best is None:
+        return []
+    donor, node, _ = best
+    return [_Shift(donor, smaller.id, forest.leaf_ids(node))]
+
+
+def _intermediate_donor_nodes(forest, root, height_limit):
+    nodes = forest.subtree_tensor_map(root, height_limit)
+    nodes.pop(root, None)  # never move the whole partition
+    return nodes
+
+
+def _best_intermediate_tensors(data, forest, settings, rng) -> list[_Shift]:
+    shifts = _intermediate_tensors_odd(data, forest, settings, rng)
+    smaller = data[0].id
+    receiver_nodes = forest.subtree_tensor_map(smaller, None)
+    best = _best_donor_into(
+        data[1:-1],
+        forest,
+        settings,
+        rng,
+        smaller,
+        receiver_nodes,
+        lambda part: _intermediate_donor_nodes(
+            forest, part.id, settings.height_limit
+        ),
+    )
+    if best is not None:
+        donor, node, _ = best
+        shifts.append(_Shift(donor, smaller, forest.leaf_ids(node)))
+    return shifts
+
+
+def _intermediate_tensors_odd(data, forest, settings, rng) -> list[_Shift]:
+    larger = data[-1].id
+    donor_nodes = _intermediate_donor_nodes(forest, larger, settings.height_limit)
+    if not donor_nodes:
+        return []
+    best = _best_receiver(data[:-1], forest, settings, rng, larger, donor_nodes)
+    if best is None:
+        return []
+    receiver, node, _ = best
+    return [_Shift(larger, receiver, forest.leaf_ids(node))]
+
+
+def _intermediate_tensors_even(data, forest, settings, rng) -> list[_Shift]:
+    smaller = data[0].id
+    receiver_nodes = forest.subtree_tensor_map(smaller, None)
+    best = _best_donor_into(
+        data[1:],
+        forest,
+        settings,
+        rng,
+        smaller,
+        receiver_nodes,
+        lambda part: _intermediate_donor_nodes(
+            forest, part.id, settings.height_limit
+        ),
+    )
+    if best is None:
+        return []
+    donor, node, _ = best
+    return [_Shift(donor, smaller, forest.leaf_ids(node))]
+
+
+def _tree_tensors_odd(data, forest, settings, rng) -> list[_Shift]:
+    """Intermediate move vs receiver externals; requires objective > 0
+    (``balancing_schemes.rs:496-546``)."""
+    larger = data[-1].id
+    donor_nodes = _intermediate_donor_nodes(forest, larger, settings.height_limit)
+    if not donor_nodes:
+        return []
+    best = None
+    for part in data[:-1]:
+        node = None
+        objective = 0.0
+        for node_id, node_tensor in donor_nodes.items():
+            obj = settings.objective(node_tensor, part.local_tensor)
+            if obj > objective:
+                objective = obj
+                node = node_id
+        if node is not None and (best is None or objective > best[2]):
+            best = (part.id, node, objective)
+    if best is None:
+        return []
+    receiver, node, _ = best
+    return [_Shift(larger, receiver, forest.leaf_ids(node))]
+
+
+def _tree_tensors_even(data, forest, settings, rng) -> list[_Shift]:
+    smaller = data[0]
+    best = None
+    for part in data[1:]:
+        donor_nodes = _intermediate_donor_nodes(
+            forest, part.id, settings.height_limit
+        )
+        if not donor_nodes:
+            continue
+        node = None
+        objective = 0.0
+        for node_id, node_tensor in donor_nodes.items():
+            obj = settings.objective(node_tensor, smaller.local_tensor)
+            if obj > objective:
+                objective = obj
+                node = node_id
+        if node is not None and (best is None or objective > best[2]):
+            best = (part.id, node, objective)
+    if best is None:
+        return []
+    donor, node, _ = best
+    return [_Shift(donor, smaller.id, forest.leaf_ids(node))]
+
+
+def _scheme_shifts(data, forest, settings, rng, iteration) -> list[_Shift]:
+    """Dispatch (``balancing.rs:258-367``): data sorted ascending by
+    flop cost; alternating schemes switch on iteration parity."""
     scheme = settings.scheme
-    subtree_schemes = (
-        BalancingScheme.INTERMEDIATE_TENSORS,
-        BalancingScheme.ALTERNATING_INTERMEDIATE_TENSORS,
-        BalancingScheme.ALTERNATING_TREE_TENSORS,
-    )
-    if scheme in subtree_schemes:
-        local_path = state.local_paths[donor]
-        groups = []
-        limit = max(2, settings.height_limit)
-        for pair_index in range(max(0, len(local_path) - 1)):
-            leaves = _subtree_leaves(local_path, pair_index)
-            if 2 <= len(leaves) <= limit and len(leaves) < len(donor_indices):
-                groups.append([donor_indices[k] for k in sorted(leaves)])
-        if groups:
-            return groups
-    if scheme in (BalancingScheme.TENSORS, BalancingScheme.ALTERNATING_TENSORS):
-        # batch moves: connected leaf pairs (sharing a leg) in addition to
-        # single leaves, so a bonded cluster can migrate in one shift
-        groups = [[g] for g in donor_indices]
-        if len(donor_indices) > 2:
-            legs_of = {g: set(tensor.tensors[g].legs) for g in donor_indices}
-            for a_pos, a in enumerate(donor_indices):
-                for b in donor_indices[a_pos + 1 :]:
-                    if legs_of[a] & legs_of[b]:
-                        groups.append([a, b])
-        return groups
-    # single-leaf moves (also the fallback for subtree schemes)
-    return [[g] for g in donor_indices]
+    odd = iteration % 2 == 1
+    if scheme == BalancingScheme.BEST_WORST:
+        return _best_worst(data, forest, settings, rng)
+    if scheme == BalancingScheme.TENSOR:
+        return _best_tensor(data, forest, settings, rng)
+    if scheme == BalancingScheme.TENSORS:
+        return _best_tensors(data, forest, settings, rng)
+    if scheme == BalancingScheme.ALTERNATING_TENSORS:
+        return (
+            _tensors_odd(data, forest, settings, rng)
+            if odd
+            else _tensors_even(data, forest, settings, rng)
+        )
+    if scheme == BalancingScheme.INTERMEDIATE_TENSORS:
+        return _best_intermediate_tensors(data, forest, settings, rng)
+    if scheme == BalancingScheme.ALTERNATING_INTERMEDIATE_TENSORS:
+        return (
+            _intermediate_tensors_odd(data, forest, settings, rng)
+            if odd
+            else _intermediate_tensors_even(data, forest, settings, rng)
+        )
+    if scheme == BalancingScheme.ALTERNATING_TREE_TENSORS:
+        return (
+            _tree_tensors_odd(data, forest, settings, rng)
+            if odd
+            else _tree_tensors_even(data, forest, settings, rng)
+        )
+    raise ValueError(f"unknown balancing scheme {scheme!r}")
 
 
-def _pick_donor(
-    tensor: CompositeTensor,
-    state: _State,
-    settings: BalanceSettings,
-    iteration: int,
-) -> int:
-    costs = [
-        _partition_cost(tensor, state, p) for p in range(state.num_partitions)
-    ]
-    alternating = settings.scheme in (
-        BalancingScheme.ALTERNATING_TENSORS,
-        BalancingScheme.ALTERNATING_INTERMEDIATE_TENSORS,
-        BalancingScheme.ALTERNATING_TREE_TENSORS,
-    )
-    if alternating and iteration % 2 == 1:
-        memories = [
-            _partition_memory(tensor, state, p)
-            for p in range(state.num_partitions)
+# ---------------------------------------------------------------------------
+# Shift application
+
+
+def _apply_shift(
+    forest: _PartitionForest, shift: _Shift
+) -> tuple[_PartitionData, _PartitionData]:
+    """``shift_node_between_subtrees`` (``balancing.rs:517-613``): move
+    leaves, re-Greedy both partitions, rebuild both subtrees. Returns the
+    new (donor, receiver) partition data."""
+    donor_leaves = forest.leaf_ids(shift.from_subtree_id)
+    receiver_leaves = forest.leaf_ids(shift.to_subtree_id)
+    moved = set(shift.moved_leaf_ids)
+    assert moved and moved.issubset(set(donor_leaves))
+    assert not moved & set(receiver_leaves)
+    donor_leaves = [l for l in donor_leaves if l not in moved]
+    receiver_leaves = receiver_leaves + shift.moved_leaf_ids
+    if not donor_leaves:
+        raise ValueError("shift would empty the donor partition")
+
+    forest.remove_internal(shift.from_subtree_id)
+    forest.remove_internal(shift.to_subtree_id)
+
+    out = []
+    for leaves in (donor_leaves, receiver_leaves):
+        tensors = [
+            forest.tensor.tensors[forest.nodes[l].leaf_index] for l in leaves
         ]
-        return max(range(state.num_partitions), key=lambda p: memories[p])
-    return max(range(state.num_partitions), key=lambda p: costs[p])
+        if len(tensors) > 1:
+            result = Greedy(OptMethod.GREEDY).find_path(
+                CompositeTensor(tensors)
+            )
+            local = list(result.replace_path().toplevel)
+            flops, mem = result.flops, result.size
+            root = forest.build_subtree(leaves, local)
+        else:
+            local, flops, mem = [], 0.0, tensors[0].size()
+            root = leaves[0]
+            forest.nodes[root].parent = -1
+        external = LeafTensor()
+        for t in tensors:
+            external = external ^ t
+        out.append(
+            _PartitionData(root, flops, mem, local, external, list(leaves))
+        )
+    return out[0], out[1]
+
+
+# ---------------------------------------------------------------------------
+# Main loop
 
 
 def balance_partitions_iter(
@@ -220,81 +624,119 @@ def balance_partitions_iter(
     settings = settings or BalanceSettings()
     rng = rng or random.Random(42)
 
-    num_partitions = max(partitioning) + 1
-    state = _State(
-        partitioning=list(partitioning),
-        local_paths=[],
-        num_partitions=num_partitions,
-    )
-    for p in range(num_partitions):
-        members = [
-            t for t, b in zip(tensor.tensors, state.partitioning) if b == p
-        ]
-        state.local_paths.append(_local_greedy_path(members))
+    forest = _PartitionForest(tensor)
+    blocks: dict[int, list[int]] = {}
+    for g, b in enumerate(partitioning):
+        blocks.setdefault(b, []).append(g)
+    if len(blocks) < 2:
+        raise ValueError("balancing needs at least two partitions")
 
-    cost, best_tn, best_path = _evaluate(tensor, state, settings, rng)
+    data: list[_PartitionData] = []
+    for b in sorted(blocks):
+        leaves = [forest.leaf_of[g] for g in blocks[b]]
+        part = _characterize_from_leaves(forest, leaves)
+        data.append(part)
+
+    def score(current: list[_PartitionData]) -> tuple[float, list[tuple[int, int]], float]:
+        children = [p.local_tensor for p in current]
+        latency = {i: p.flop_cost for i, p in enumerate(current)}
+        communication_path = settings.communication_scheme.communication_path(
+            children, latency, rng
+        )
+        costs = [latency[i] for i in range(len(current))]
+        (parallel, _), mem = communication_path_op_costs(
+            children, communication_path, True, costs
+        )
+        return parallel, communication_path, mem
+
+    def snapshot(current: list[_PartitionData], communication_path):
+        # p.contraction was built over p.leaves order — never re-derive
+        # the order from the tree (traversal order is a different
+        # permutation of the same leaf set).
+        ordered = []
+        nested: dict[int, ContractionPath] = {}
+        for i, p in enumerate(current):
+            tensors = [
+                forest.tensor.tensors[forest.nodes[l].leaf_index]
+                for l in p.leaves
+            ]
+            ordered.append(CompositeTensor(tensors))
+            nested[i] = ContractionPath.simple(list(p.contraction))
+        return CompositeTensor(ordered), ContractionPath(
+            nested, list(communication_path)
+        )
+
+    cost, communication_path, _ = score(data)
     history = [cost]
     best_cost = cost
     best_iteration = 0
+    best_tn, best_path = snapshot(data, communication_path)
 
-    for iteration in range(settings.iterations):
-        donor = _pick_donor(tensor, state, settings, iteration)
-        groups = _movable_groups(tensor, state, donor, settings, rng)
-        if not groups:
+    for iteration in range(1, settings.iterations + 1):
+        data.sort(key=lambda p: p.flop_cost)
+        logger.debug(
+            "balancing iteration %d scheme=%s donor_cost=%.3e",
+            iteration,
+            settings.scheme,
+            data[-1].flop_cost,
+        )
+        shifts = _scheme_shifts(data, forest, settings, rng, iteration)
+        if not shifts:
+            break
+        id_remap: dict[int, int] = {}
+        applied = False
+        for shift in shifts:
+            from_id = id_remap.get(shift.from_subtree_id, shift.from_subtree_id)
+            to_id = id_remap.get(shift.to_subtree_id, shift.to_subtree_id)
+            if from_id == to_id:
+                continue
+            shift = _Shift(from_id, to_id, shift.moved_leaf_ids)
+            donor_leaves = set(forest.leaf_ids(from_id))
+            if not set(shift.moved_leaf_ids).issubset(donor_leaves):
+                continue  # an earlier shift in this round moved these leaves
+            if len(shift.moved_leaf_ids) >= len(donor_leaves):
+                continue  # would empty the donor
+            new_donor, new_receiver = _apply_shift(forest, shift)
+            id_remap[shift.from_subtree_id] = new_donor.id
+            id_remap[shift.to_subtree_id] = new_receiver.id
+            for k, p in enumerate(data):
+                if p.id == from_id:
+                    data[k] = new_donor
+                elif p.id == to_id:
+                    data[k] = new_receiver
+            applied = True
+        if not applied:
             break
 
-        # Score each (group, target) by the objective on the target's
-        # external tensor; BEST_WORST fixes the target to the cheapest
-        # partition.
-        if settings.scheme == BalancingScheme.BEST_WORST:
-            costs = [
-                _partition_cost(tensor, state, p)
-                for p in range(num_partitions)
-            ]
-            targets = [
-                min(
-                    (p for p in range(num_partitions) if p != donor),
-                    key=lambda p: costs[p],
-                )
-            ]
-        else:
-            targets = [p for p in range(num_partitions) if p != donor]
-
-        externals = {
-            p: _partition_external(tensor, state, p) for p in targets
-        }
-        moves: list[tuple[float, list[int], int]] = []
-        for group in groups:
-            shifted = LeafTensor()
-            for g in group:
-                shifted = shifted ^ tensor.tensors[g]
-            for p in targets:
-                moves.append((settings.objective(shifted, externals[p]), group, p))
-        if not moves:
-            break
-        moves.sort(key=lambda m: m[0])
-        if settings.weighted_random_top:
-            top = moves[: settings.weighted_random_top]
-            _, group, target = top[rng.randrange(len(top))]
-        else:
-            _, group, target = moves[0]
-
-        # Apply the shift and re-path both partitions.
-        for g in group:
-            state.partitioning[g] = target
-        for p in (donor, target):
-            members = [
-                t
-                for t, b in zip(tensor.tensors, state.partitioning)
-                if b == p
-            ]
-            state.local_paths[p] = _local_greedy_path(members)
-
-        cost, tn, path = _evaluate(tensor, state, settings, rng)
+        data.sort(key=lambda p: p.flop_cost)
+        cost, communication_path, mem = score(data)
         history.append(cost)
+        if settings.memory_limit is not None and mem > settings.memory_limit:
+            break
         if cost < best_cost:
             best_cost = cost
-            best_tn, best_path = tn, path
-            best_iteration = iteration + 1
+            best_iteration = iteration
+            best_tn, best_path = snapshot(data, communication_path)
 
     return best_iteration, best_tn, best_path, history
+
+
+def _characterize_from_leaves(
+    forest: _PartitionForest, leaves: list[int]
+) -> _PartitionData:
+    """Initial characterization: Greedy path + subtree build per block."""
+    tensors = [
+        forest.tensor.tensors[forest.nodes[l].leaf_index] for l in leaves
+    ]
+    if len(tensors) > 1:
+        result = Greedy(OptMethod.GREEDY).find_path(CompositeTensor(tensors))
+        local = list(result.replace_path().toplevel)
+        flops, mem = result.flops, result.size
+        root = forest.build_subtree(leaves, local)
+    else:
+        local, flops, mem = [], 0.0, tensors[0].size()
+        root = leaves[0]
+    external = LeafTensor()
+    for t in tensors:
+        external = external ^ t
+    return _PartitionData(root, flops, mem, local, external, list(leaves))

@@ -55,3 +55,52 @@ def compute_solution(
 
     final_path = ContractionPath(path.nested, communication_path)
     return partitioned, final_path, parallel_cost, sum_cost
+
+
+def compute_solution_with_paths(
+    tensor: CompositeTensor,
+    partitioning: Sequence[int],
+    local_paths: Sequence[Sequence[tuple[int, int]]],
+    communication_scheme: CommunicationScheme = CommunicationScheme.GREEDY,
+    rng: random.Random | None = None,
+) -> tuple[CompositeTensor, ContractionPath, float, float]:
+    """Like :func:`compute_solution`, but reuses caller-maintained local
+    paths instead of re-running Greedy on every partition.
+
+    This is the incremental evaluation kernel for the SA models
+    (mirroring ``simulated_annealing.rs:457-562``, where a trial move
+    re-paths only the two touched partitions): ``local_paths[b]`` is the
+    replace-path over block ``b``'s tensors in original order. Empty
+    blocks are dropped and blocks ordered by id, exactly as
+    :func:`~tnc_tpu.tensornetwork.partitioning.partition_tensor_network`
+    does.
+    """
+    blocks: dict[int, list] = {}
+    for t, b in zip(tensor.tensors, partitioning):
+        blocks.setdefault(b, []).append(t)
+    present = sorted(blocks)
+
+    nested: dict[int, ContractionPath] = {}
+    latency_map: dict[int, float] = {}
+    children = []
+    children_tensors = []
+    for idx, b in enumerate(present):
+        child = CompositeTensor(blocks[b])
+        children.append(child)
+        children_tensors.append(child.external_tensor())
+        local = ContractionPath.simple(list(local_paths[b]))
+        nested[idx] = local
+        local_cost, _ = contract_path_cost(child.tensors, local, True)
+        latency_map[idx] = local_cost
+
+    communication_path = communication_scheme.communication_path(
+        children_tensors, latency_map, rng
+    )
+    tensor_costs = [latency_map[i] for i in range(len(children_tensors))]
+    (parallel_cost, sum_cost), _ = communication_path_op_costs(
+        children_tensors, communication_path, True, tensor_costs
+    )
+
+    partitioned = CompositeTensor(children)
+    final_path = ContractionPath(nested, communication_path)
+    return partitioned, final_path, parallel_cost, sum_cost

@@ -4,11 +4,15 @@ Mirror of ``tnc/src/contractionpath/repartitioning/genetic.rs``: evolve
 partition-assignment chromosomes with single-gene mutation, uniform
 crossover, and tournament selection (the reference uses the
 ``genetic_algorithm`` crate with population 100, stale limit 100,
-``MutateSingleGene(0.2)``; this is a self-contained equivalent).
+``MutateSingleGene(0.2)``; this is a self-contained equivalent). Fitness
+is evaluated by a process pool when cores are available, like the
+reference's ``.with_par_fitness(true)`` (``genetic.rs:103``); scoring is
+a pure function of the chromosome so results are worker-count invariant.
 """
 
 from __future__ import annotations
 
+import os
 import random
 from dataclasses import dataclass
 from typing import Sequence
@@ -18,6 +22,54 @@ from tnc_tpu.contractionpath.repartitioning.simulated_annealing import (
     evaluate_partitioning,
 )
 from tnc_tpu.tensornetwork.tensor import CompositeTensor
+
+_POOL_CTX = None
+
+
+def _fitness_init(tensor, scheme, memory_limit):
+    global _POOL_CTX
+    _POOL_CTX = (tensor, scheme, memory_limit)
+
+
+def _fitness_worker(args):
+    seed, chromosome = args
+    tensor, scheme, memory_limit = _POOL_CTX
+    return evaluate_partitioning(
+        tensor, chromosome, scheme, memory_limit, random.Random(seed)
+    )
+
+
+def _make_fitness_pool(tensor, scheme, memory_limit, population_size):
+    import multiprocessing as mp
+
+    from tnc_tpu.contractionpath.repartitioning.simulated_annealing import (
+        spawn_safe,
+    )
+
+    env = os.environ.get("TNC_TPU_SA_WORKERS")
+    workers = (
+        max(1, int(env))
+        if env is not None
+        else max(1, min(population_size, os.cpu_count() or 1))
+    )
+    if workers <= 1 or not spawn_safe():
+        return None
+    prev = os.environ.get("JAX_PLATFORMS")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        ctx = mp.get_context("spawn")
+        return ctx.Pool(
+            workers,
+            initializer=_fitness_init,
+            initargs=(tensor, scheme, memory_limit),
+        )
+    except Exception:
+        return None
+    finally:
+        if prev is None:
+            os.environ.pop("JAX_PLATFORMS", None)
+        else:
+            os.environ["JAX_PLATFORMS"] = prev
 
 
 @dataclass
@@ -44,11 +96,33 @@ def balance_partitions(
 
     settings = settings or GeneticSettings()
     deadline = time.monotonic() + max_time if max_time else None
+    pool = _make_fitness_pool(
+        tensor, communication_scheme, memory_limit, settings.population_size
+    )
 
-    def fitness(chromosome: list[int]) -> float:
-        return evaluate_partitioning(
-            tensor, chromosome, communication_scheme, memory_limit, rng
-        )
+    def score_population(population: list[list[int]]) -> list[tuple[float, list[int]]]:
+        nonlocal pool
+        jobs = [(rng.getrandbits(64), c) for c in population]
+        if pool is not None:
+            try:
+                scores = pool.map_async(_fitness_worker, jobs).get(timeout=600.0)
+                return list(zip(scores, population))
+            except Exception:
+                pool.terminate()
+                pool = None
+        return [
+            (
+                evaluate_partitioning(
+                    tensor,
+                    c,
+                    communication_scheme,
+                    memory_limit,
+                    random.Random(seed),
+                ),
+                c,
+            )
+            for seed, c in jobs
+        ]
 
     def mutate(chromosome: list[int]) -> list[int]:
         out = list(chromosome)
@@ -68,26 +142,31 @@ def balance_partitions(
     for _ in range(settings.population_size - 1):
         population.append(mutate(list(initial_partitioning)))
 
-    scored = [(fitness(c), c) for c in population]
-    best_score, best = min(scored, key=lambda p: p[0])
-    stale = 0
+    try:
+        scored = score_population(population)
+        best_score, best = min(scored, key=lambda p: p[0])
+        stale = 0
 
-    for _generation in range(settings.max_generations):
-        if stale >= settings.stale_limit:
-            break
-        if deadline is not None and time.monotonic() > deadline:
-            break
-        next_population = [best]  # elitism
-        while len(next_population) < settings.population_size:
-            child = mutate(crossover(tournament(scored), tournament(scored)))
-            next_population.append(child)
-        population = next_population
-        scored = [(fitness(c), c) for c in population]
-        gen_best_score, gen_best = min(scored, key=lambda p: p[0])
-        if gen_best_score < best_score:
-            best_score, best = gen_best_score, gen_best
-            stale = 0
-        else:
-            stale += 1
+        for _generation in range(settings.max_generations):
+            if stale >= settings.stale_limit:
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                break
+            next_population = [best]  # elitism
+            while len(next_population) < settings.population_size:
+                child = mutate(crossover(tournament(scored), tournament(scored)))
+                next_population.append(child)
+            population = next_population
+            scored = score_population(population)
+            gen_best_score, gen_best = min(scored, key=lambda p: p[0])
+            if gen_best_score < best_score:
+                best_score, best = gen_best_score, gen_best
+                stale = 0
+            else:
+                stale += 1
+    finally:
+        if pool is not None:
+            pool.terminate()
+            pool.join()
 
     return best, best_score
