@@ -13,6 +13,14 @@ for an 8-device virtual CPU mesh):
   python examples/distributed_contraction.py
 """
 
+import sys
+from pathlib import Path
+
+try:
+    import tnc_tpu  # noqa: F401
+except ModuleNotFoundError:  # running from a source checkout
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
 import numpy as np
 
 from tnc_tpu import CompositeTensor

@@ -7,6 +7,14 @@ path, contract, and restore natural qubit order.
 Run:  python examples/local_contraction.py
 """
 
+import sys
+from pathlib import Path
+
+try:
+    import tnc_tpu  # noqa: F401
+except ModuleNotFoundError:  # running from a source checkout
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
 import numpy as np
 
 from tnc_tpu.contractionpath.paths import Greedy, OptMethod

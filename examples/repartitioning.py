@@ -9,6 +9,14 @@ reduce the critical-path cost.
 Run:  python examples/repartitioning.py
 """
 
+import sys
+from pathlib import Path
+
+try:
+    import tnc_tpu  # noqa: F401
+except ModuleNotFoundError:  # running from a source checkout
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
 import random
 
 import numpy as np

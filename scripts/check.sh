@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# CI-style gate (the reference runs fmt/clippy/tests/doc-tests/coverage in
+# .github/workflows/{check,test}.yml): syntax check everything, run the
+# test suite under the dependency-free coverage gate (75% floor), and
+# smoke-run the examples.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== syntax =="
+python -m compileall -q tnc_tpu tests examples scripts bench.py __graft_entry__.py
+
+echo "== tests + coverage (floor ${COVERAGE_MIN:-75}%) =="
+python scripts/coverage_gate.py tests/ -q
+
+echo "== examples =="
+# TNC_TPU_PLATFORM pins JAX to CPU via jax.config (env vars alone can be
+# overridden by interpreter startup hooks that pre-wire an accelerator);
+# the virtual device count exercises the distributed example's mesh.
+for example in examples/*.py; do
+  echo "-- $example"
+  TNC_TPU_PLATFORM=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python "$example" > /dev/null
+done
+
+echo "ALL CHECKS PASSED"

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Dependency-free line-coverage gate.
+
+The reference CI enforces a 75% minimum line coverage with
+``cargo llvm-cov`` (``.github/workflows/test.yml``). This gate does the
+same for ``tnc_tpu`` without third-party tooling: PEP 669
+(``sys.monitoring``) LINE events record each executed line once (the
+callback returns DISABLE per location, so steady-state overhead is
+near zero), executable lines are enumerated from compiled code objects,
+and the run fails below the floor.
+
+Usage:  python scripts/coverage_gate.py [pytest args...]
+Env:    COVERAGE_MIN (default 75)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO, "tnc_tpu")
+
+if REPO not in sys.path:  # running as `python scripts/coverage_gate.py`
+    sys.path.insert(0, REPO)
+
+TOOL = sys.monitoring.COVERAGE_ID
+
+executed: set[tuple[str, int]] = set()
+
+
+def _on_line(code, line):
+    filename = code.co_filename
+    if filename.startswith(PACKAGE):
+        executed.add((filename, line))
+    return sys.monitoring.DISABLE
+
+
+def _executable_lines(path: str) -> set[int]:
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    try:
+        top = compile(source, path, "exec")
+    except SyntaxError:
+        return set()
+    lines: set[int] = set()
+    stack = [top]
+    while stack:
+        code = stack.pop()
+        for _, _, line in code.co_lines():
+            if line is not None and line > 0:
+                lines.add(line)
+        for const in code.co_consts:
+            if hasattr(const, "co_lines"):
+                stack.append(const)
+    return lines
+
+
+def main() -> int:
+    floor = float(os.environ.get("COVERAGE_MIN", "75"))
+
+    sys.monitoring.use_tool_id(TOOL, "tnc_tpu-coverage")
+    sys.monitoring.register_callback(
+        TOOL, sys.monitoring.events.LINE, _on_line
+    )
+    sys.monitoring.set_events(TOOL, sys.monitoring.events.LINE)
+
+    import pytest
+
+    args = sys.argv[1:] or ["tests/", "-q"]
+    rc = pytest.main(args)
+
+    sys.monitoring.set_events(TOOL, 0)
+    sys.monitoring.free_tool_id(TOOL)
+
+    if rc != 0:
+        print(f"coverage gate: tests failed (rc={rc})", file=sys.stderr)
+        return int(rc)
+
+    per_file: list[tuple[str, int, int]] = []
+    total_exec = 0
+    total_hit = 0
+    for dirpath, _dirnames, filenames in os.walk(PACKAGE):
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            lines = _executable_lines(path)
+            if not lines:
+                continue
+            hit = {l for f, l in executed if f == path}
+            covered = len(lines & hit)
+            per_file.append((os.path.relpath(path, REPO), covered, len(lines)))
+            total_exec += len(lines)
+            total_hit += covered
+
+    pct = 100.0 * total_hit / total_exec if total_exec else 100.0
+    print(f"\ncoverage: {total_hit}/{total_exec} lines = {pct:.1f}% "
+          f"(floor {floor:.0f}%)")
+    worst = sorted(per_file, key=lambda r: r[1] / max(r[2], 1))[:10]
+    for rel, covered, n in worst:
+        print(f"  {100.0 * covered / n:5.1f}%  {rel}")
+    if pct < floor:
+        print(f"coverage gate: FAILED ({pct:.1f}% < {floor:.0f}%)",
+              file=sys.stderr)
+        return 1
+    print("coverage gate: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

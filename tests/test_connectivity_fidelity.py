@@ -1,0 +1,107 @@
+"""Device coupling-graph fidelity.
+
+The reference generates Eagle/Osprey/Condor with a heavy-hex
+construction (``connectivity.rs:380-495``) and hard-codes the Sycamore
+table (``connectivity.rs:59-148``). These tests pin our graphs to the
+published device facts (qubit/coupler counts of the real chips) and to
+golden fingerprints so any change to the construction is caught
+edge-for-edge.
+"""
+
+import hashlib
+
+import pytest
+
+from tnc_tpu.builders.connectivity import (
+    condor_connect,
+    eagle_connect,
+    line_connect,
+    osprey_connect,
+    sycamore_connect,
+)
+
+
+def _stats(edges):
+    qubits = set()
+    degree = {}
+    for a, b in edges:
+        qubits.add(a)
+        qubits.add(b)
+        degree[a] = degree.get(a, 0) + 1
+        degree[b] = degree.get(b, 0) + 1
+    return qubits, degree
+
+
+def _connected(edges, qubits):
+    adjacency = {q: [] for q in qubits}
+    for a, b in edges:
+        adjacency[a].append(b)
+        adjacency[b].append(a)
+    start = next(iter(qubits))
+    seen = {start}
+    stack = [start]
+    while stack:
+        for nxt in adjacency[stack.pop()]:
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append(nxt)
+    return seen == qubits
+
+
+def _fingerprint(edges):
+    canonical = sorted(tuple(sorted(e)) for e in edges)
+    return hashlib.sha256(repr(canonical).encode()).hexdigest()[:16]
+
+
+# (generator, qubits, edges, max degree, golden fingerprint).
+# Qubit/coupler counts are the published device numbers: IBM Eagle 127,
+# Osprey 433, Condor 1121 (heavy-hex, degree <= 3); Google Sycamore 53
+# working qubits / 86 working couplers (arXiv:1910.11333).
+DEVICES = [
+    (eagle_connect, 127, 142, 3, "70edb43ddbbd39a6"),
+    (osprey_connect, 433, 499, 3, "1859df13459e83f6"),
+    (condor_connect, 1121, 1311, 3, "f8b65132d121b1c1"),
+    (sycamore_connect, 53, 86, 4, "a67fef12d3afb55f"),
+]
+
+
+@pytest.mark.parametrize(
+    "connect,n_qubits,n_edges,max_degree,golden",
+    DEVICES,
+    ids=["eagle", "osprey", "condor", "sycamore"],
+)
+def test_device_graph_fidelity(connect, n_qubits, n_edges, max_degree, golden):
+    edges = connect()
+    qubits, degree = _stats(edges)
+    assert len(qubits) == n_qubits
+    assert len(edges) == n_edges
+    assert max(degree.values()) == max_degree
+    assert _connected(edges, qubits)
+    # no duplicate couplers in either direction
+    canonical = [tuple(sorted(e)) for e in edges]
+    assert len(set(canonical)) == len(canonical)
+    assert _fingerprint(edges) == golden
+
+
+def test_ibm_labels_contiguous_zero_based():
+    for connect, n_qubits in [
+        (eagle_connect, 127),
+        (osprey_connect, 433),
+        (condor_connect, 1121),
+    ]:
+        qubits, _ = _stats(connect())
+        assert qubits == set(range(n_qubits))
+
+
+def test_sycamore_labels_match_reference_table():
+    """The reference table is 1-based over 53 working qubits
+    (``connectivity.rs:59-148``); spot-check a few rows of it."""
+    edges = sycamore_connect()
+    assert (52, 32) == edges[0]
+    assert (32, 31) == edges[1]
+    for probe in [(52, 32), (44, 53), (21, 7), (1, 5)]:
+        assert probe in edges
+
+
+def test_line_connect():
+    assert line_connect(4) == [(0, 1), (1, 2), (2, 3)]

@@ -1,0 +1,42 @@
+"""README example as an executable test — the analogue of the
+reference's README doctest harness (``tnc/src/doctests.rs:7-11``, which
+compiles README.md so the front-page example can never rot)."""
+
+import re
+from pathlib import Path
+
+import numpy as np
+
+README = Path(__file__).resolve().parent.parent / "README.md"
+
+GHZ_QASM = """\
+OPENQASM 2.0;
+include "qelib1.inc";
+qreg q[3];
+h q[0];
+cx q[0], q[1];
+cx q[1], q[2];
+"""
+
+
+def _python_blocks(text: str) -> list[str]:
+    return re.findall(r"```python\n(.*?)```", text, re.DOTALL)
+
+
+def test_readme_example_runs(tmp_path, monkeypatch):
+    blocks = _python_blocks(README.read_text())
+    assert blocks, "README has no python example"
+    (tmp_path / "ghz.qasm").write_text(GHZ_QASM)
+    monkeypatch.chdir(tmp_path)
+    printed: list = []
+    exec(
+        compile(blocks[0], str(README), "exec"),
+        {"__name__": "__readme__", "print": lambda *a: printed.extend(a)},
+    )
+    # the example ends by printing the GHZ statevector: 1/sqrt(2) at
+    # |000> and |111>, zero elsewhere
+    values = np.asarray(printed[-1]).reshape(-1)
+    assert values.shape == (8,)
+    assert abs(abs(values[0]) - 1 / np.sqrt(2)) < 1e-5
+    assert abs(abs(values[-1]) - 1 / np.sqrt(2)) < 1e-5
+    assert np.allclose(abs(values[1:-1]), 0, atol=1e-6)

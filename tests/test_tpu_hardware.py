@@ -1,0 +1,132 @@
+"""Single-chip TPU hardware tier.
+
+The analogue of the reference's real-MPI integration tier
+(``tnc/tests/integration_tests.rs:121-167``, which self-launches under
+real MPI ranks): these tests run the contraction, split-complex, and
+sliced execution paths on a *real accelerator* and pin complex64 parity
+against the numpy oracle to 1e-5 (the BASELINE.md requirement).
+
+Run:  TNC_TPU_TEST_PLATFORM=tpu python -m pytest -m tpu tests/
+
+They skip (not fail) under the default CPU-pinned suite so `pytest`
+stays green on CPU-only hosts; the bench machine runs them as the
+pre-bench smoke.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.tpu
+
+requires_tpu_env = pytest.mark.skipif(
+    os.environ.get("TNC_TPU_TEST_PLATFORM", "cpu") == "cpu",
+    reason="hardware tier: set TNC_TPU_TEST_PLATFORM=tpu",
+)
+
+
+@pytest.fixture(scope="module")
+def device():
+    import jax
+
+    dev = jax.devices()[0]
+    if dev.platform == "cpu":
+        pytest.skip("no accelerator available")
+    return dev
+
+
+def _ghz_network(n=16):
+    from tnc_tpu.builders.circuit_builder import Circuit
+    from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+    from tnc_tpu.tensornetwork.tensordata import TensorData
+
+    c = Circuit()
+    reg = c.allocate_register(n)
+    c.append_gate(TensorData.gate("h"), [reg.qubit(0)])
+    for i in range(n - 1):
+        c.append_gate(TensorData.gate("cx"), [reg.qubit(i), reg.qubit(i + 1)])
+    tn, _ = c.into_amplitude_network("1" * n)
+    result = Greedy(OptMethod.GREEDY).find_path(tn)
+    return tn, result
+
+
+@requires_tpu_env
+def test_whole_path_contraction_parity(device):
+    """complex64 split-complex whole-path program vs numpy oracle."""
+    from tnc_tpu.tensornetwork.contraction import contract_tensor_network
+
+    tn, result = _ghz_network()
+    got = complex(
+        contract_tensor_network(tn, result.replace_path(), backend="jax")
+        .data.into_data()
+    )
+    want = complex(
+        contract_tensor_network(tn, result.replace_path(), backend="numpy")
+        .data.into_data()
+    )
+    assert abs(got - want) <= 1e-5 * max(1.0, abs(want))
+
+
+@requires_tpu_env
+def test_random_circuit_statevector_parity(device):
+    """Wider program: 12q random-circuit statevector, max-abs parity."""
+    from tnc_tpu.builders.connectivity import ConnectivityLayout
+    from tnc_tpu.builders.random_circuit import random_circuit
+    from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+    from tnc_tpu.ops.backends import JaxBackend, NumpyBackend
+    from tnc_tpu.ops.program import build_program, flat_leaf_tensors
+
+    rng = np.random.default_rng(7)
+    tn = random_circuit(
+        12, 8, 0.5, 0.5, rng, ConnectivityLayout.LINE, bitstring="*" * 12
+    )
+    result = Greedy(OptMethod.GREEDY).find_path(tn)
+    program = build_program(tn, result.replace_path())
+    arrays = [leaf.data.into_data() for leaf in flat_leaf_tensors(tn)]
+    got = np.asarray(JaxBackend(dtype="complex64").execute(program, arrays))
+    want = np.asarray(NumpyBackend(np.complex128).execute(program, arrays))
+    denom = max(float(np.max(np.abs(want))), 1e-30)
+    assert float(np.max(np.abs(got - want))) / denom <= 1e-5
+
+
+@requires_tpu_env
+def test_sliced_execution_parity(device):
+    """On-device slice loop (both strategies) vs numpy sliced oracle."""
+    from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+    from tnc_tpu.contractionpath.slicing import find_slicing
+    from tnc_tpu.ops.backends import JaxBackend
+    from tnc_tpu.ops.program import flat_leaf_tensors
+    from tnc_tpu.ops.sliced import build_sliced_program, execute_sliced_numpy
+
+    tn, result = _ghz_network(12)
+    replace = result.replace_path()
+    inputs = list(tn.tensors)
+    slicing = find_slicing(inputs, replace.toplevel, max(result.size / 8, 2.0))
+    if slicing.num_slices < 2:
+        pytest.skip("network did not slice")
+    sp = build_sliced_program(tn, replace, slicing)
+    arrays = [leaf.data.into_data() for leaf in flat_leaf_tensors(tn)]
+    want = execute_sliced_numpy(sp, arrays, dtype=np.complex128)
+    for strategy in ("loop", "chunked"):
+        backend = JaxBackend(dtype="complex64", sliced_strategy=strategy)
+        got = np.asarray(backend.execute_sliced(sp, arrays))
+        denom = max(float(np.max(np.abs(want))), 1e-30)
+        assert float(np.max(np.abs(got - want))) / denom <= 1e-5, strategy
+
+
+@requires_tpu_env
+def test_donation_keeps_result_correct_on_repeat(device):
+    """Donated buffers: running the same jitted program twice from fresh
+    host arrays must give identical results (no use-after-donate)."""
+    from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+    from tnc_tpu.ops.backends import JaxBackend
+    from tnc_tpu.ops.program import build_program, flat_leaf_tensors
+
+    tn, result = _ghz_network(10)
+    program = build_program(tn, result.replace_path())
+    arrays = [leaf.data.into_data() for leaf in flat_leaf_tensors(tn)]
+    backend = JaxBackend(dtype="complex64")
+    first = np.asarray(backend.execute(program, arrays))
+    second = np.asarray(backend.execute(program, arrays))
+    np.testing.assert_array_equal(first, second)

@@ -22,6 +22,14 @@ A from-scratch rebuild of the capabilities of qc-tum/TNC (reference:
 
 __version__ = "0.1.0"
 
+from tnc_tpu.utils.logging_config import (
+    configure_from_env as _configure_logging,
+    pin_platform_from_env as _pin_platform,
+)
+
+_configure_logging()
+_pin_platform()
+
 from tnc_tpu.tensornetwork.tensor import (  # noqa: F401
     CompositeTensor,
     LeafTensor,

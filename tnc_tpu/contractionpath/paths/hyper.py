@@ -121,14 +121,17 @@ class Hyperoptimizer(Pathfinder):
 
             assert self.target_size is not None
             try:
+                # Work-bounded repair (rounds only, no wall-clock
+                # deadline) so candidate ranking is reproducible
+                # run-to-run and machine-to-machine.
                 replace, slicing = slice_and_reconfigure(
                     inputs,
                     candidate,
                     self.target_size,
                     reconf_rounds=1,
-                    step_budget=2.0,
+                    step_budget=None,
                     final_rounds=2,
-                    final_budget=10.0,
+                    final_budget=None,
                 )
             except ValueError:
                 return math.inf
@@ -168,9 +171,17 @@ class Hyperoptimizer(Pathfinder):
                 seen.add(key)
                 unique.append(candidate)
 
-        score = sliced_score if self.target_size is not None else evaluate
-        best_path = min(unique, key=score)
-        return best_path
+        if self.target_size is not None:
+            scored = [(sliced_score(c), c) for c in unique]
+            best_score = min(s for s, _ in scored)
+            if math.isinf(best_score):
+                # No finalist could be sliced to the target: fall back to
+                # the raw-flops ranking explicitly (an arbitrary
+                # inf-scored pick would defer the failure to the caller's
+                # own slicing attempt, far from this decision).
+                return min(unique, key=evaluate)
+            return next(c for s, c in scored if s == best_score)
+        return min(unique, key=evaluate)
 
     def _bisection_path(
         self,

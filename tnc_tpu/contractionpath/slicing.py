@@ -156,8 +156,8 @@ def slice_and_reconfigure(
     subtree_size: int = 12,
     reconf_rounds: int = 1,
     final_rounds: int = 8,
-    step_budget: float = 4.0,
-    final_budget: float = 45.0,
+    step_budget: float | None = 4.0,
+    final_budget: float | None = 45.0,
     max_slices: int = 1 << 26,
     max_leg_candidates: int = 48,
 ) -> tuple[list[tuple[int, int]], Slicing]:

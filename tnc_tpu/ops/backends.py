@@ -18,6 +18,7 @@ nothing.
 
 from __future__ import annotations
 
+import logging
 import warnings
 from collections import OrderedDict
 from typing import Any, Sequence
@@ -25,6 +26,8 @@ from typing import Any, Sequence
 import numpy as np
 
 from tnc_tpu.ops.program import ContractionProgram
+
+logger = logging.getLogger(__name__)
 
 
 class Backend:
@@ -79,6 +82,11 @@ def jit_program(
     if fn is not None:
         _PROGRAM_JIT_CACHE.move_to_end(key)
     if fn is None:
+        logger.debug(
+            "jit: tracing program (%d steps, split_complex=%s)",
+            len(program.steps),
+            split_complex,
+        )
         import jax.numpy as jnp
 
         if split_complex:

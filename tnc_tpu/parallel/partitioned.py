@@ -34,10 +34,13 @@ DCN. There is no rank-local control flow to port.
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass
 from typing import Any, Sequence
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 from tnc_tpu.contractionpath.contraction_path import ContractionPath
 from tnc_tpu.ops.backends import jit_program, place_buffers
@@ -155,6 +158,14 @@ def scatter_partitions(
                 devices[mapping.device(i)],
             )
         )
+        # mirror of "Scattering tensor network" (communication.rs:132)
+        logger.debug(
+            "scatter: partition %d -> device %d (%d tensors, %d steps)",
+            i,
+            mapping.device(i),
+            len(child),
+            len(program.steps),
+        )
 
     comm = Communication(mapping, list(devices), programs, metas)
     return comm, buffers
@@ -167,12 +178,24 @@ def local_contract_partitions(
     precision,
 ) -> list[Any]:
     """Dispatch every partition's compiled program to its device. Async
-    dispatch → all devices run concurrently (the per-rank local phase)."""
-    results: list[Any] = []
-    for program, bufs in zip(comm.programs, buffers):
-        fn = jit_program(program, split_complex, precision)
-        results.append(fn(list(bufs)))
-    return results
+    dispatch → all devices run concurrently (the per-rank local phase).
+
+    First-run XLA compiles are driven from a thread pool: k distinct
+    partition programs would otherwise compile back-to-back on the main
+    thread (XLA compilation releases the GIL), serializing exactly the
+    phase that should overlap. Warm runs take the sequential fast path.
+    """
+    logger.debug("local phase: %d partition programs", len(comm.programs))
+    jobs = [
+        (jit_program(program, split_complex, precision), list(bufs))
+        for program, bufs in zip(comm.programs, buffers)
+    ]
+    if len(jobs) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=len(jobs)) as pool:
+            return list(pool.map(lambda job: job[0](job[1]), jobs))
+    return [fn(bufs) for fn, bufs in jobs]
 
 
 def intermediate_reduce(
@@ -192,6 +215,13 @@ def intermediate_reduce(
     held: list[Any] = list(results)
     for x, y in toplevel:
         target = comm.devices[comm.mapping.device(x)]
+        logger.debug(
+            "fan-in: partition %d (device %d) <- partition %d (device %d)",
+            x,
+            comm.mapping.device(x),
+            y,
+            comm.mapping.device(y),
+        )
         moved = jax.device_put(held[y], target)  # device-to-device (ICI)
         program, result_meta = _pair_program(metas[x], metas[y])
         fn = jit_program(program, split_complex, precision)

@@ -16,9 +16,12 @@ device count used in tests (the ``mpi_test`` analogue).
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Sequence
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 from tnc_tpu.contractionpath.contraction_path import ContractionPath
 from tnc_tpu.contractionpath.slicing import Slicing
@@ -167,6 +170,14 @@ def distributed_sliced_contraction(
 
     sp = build_sliced_program(tn, contract_path, slicing)
     leaves = flat_leaf_tensors(tn)
+    logger.debug(
+        "sliced SPMD: %d slices over %d devices (%d sliced legs, "
+        "split_complex=%s)",
+        slicing.num_slices,
+        mesh.shape[axis],
+        len(slicing.legs),
+        split_complex,
+    )
     fn = _make_spmd_fn(sp, mesh, axis, dtype, split_complex, precision)
     if split_complex:
         from tnc_tpu.ops.split_complex import combine_array, split_array

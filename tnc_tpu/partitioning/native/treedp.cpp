@@ -67,10 +67,13 @@ extern "C" {
 // logsize_cap: if >= 0, any non-root intermediate with log2(size) >
 // logsize_cap is forbidden (used by slice-aware reconfiguration);
 // returns 1 if no ordering satisfies the cap.
+// n is capped at 16: the subset DP is Theta(3^n) with no interruption
+// point, so n=17..20 could stall a caller minutes past its time budget
+// in a single uninterruptible solve (3^20 ~ 3.5e9 iterations).
 int tnc_optimal_order(int n, int nlegs, const uint64_t* leaf_masks,
                       const double* leg_logdims, int minimize,
                       double logsize_cap, double* out_cost, int* out_pairs) {
-    if (n < 2 || n > 20 || nlegs < 0) return 2;
+    if (n < 2 || n > 16 || nlegs < 0) return 2;
     const int nwords = (nlegs + 63) / 64;
     if (nwords == 0) return 2;
     const uint32_t full = (n == 32) ? 0xffffffffu : ((1u << n) - 1);

@@ -206,7 +206,7 @@ def native_optimal_order(
 
     lib = load_native()
     n = len(leg_sets)
-    if lib is None or not hasattr(lib, "tnc_optimal_order") or not 2 <= n <= 20:
+    if lib is None or not hasattr(lib, "tnc_optimal_order") or not 2 <= n <= 16:
         return None
     all_legs = sorted(set().union(*leg_sets))
     index = {leg: i for i, leg in enumerate(all_legs)}

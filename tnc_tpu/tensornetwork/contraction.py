@@ -14,11 +14,15 @@ here, at the host→device boundary, matching the reference's lazy
 
 from __future__ import annotations
 
+import logging
+
 from tnc_tpu.contractionpath.contraction_path import ContractionPath
 from tnc_tpu.ops.backends import Backend, get_backend
 from tnc_tpu.ops.program import build_program, flat_leaf_tensors
 from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
 from tnc_tpu.tensornetwork.tensordata import TensorData
+
+logger = logging.getLogger(__name__)
 
 
 def contract_tensor_network(
@@ -34,9 +38,20 @@ def contract_tensor_network(
     """
     backend_obj = get_backend(backend)
     program = build_program(tn, contract_path)
+    # mirror of the reference's contraction debug records
+    # (tensornetwork/contraction.rs:36,58)
+    logger.debug(
+        "contract: %d tensors, %d steps, backend=%s",
+        len(program.steps) + 1 if program.steps else 1,
+        len(program.steps),
+        backend_obj.name,
+    )
     leaves = flat_leaf_tensors(tn)
     arrays = [leaf.data.into_data() for leaf in leaves]
     result = backend_obj.execute(program, arrays)
+    logger.debug(
+        "contract done: result shape %s", tuple(program.result_shape)
+    )
     return LeafTensor(
         list(program.result_legs),
         list(program.result_shape),

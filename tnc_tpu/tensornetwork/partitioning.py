@@ -19,12 +19,15 @@ devices.
 from __future__ import annotations
 
 import enum
+import logging
 import random
 from typing import Sequence
 
 from tnc_tpu.partitioning.bisect import partition_kway
 from tnc_tpu.partitioning.hypergraph import hypergraph_from_tensors
 from tnc_tpu.tensornetwork.tensor import CompositeTensor
+
+logger = logging.getLogger(__name__)
 
 
 class PartitioningStrategy(enum.Enum):
@@ -56,6 +59,14 @@ def find_partitioning(
         tn.tensors, unit_vertex_weights=strategy is PartitioningStrategy.MIN_CUT
     )
     eps = imbalance if balanced else 0.3
+    logger.debug(
+        "partition: %d tensors, %d hyperedges -> k=%d (%s, imbalance %.2f)",
+        hg.num_vertices,
+        len(hg.edge_pins),
+        k,
+        strategy.value,
+        eps,
+    )
     return partition_kway(hg, k, eps, random.Random(seed))
 
 

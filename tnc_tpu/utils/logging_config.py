@@ -1,0 +1,64 @@
+"""Library-wide structured logging.
+
+The reference logs structured debug records throughout the library with
+the ``log`` crate (``mpi/communication.rs:132``,
+``tensornetwork/contraction.rs:36,58``) and lets the application pick the
+sink. Here every module logs through the std :mod:`logging` hierarchy
+under the ``tnc_tpu`` root logger; by default records propagate to
+whatever handlers the application configured.
+
+``TNC_TPU_LOG=<level>`` (debug/info/warning/...) attaches a stderr
+handler to the ``tnc_tpu`` logger at import time — the zero-setup way to
+watch the pipeline stages (compile, execute, partition, scatter, fan-in)
+of a run, mirroring the reference benchmark's ``flexi_logger``
+duplication to stdout (``benchmark/src/utils.rs:12-24``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+_FORMAT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
+
+
+def pin_platform_from_env() -> None:
+    """Honor ``TNC_TPU_PLATFORM=<cpu|tpu|...>`` by pinning JAX's platform
+    via ``jax.config`` at package import.
+
+    Plain ``JAX_PLATFORMS`` env vars can be overridden by interpreter
+    startup hooks that pre-wire JAX at an accelerator; ``jax.config``
+    wins as long as no backend has been initialized yet. This gives
+    examples and scripts one reliable knob
+    (``TNC_TPU_PLATFORM=cpu python examples/local_contraction.py``).
+    """
+    platform = os.environ.get("TNC_TPU_PLATFORM")
+    if not platform:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+    except Exception:
+        logging.getLogger("tnc_tpu").warning(
+            "could not pin platform %r (backend already initialized?)",
+            platform,
+        )
+
+
+def configure_from_env() -> None:
+    """Attach a stderr handler at ``TNC_TPU_LOG``'s level, if set."""
+    level_name = os.environ.get("TNC_TPU_LOG")
+    if not level_name:
+        return
+    level = getattr(logging, level_name.upper(), None)
+    if not isinstance(level, int):
+        return
+    root = logging.getLogger("tnc_tpu")
+    if any(getattr(h, "_tnc_tpu_env", False) for h in root.handlers):
+        return
+    handler = logging.StreamHandler()
+    handler.setFormatter(logging.Formatter(_FORMAT))
+    handler._tnc_tpu_env = True  # type: ignore[attr-defined]
+    root.addHandler(handler)
+    root.setLevel(level)
