@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Compile steps of one chunk individually (vmapped, split-complex) to
+find which step breaks the TPU compiler; prints full error for the first
+failure. Usage: CHUNK=3 [STEP_LO/STEP_HI] python scripts/chunk_bisect.py"""
+
+from __future__ import annotations
+
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scripts.hbm_probe import load_plan  # noqa: E402
+
+
+def main():
+    tn, replace, slicing, _ = load_plan()
+    from tnc_tpu.ops.sliced import build_sliced_program
+    from tnc_tpu.ops import chunked
+    from tnc_tpu.ops.program import flat_leaf_tensors
+    from tnc_tpu.ops.split_complex import apply_step_split
+
+    sp = build_sliced_program(tn, replace, slicing)
+    program = sp.program
+    B = int(os.environ.get("B", "8"))
+    chunk_steps = int(os.environ.get("CHUNK_STEPS", "48"))
+    ci = int(os.environ.get("CHUNK", "3"))
+    chunks = chunked.split_program(program, chunk_steps)
+
+    removed = set(slicing.legs)
+    shape_now = {}
+    for slot, leaf in enumerate(flat_leaf_tensors(tn)):
+        shape_now[slot] = tuple(d for l, d in leaf.edges() if l not in removed)
+    batched = {slot for slot, info in enumerate(sp.slot_slices) if info}
+
+    import jax
+    import jax.numpy as jnp
+
+    step_idx = 0
+    failed = 0
+    for cj, chunk in enumerate(chunks):
+        for st in chunk.steps:
+            if cj == ci:
+                a_shp, b_shp = shape_now[st.lhs], shape_now[st.rhs]
+                a_b = st.lhs in batched
+                b_b = st.rhs in batched
+                sa = jax.ShapeDtypeStruct(
+                    ((B,) + a_shp) if a_b else a_shp, jnp.float32
+                )
+                sb = jax.ShapeDtypeStruct(
+                    ((B,) + b_shp) if b_b else b_shp, jnp.float32
+                )
+
+                def single(ab, _st=st):
+                    return apply_step_split(jnp, ab[0], ab[1], _st, "float32")
+
+                in_ax = ((0, 0) if a_b else (None, None), (0, 0) if b_b else (None, None))
+                if a_b or b_b:
+                    fn = jax.vmap(single, in_axes=(in_ax,))
+                else:
+                    fn = single
+                t0 = time.monotonic()
+                try:
+                    c = jax.jit(fn).lower(((sa, sa), (sb, sb))).compile()
+                    ma = c.memory_analysis()
+                    tot = (
+                        ma.temp_size_in_bytes
+                        + ma.argument_size_in_bytes
+                        + ma.output_size_in_bytes
+                    )
+                    logical = (
+                        2
+                        * 4
+                        * (
+                            (B if a_b else 1) * math.prod(a_shp)
+                            + (B if b_b else 1) * math.prod(b_shp)
+                            + (B if (a_b or b_b) else 1) * math.prod(st.out_store)
+                        )
+                    )
+                    flag = " <<<" if tot > 2 * logical and tot > 2**28 else ""
+                    print(
+                        f"step {step_idx:3d}: tot={tot/2**30:7.3f}GiB "
+                        f"logical={logical/2**30:6.3f} ({time.monotonic()-t0:.1f}s){flag}"
+                    )
+                except Exception as e:
+                    print(f"step {step_idx:3d}: FAIL ({time.monotonic()-t0:.1f}s)")
+                    print("  a:", sa.shape, "view", st.a_view, "perm", st.a_perm, "dot", st.a_dot)
+                    print("  b:", sb.shape, "view", st.b_view, "perm", st.b_perm, "dot", st.b_dot)
+                    print("  swap", st.swap, "out", st.out_store)
+                    failed += 1
+                    if failed <= 2:
+                        print(str(e)[:3000])
+                sys.stdout.flush()
+            if st.lhs in batched or st.rhs in batched:
+                batched.add(st.lhs)
+            shape_now[st.lhs] = st.out_store
+            shape_now.pop(st.rhs, None)
+            step_idx += 1
+
+
+if __name__ == "__main__":
+    main()

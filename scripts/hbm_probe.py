@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Probe compiled HBM usage of the north-star's chunked executor, chunk by
+chunk, against the real device (AOT lower+compile, no execution).
+
+Usage: python scripts/hbm_probe.py [--batch 8] [--chunk-steps 48]
+Caches the (network, path, slicing) plan to .cache/northstar_plan.pkl so
+iteration on the executor doesn't re-run the 40s hyper-optimizer.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pickle
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CACHE = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".cache")
+
+
+def load_plan(qubits=53, depth=14, seed=42, target_log2=28.0, ntrials=64):
+    os.makedirs(CACHE, exist_ok=True)
+    key = f"northstar_{qubits}_{depth}_{seed}_{target_log2}_{ntrials}.pkl"
+    path = os.path.join(CACHE, key)
+    if os.path.exists(path):
+        with open(path, "rb") as f:
+            return pickle.load(f)
+
+    from tnc_tpu.builders.sycamore_circuit import sycamore_circuit
+    from tnc_tpu.contractionpath.contraction_path import ContractionPath
+    from tnc_tpu.contractionpath.paths.hyper import Hyperoptimizer
+    from tnc_tpu.contractionpath.slicing import slice_and_reconfigure, sliced_flops
+    from tnc_tpu.tensornetwork.simplify import simplify_network
+
+    rng = np.random.default_rng(seed)
+    raw, _ = sycamore_circuit(qubits, depth, rng).into_amplitude_network("0" * qubits)
+    tn = simplify_network(raw)
+    target = 2.0 ** target_log2
+    t0 = time.monotonic()
+    result = Hyperoptimizer(ntrials=ntrials, seed=seed, target_size=target).find_path(tn)
+    print(f"planned in {time.monotonic()-t0:.1f}s flops={result.flops:.3e}")
+    inputs = list(tn.tensors)
+    replace_pairs, slicing = slice_and_reconfigure(inputs, result.ssa_path.toplevel, target)
+    replace = ContractionPath.simple(replace_pairs)
+    total_flops = sliced_flops(inputs, replace.toplevel, slicing)
+    print(f"slices={slicing.num_slices} total_flops={total_flops:.3e}")
+    plan = (tn, replace, slicing, total_flops)
+    with open(path, "wb") as f:
+        pickle.dump(plan, f)
+    return plan
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--chunk-steps", type=int, default=48)
+    ap.add_argument("--target-log2", type=float, default=28.0)
+    ap.add_argument("--max-chunks", type=int, default=0)
+    args = ap.parse_args()
+
+    tn, replace, slicing, total_flops = load_plan(target_log2=args.target_log2)
+
+    from tnc_tpu.ops.sliced import build_sliced_program
+    from tnc_tpu.ops import chunked
+
+    sp = build_sliced_program(tn, replace, slicing)
+    print(f"program: {len(sp.program.steps)} steps, {sp.program.num_inputs} inputs")
+
+    import jax
+    import jax.numpy as jnp
+
+    chunks = chunked.split_program(sp.program, args.chunk_steps)
+    print(f"{len(chunks)} chunks of <= {args.chunk_steps} steps")
+
+    # replicate the chunked executor's batching decisions
+    batched: set[int] = {slot for slot, info in enumerate(sp.slot_slices) if info}
+    batched_after: list[set[int]] = []
+    current = set(batched)
+    for chunk in chunks:
+        for step in chunk.steps:
+            if step.lhs in current or step.rhs in current:
+                current.add(step.lhs)
+        batched_after.append(set(current))
+
+    # shapes of slot buffers at chunk entry: leaves are slice-reduced leaf
+    # shapes; intermediates live in their producer's ``out_store`` shape
+    from tnc_tpu.ops.program import flat_leaf_tensors
+
+    leaves = flat_leaf_tensors(tn)
+    removed = set(slicing.legs)
+    slot_shape: dict[int, tuple[int, ...]] = {}
+    for slot, leaf in enumerate(leaves):
+        slot_shape[slot] = tuple(d for l, d in leaf.edges() if l not in removed)
+
+    B = args.batch
+    total_peak = 0
+    worst = (0, -1)
+    n_probe = args.max_chunks or len(chunks)
+    for ci, chunk in enumerate(chunks):
+        pre_b = batched if ci == 0 else batched_after[ci - 1]
+        in_specs = []
+        for slot in chunk.in_slots:
+            shp = slot_shape[slot]
+            if slot in pre_b:
+                shp = (B,) + shp
+            # split-complex: pair of f32
+            in_specs.append(
+                (
+                    jax.ShapeDtypeStruct(shp, jnp.float32),
+                    jax.ShapeDtypeStruct(shp, jnp.float32),
+                )
+            )
+
+        def single(ins, _chunk=chunk):
+            state = dict(zip(_chunk.in_slots, ins))
+            chunked._run_chunk_split(jnp, _chunk, state, "float32")
+            return tuple(state[s] for s in _chunk.out_slots)
+
+        in_axes = []
+        for slot in chunk.in_slots:
+            ax = 0 if slot in pre_b else None
+            in_axes.append((ax, ax))
+        out_axes = []
+        post_b = batched_after[ci]
+        for slot in chunk.out_slots:
+            ax = 0 if slot in post_b else None
+            out_axes.append((ax, ax))
+
+        has_axis = any(a != (None, None) for a in in_axes)
+        if has_axis:
+            fn = jax.vmap(single, in_axes=(tuple(in_axes),), out_axes=tuple(out_axes))
+        else:
+            fn = single
+
+        t0 = time.monotonic()
+        try:
+            compiled = jax.jit(fn).lower(tuple(in_specs)).compile()
+            ma = compiled.memory_analysis()
+            peak = ma.temp_size_in_bytes
+            argb = ma.argument_size_in_bytes
+            outb = ma.output_size_in_bytes
+            print(
+                f"chunk {ci:3d}: steps={len(chunk.steps):3d} "
+                f"args={argb/2**30:7.3f}GiB out={outb/2**30:7.3f}GiB "
+                f"temp={peak/2**30:7.3f}GiB  ({time.monotonic()-t0:.1f}s)"
+            )
+            tot = peak + argb + outb
+            if tot > worst[0]:
+                worst = (tot, ci)
+        except Exception as e:
+            msg = str(e).split("\n")[0][:300]
+            print(f"chunk {ci:3d}: COMPILE FAIL ({time.monotonic()-t0:.1f}s): {msg}")
+            worst = (float("inf"), ci)
+
+        # advance slot shapes through the chunk (storage form)
+        for step in chunk.steps:
+            slot_shape[step.lhs] = step.out_store
+            slot_shape.pop(step.rhs, None)
+        if ci + 1 >= n_probe:
+            break
+
+    print(f"worst chunk: {worst[1]} total={worst[0]/2**30 if worst[0] != float('inf') else 'inf'}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Host-side analysis of the north-star program: per-step view/perm
+structure, dot shapes, post-perm minor dims, and estimated TPU tile
+padding (f32: minor dim pads to 128). No device needed."""
+
+from __future__ import annotations
+
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scripts.hbm_probe import load_plan  # noqa: E402
+
+
+def pad_ratio(shape):
+    """Estimated tile-padding factor: minor pads to 128 (sublane tiles
+    shrink to fit, so the second-minor is ignored)."""
+    if not shape:
+        return 1.0
+    minor = shape[-1]
+    return (-(-minor // 128) * 128) / minor if minor < 128 else 1.0
+
+
+def main():
+    tn, replace, slicing, _ = load_plan()
+    from tnc_tpu.ops.sliced import build_sliced_program
+
+    sp = build_sliced_program(tn, replace, slicing)
+    min_mi = float(os.environ.get("MIN_MI", "4")) * 2**20
+    print(f"{len(sp.program.steps)} steps; flagging ops >= {min_mi/2**20:.0f}Mi")
+    rows = []
+    for i, st in enumerate(sp.program.steps):
+        a_sz = math.prod(st.a_view) if st.a_view else 1
+        b_sz = math.prod(st.b_view) if st.b_view else 1
+        o_sz = math.prod(st.out_store) if st.out_store else 1
+        if max(a_sz, b_sz, o_sz) < min_mi:
+            continue
+
+        def post(view, perm):
+            return tuple(view[p] for p in perm) if perm else view
+
+        pa, pb = post(st.a_view, st.a_perm), post(st.b_view, st.b_perm)
+        worst = max(
+            pad_ratio(pa) * a_sz, pad_ratio(pb) * b_sz, pad_ratio(st.out_store) * o_sz
+        )
+        rows.append((worst, i, st, pa, pb, a_sz, b_sz, o_sz))
+
+    rows.sort(reverse=True)
+    for worst, i, st, pa, pb, a_sz, b_sz, o_sz in rows[:20]:
+        print(
+            f"step {i:3d}: k={st.a_dot[0]:<6d} a={a_sz/2**20:7.1f}Mi "
+            f"b={b_sz/2**20:7.1f}Mi o={o_sz/2**20:7.1f}Mi "
+            f"padded-worst={worst/2**20:9.1f}Mi"
+        )
+        print(f"   a view={st.a_view} perm={st.a_perm} -> {pa}")
+        print(f"   b view={st.b_view} perm={st.b_perm} -> {pb}")
+        print(f"   out_store={st.out_store} swap={st.swap}")
+
+
+if __name__ == "__main__":
+    main()

@@ -40,22 +40,51 @@ class Backend:
         raise NotImplementedError
 
 
+def _prep_operand(xp, buf, view, perm, dot_shape):
+    """Stored buffer → ``(k, free-run dims…)`` dot operand: reshape to the
+    fused view, one macro transpose to (contract…, free…), and a
+    leading-axes merge of the contract runs (layout-free on TPU — tiling
+    only constrains trailing dims). See :mod:`tnc_tpu.ops.program`."""
+    v = buf.reshape(view)
+    if perm is not None:
+        v = xp.transpose(v, perm)
+    return v.reshape(dot_shape)
+
+
 def apply_step(xp, a: Any, b: Any, step) -> Any:
-    """One pairwise contraction on matrix-shaped buffers. The fused
-    pre-shape/macro-perm keeps every device array low-rank (rank-25+
-    logical shapes break the TPU compiler — see PairStep docstring);
-    the single source of truth for the step kernel, shared by the whole-
-    program, sliced-loop, and chunked executors."""
-    a = xp.transpose(a.reshape(step.lhs_pre), step.lhs_mperm).reshape(step.lhs_mat)
-    b = xp.transpose(b.reshape(step.rhs_pre), step.rhs_mperm).reshape(step.rhs_mat)
-    return xp.matmul(a, b)
+    """One pairwise contraction; the single source of truth for the step
+    kernel, shared by the whole-program, sliced-loop, and chunked
+    executors.
+
+    Device path: one ``lax.dot_general`` contracting the single leading
+    ``k`` dim of both operands — XLA performs no internal relayout and
+    every materialized buffer keeps a large minor dim (see
+    :mod:`tnc_tpu.ops.program`). Host path: the equivalent 2-D matmul."""
+    av = _prep_operand(xp, a, step.a_view, step.a_perm, step.a_dot)
+    bv = _prep_operand(xp, b, step.b_view, step.b_perm, step.b_dot)
+    if xp is np:
+        a2 = av.reshape(step.a_mat)  # (k, m)
+        b2 = bv.reshape(step.b_mat)  # (k, n)
+        out = (b2.T @ a2) if step.swap else (a2.T @ b2)
+        return out.reshape(step.out_store)
+    from jax import lax
+
+    dims = (((0,), (0,)), ((), ()))
+    if step.swap:
+        out = lax.dot_general(bv, av, dims)
+    else:
+        out = lax.dot_general(av, bv, dims)
+    return out.reshape(step.out_store)
 
 
 def _run_steps(xp, program: ContractionProgram, buffers: list[Any]) -> Any:
+    """Execute all steps; returns the result in **stored** (merged) shape —
+    callers reshape to ``program.result_shape`` on the host, so the jit
+    output never materializes a high-rank tile-padded array."""
     for step in program.steps:
         buffers[step.lhs] = apply_step(xp, buffers[step.lhs], buffers[step.rhs], step)
         buffers[step.rhs] = None  # free eagerly
-    return buffers[program.result_slot].reshape(program.result_shape)
+    return buffers[program.result_slot]
 
 
 _PROGRAM_JIT_CACHE: "OrderedDict[tuple, Any]" = OrderedDict()
@@ -156,7 +185,8 @@ class NumpyBackend(Backend):
 
     def execute(self, program: ContractionProgram, arrays: Sequence[Any]) -> np.ndarray:
         buffers = [np.asarray(a, dtype=self.dtype) for a in arrays]
-        return np.asarray(_run_steps(np, program, buffers))
+        out = _run_steps(np, program, buffers)
+        return np.asarray(out).reshape(program.result_shape)
 
     def execute_sliced(self, sp, arrays: Sequence[Any]) -> np.ndarray:
         from tnc_tpu.ops.sliced import execute_sliced_numpy
@@ -223,8 +253,8 @@ class JaxBackend(Backend):
         if self.split_complex:
             from tnc_tpu.ops.split_complex import combine_array
 
-            return combine_array(*result)
-        return np.asarray(result)
+            return combine_array(*result).reshape(program.result_shape)
+        return np.asarray(result).reshape(program.result_shape)
 
     def _run(self, program: ContractionProgram, buffers: list[Any]):
         return self._compiled(program)(buffers)
@@ -263,13 +293,16 @@ class JaxBackend(Backend):
         if self.split_complex:
             from tnc_tpu.ops.split_complex import combine_array
 
-            return combine_array(*result)
-        return np.asarray(result)
+            return combine_array(*result).reshape(sp.program.result_shape)
+        return np.asarray(result).reshape(sp.program.result_shape)
 
     def execute_on_device(self, program: ContractionProgram, arrays: Sequence[Any]):
         """Like :meth:`execute` but leaves the result on device (no host
         round-trip; a (real, imag) pair in split mode) — used for
-        benchmarking and distributed fan-in.
+        benchmarking and distributed fan-in. The buffer is in **stored**
+        shape (``program.stored_result_shape``) with axes in
+        ``program.result_legs`` order, not ``result_shape``/canonical
+        order — reshape/permute host-side when leg semantics matter.
         """
         return self._run(program, self._device_buffers(arrays))
 

@@ -199,7 +199,7 @@ def _compiled_plan(
             fn = jax.jit(single)
         chunk_fns.append(fn)
 
-    result_shape = sp.program.result_shape
+    result_shape = sp.program.stored_result_shape
 
     if split_complex:
 
@@ -278,14 +278,14 @@ def execute_sliced_batched_jax(
     device_full = place_buffers(arrays, dtype, split_complex, device)
 
     part_dtype = "float64" if "128" in str(dtype) else "float32"
-    result_shape = sp.program.result_shape
+    stored_shape = sp.program.stored_result_shape
     if split_complex:
         acc = (
-            jnp.zeros(result_shape, dtype=part_dtype),
-            jnp.zeros(result_shape, dtype=part_dtype),
+            jnp.zeros(stored_shape, dtype=part_dtype),
+            jnp.zeros(stored_shape, dtype=part_dtype),
         )
     else:
-        acc = jnp.zeros(result_shape, dtype=dtype)
+        acc = jnp.zeros(stored_shape, dtype=dtype)
 
     for start in range(0, num, batch):
         idx = jnp.asarray(all_indices[start : start + batch])
@@ -303,5 +303,5 @@ def execute_sliced_batched_jax(
     if split_complex:
         from tnc_tpu.ops.split_complex import combine_array
 
-        return combine_array(acc[0], acc[1])
-    return np.asarray(acc)
+        return combine_array(acc[0], acc[1]).reshape(sp.program.result_shape)
+    return np.asarray(acc).reshape(sp.program.result_shape)

@@ -3,83 +3,278 @@
 The reference executes a path as a loop of TBLIS einsum calls, one per pair
 (``tnc/src/tensornetwork/contraction.rs:52-57,88-116``). On TPU, the whole
 path is known before execution and every shape is static, so we compile it
-once into a :class:`ContractionProgram`: a flat list of
-transpose→reshape→matmul→reshape steps. This form
+once into a :class:`ContractionProgram`: a flat list of :class:`PairStep`
+dot-contractions traced into one (or a few) XLA programs.
 
-- maps every pairwise contraction onto the MXU as a single matmul,
-- avoids einsum-label limits for high-rank tensors (statevector networks
-  can exceed 50 open legs),
-- is traceable by ``jax.jit`` as one XLA program, so intermediates stay in
-  HBM, elementwise glue is fused, and buffers are freed eagerly
-  (the reference frees inputs per step via ``Option::take``,
-  ``contraction.rs:39,53-56``; XLA liveness analysis does the same here).
+TPU layout discipline (the design constraint that shapes this module):
+an f32 array is stored in (sublane×128-lane) tiles over its two trailing
+dims, and a trailing dim < 128 is *padded up to 128* — a high-rank
+quantum-circuit tensor stored as (…, 2, 2) wastes up to 64× HBM and
+bandwidth. The compiler therefore guarantees:
 
-A pairwise contraction of ``a`` (legs La) and ``b`` (legs Lb) with shared
-legs S = La∩Lb computes ``out = a_keep × S · S × b_keep`` and produces the
-legs ``(La-Lb) ++ (Lb-La)`` — exactly the reference's ``a ^ b`` ordering,
-so no extra transpose is needed afterwards.
+- **Stored form**: every intermediate lives in its dot-output shape with
+  trailing axes merged until the minor dim is ≥ 128 (`_storage_merge`) —
+  zero tile padding for every large buffer.
+- **One aligned macro-transpose per operand, or none**: an operand is
+  brought to ``(contracted…, free…)`` order by a single low-rank
+  transpose over *run-fused* macro axes. Intra-group leg order always
+  follows the operand's stored order (never a leg-id sort), so the
+  permutation degrades into a handful of contiguous block moves whose
+  output keeps a large minor dim.
+- **dot_general with contiguous contracting dims**: the contraction
+  itself never asks XLA to relayout an operand internally.
+- **Consumer alignment**: each step knows which of its output legs the
+  next step contracts (`next_shared`) and emits its free legs as
+  [consumer-contracted…, consumer-kept…] (stored-order within each), so
+  the consumer's transpose is a ≤4-block permutation. Storage merges
+  also stop at that boundary, keeping the consumer's reshape view a
+  layout-free regroup.
+
+The whole-program jit then keeps intermediates in HBM, fuses elementwise
+glue, and frees buffers eagerly (the reference frees inputs per step via
+``Option::take``, ``contraction.rs:39,53-56``; XLA liveness does the same
+here).
 """
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass
 
 from tnc_tpu.contractionpath.contraction_path import ContractionPath
 from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor, Tensor
+
+_MIN_MINOR = 128  # f32 lane tile: trailing dims below this pad up to it
 
 
 @dataclass(frozen=True)
 class PairStep:
     """One pairwise contraction, fully shape-resolved.
 
-    ``*_perm`` are the logical (per-leg) permutations; executors use the
-    fused ``*_pre``/``*_mperm`` forms instead: the logical permutation
-    with runs of consecutive source axes that stay consecutive collapsed
-    into single macro axes. Quantum-circuit tensors are high-rank with
-    all-dim-2 legs (rank 25+ after slicing Sycamore-53), and the TPU
-    compiler blows up on rank-20+ transposes, while the fused macro
-    transpose is typically rank <= 8 over the same elements. Device
-    buffers hold each intermediate as its (m, n) matmul result — the
-    high-rank logical shape never materializes on device.
+    Executors reshape each operand's stored buffer to the run-fused
+    ``*_view``, apply ``*_perm`` (identity ⇒ ``None``), contract the
+    leading ``n_contract`` axes of both views against each other
+    (``lax.dot_general`` on device, 2-D matmul on the host oracle), and
+    store the result reshaped to ``out_store``.
+
+    ``swap``: the dot is issued as (rhs, lhs) so the operand with the
+    larger trailing free run supplies the output's minor dims.
     """
 
     lhs: int  # slot of left input (result replaces this slot)
     rhs: int  # slot of right input (freed after the step)
-    lhs_perm: tuple[int, ...]  # transpose to (keep…, shared…)
-    rhs_perm: tuple[int, ...]  # transpose to (shared…, keep…)
-    lhs_mat: tuple[int, int]  # (m, k) matmul view of lhs
-    rhs_mat: tuple[int, int]  # (k, n) matmul view of rhs
-    out_shape: tuple[int, ...]  # final result shape for this step
-    lhs_pre: tuple[int, ...] = ()  # fused reshape before macro transpose
-    lhs_mperm: tuple[int, ...] = ()  # macro transpose
-    rhs_pre: tuple[int, ...] = ()
-    rhs_mperm: tuple[int, ...] = ()
+    a_view: tuple[int, ...]  # fused macro view of lhs stored buffer
+    a_perm: tuple[int, ...] | None  # macro transpose to (contract…, free…)
+    a_dot: tuple[int, ...]  # post-perm reshape to (k, free-run dims…)
+    b_view: tuple[int, ...]
+    b_perm: tuple[int, ...] | None
+    b_dot: tuple[int, ...]
+    swap: bool  # issue dot as (b, a): output legs = b_free ++ a_free
+    out_store: tuple[int, ...]  # storage shape of the result buffer
+
+    @property
+    def a_mat(self) -> tuple[int, int]:
+        """2-D (k, m) view for the host matmul oracle."""
+        return (self.a_dot[0], int(math.prod(self.a_dot[1:])))
+
+    @property
+    def b_mat(self) -> tuple[int, int]:
+        return (self.b_dot[0], int(math.prod(self.b_dot[1:])))
 
 
-def _fuse_perm(
-    dims: tuple[int, ...], perm: tuple[int, ...]
-) -> tuple[tuple[int, ...], tuple[int, ...]]:
-    """Run-length fuse a permutation: maximal runs of consecutive source
-    axes that appear consecutively in ``perm`` become one macro axis.
-    Returns (pre_shape in source order, macro permutation)."""
-    if not perm:
-        return (1,), (0,)
-    runs: list[list[int]] = [[perm[0]]]
-    for p in perm[1:]:
-        if p == runs[-1][-1] + 1:
-            runs[-1].append(p)
+def _storage_merge(
+    dims: list[int], categories: list[int] | None = None
+) -> tuple[int, ...]:
+    """Merge adjacent axes into a storage shape: all same-category runs
+    collapse, and trailing axes keep merging (across categories if
+    necessary) until the minor dim reaches ``_MIN_MINOR``.
+
+    ``categories[i]`` groups axes the *consumer* treats alike (contracted
+    vs kept); merging inside a category keeps the consumer's reshape a
+    pure regroup.  ``None`` ⇒ merge everything.
+    """
+    if not dims:
+        return ()
+    if categories is None:
+        categories = [0] * len(dims)
+    merged: list[int] = [dims[0]]
+    mcat: list[int] = [categories[0]]
+    for d, c in zip(dims[1:], categories[1:]):
+        if c == mcat[-1]:
+            merged[-1] *= d
         else:
-            runs.append([p])
-    source_order = sorted(range(len(runs)), key=lambda r: runs[r][0])
-    pre_shape = []
-    for ri in source_order:
-        d = 1
-        for p in runs[ri]:
-            d *= dims[p]
-        pre_shape.append(d)
-    pos_in_source = {ri: k for k, ri in enumerate(source_order)}
-    macro_perm = tuple(pos_in_source[ri] for ri in range(len(runs)))
-    return tuple(pre_shape), macro_perm
+            merged.append(d)
+            mcat.append(c)
+    # trailing merge to reach a well-tiled minor dim (cross-category only
+    # when a large buffer would otherwise pad)
+    while len(merged) > 1 and merged[-1] < _MIN_MINOR:
+        tail = merged.pop()
+        merged[-1] *= tail
+        mcat.pop()
+    return tuple(merged)
+
+
+def _fused_view(
+    edges: list[tuple[int, int]], key: dict[int, tuple]
+) -> tuple:
+    """Run-fuse one operand for a contraction.
+
+    ``edges``: stored (leg, dim) list.  ``key``: leg → desired sort key;
+    contracted legs sort first (key[0] == 0), free legs after
+    (key[0] == 1).
+
+    Each operand fuses at its **own** run granularity — the two operands'
+    contract parts need not match axis-for-axis, because the executor
+    merges every post-perm contract axis into one leading ``k`` dim (a
+    leading-axes reshape, layout-free on TPU) before the dot. This keeps
+    the big operand's transpose at its natural ≤6-ish rank instead of
+    refining it down to the small operand's fragmentation.
+
+    Returns: fused view shape, macro perm (or None), dot shape
+    ``(k, free-run dims…)``, and the post-perm free (leg-group, dim) list.
+    """
+    runs: list[list[tuple[int, int]]] = []
+    order = {
+        leg: i
+        for i, (leg, _) in enumerate(sorted(edges, key=lambda e: key[e[0]]))
+    }
+    for leg, dim in edges:
+        if (
+            runs
+            and order[leg] == order[runs[-1][-1][0]] + 1
+            and key[leg][0] == key[runs[-1][-1][0]][0]
+        ):
+            runs[-1].append((leg, dim))
+        else:
+            runs.append([(leg, dim)])
+
+    view = tuple(int(math.prod(d for _, d in run)) for run in runs)
+    perm_order = sorted(range(len(runs)), key=lambda i: key[runs[i][0][0]])
+
+    # Tail guard: the post-perm trailing run becomes the materialized
+    # operand's minor dim. Free runs keep stored order (contract-leg
+    # extraction is then a cheap leading-dim row gather over an intact
+    # tail); only when the trailing run is small — e.g. the stored tail
+    # itself got contracted — move the largest free run to the minor
+    # position so the relayout this step pays anyway stays well-tiled.
+    free_idx = [i for i in perm_order if key[runs[i][0][0]][0] != 0]
+    if free_idx and view[free_idx[-1]] < _MIN_MINOR:
+        biggest = max(free_idx, key=lambda i: view[i])
+        if biggest != free_idx[-1] and view[biggest] > view[free_idx[-1]]:
+            perm_order.remove(biggest)
+            perm_order.append(biggest)
+
+    perm: tuple[int, ...] | None = tuple(perm_order)
+    if perm == tuple(range(len(runs))):
+        perm = None
+    k = 1
+    free = []
+    for i in perm_order:
+        if key[runs[i][0][0]][0] == 0:
+            k *= view[i]
+        else:
+            free.append(([leg for leg, _ in runs[i]], view[i]))
+    dot_shape = (k,) + tuple(d for _, d in free)
+    return view, perm, dot_shape, free
+
+
+_INF_DEATH = 1 << 60
+
+
+def _pair_step(
+    lhs: int,
+    rhs: int,
+    ta: LeafTensor,
+    tb: LeafTensor,
+    death: dict[int, int] | None = None,
+) -> tuple[PairStep, LeafTensor]:
+    """Build one contraction step.
+
+    ``ta``/``tb`` carry each operand's legs in **stored buffer order**.
+    Free legs keep that order (see `_fused_view`); ``death`` (leg → index
+    of the future step that contracts it) is used to stop storage merges
+    at the immediate consumer's contract/keep boundary, so the consumer's
+    reshape stays a layout-free regroup.
+    """
+    a_edges = list(ta.edges())
+    b_edges = list(tb.edges())
+    a_set = {leg for leg, _ in a_edges}
+    b_set = {leg for leg, _ in b_edges}
+    shared = a_set & b_set
+    if death is None:
+        death = {}
+
+    # k-order follows the larger operand's stored order: its contract part
+    # stays in few runs; only the smaller operand pays an interleave.
+    a_size = ta.size()
+    b_size = tb.size()
+    big_edges = b_edges if b_size > a_size else a_edges
+    contract_order = [leg for leg, _ in big_edges if leg in shared]
+
+    def keys(edges):
+        key: dict[int, tuple] = {}
+        stored_pos = {leg: i for i, (leg, _) in enumerate(edges)}
+        cpos = {leg: i for i, leg in enumerate(contract_order)}
+        for leg, _ in edges:
+            if leg in shared:
+                key[leg] = (0, cpos[leg])
+            else:
+                # frees keep stored order: no merge-shuffle ever builds
+                # up, and the contract extraction is a leading-dim row
+                # gather over the intact trailing block
+                key[leg] = (1, stored_pos[leg])
+        return key
+
+    a_key = keys(a_edges)
+    b_key = keys(b_edges)
+
+    a_view, a_perm, a_dot, a_free = _fused_view(a_edges, a_key)
+    b_view, b_perm, b_dot, b_free = _fused_view(b_edges, b_key)
+    assert a_dot[0] == b_dot[0], "contract dims must agree"
+
+    # orientation: the dot-rhs supplies the output's trailing dims — pick
+    # the operand with the larger trailing free run so the stored result
+    # keeps a well-tiled minor dim.
+    a_tail = a_free[-1][1] if a_free else 1
+    b_tail = b_free[-1][1] if b_free else 1
+    swap = a_tail > b_tail
+
+    first, second = (b_free, a_free) if swap else (a_free, b_free)
+    out_legs = [leg for legs, _ in first for leg in legs] + [
+        leg for legs, _ in second for leg in legs
+    ]
+    dim_of = {leg: d for leg, d in a_edges}
+    dim_of.update({leg: d for leg, d in b_edges})
+    out_dims = [dim_of[leg] for leg in out_legs]
+
+    # storage merge boundary: the immediate consumer's contract set = the
+    # earliest-dying cohort among the output legs. Categorize at LEG
+    # granularity (a fused run can mix cohorts) so merges never cross the
+    # consumer's contract/keep split.
+    consumer_step = min(
+        (death.get(leg, _INF_DEATH) for leg in out_legs), default=_INF_DEATH
+    )
+    out_leg_cat = [
+        0 if death.get(leg, _INF_DEATH) == consumer_step else 1
+        for leg in out_legs
+    ]
+    out_store = _storage_merge(list(out_dims), out_leg_cat)
+    if not out_store:
+        out_store = (1,)
+
+    step = PairStep(
+        lhs=lhs,
+        rhs=rhs,
+        a_view=a_view,
+        a_perm=a_perm,
+        a_dot=a_dot,
+        b_view=b_view,
+        b_perm=b_perm,
+        b_dot=b_dot,
+        swap=swap,
+        out_store=out_store,
+    )
+    return step, LeafTensor(out_legs, out_dims)
 
 
 @dataclass(frozen=True)
@@ -91,98 +286,34 @@ class ContractionProgram:
     result_slot: int
     result_legs: tuple[int, ...]
     result_shape: tuple[int, ...]
+    stored_result_shape: tuple[int, ...] = ()
+    # reference leg order (the ``^``-fold, ``contraction.rs:70-86``);
+    # public APIs permute the buffer to this order host-side
+    canonical_legs: tuple[int, ...] = ()
+
+    def __post_init__(self):
+        if not self.stored_result_shape:
+            object.__setattr__(
+                self,
+                "stored_result_shape",
+                self.steps[-1].out_store if self.steps else self.result_shape,
+            )
+        if not self.canonical_legs:
+            object.__setattr__(self, "canonical_legs", self.result_legs)
+
+    def canonical_perm(self) -> tuple[int, ...] | None:
+        """Axis permutation taking the result buffer (``result_legs``
+        order) to the reference's canonical order, or None if identity."""
+        if self.canonical_legs == self.result_legs:
+            return None
+        pos = {leg: i for i, leg in enumerate(self.result_legs)}
+        return tuple(pos[leg] for leg in self.canonical_legs)
 
     def signature(self) -> tuple:
         """Hashable identity for jit-compilation caching. ``result_shape``
-        matters: the jitted body reshapes the final buffer to it, so two
-        zero-step programs with different shapes must not share a key."""
+        matters: two zero-step programs with different shapes must not
+        share a key."""
         return (self.num_inputs, self.steps, self.result_slot, self.result_shape)
-
-
-def _pair_step(
-    lhs: int,
-    rhs: int,
-    ta: LeafTensor,
-    tb: LeafTensor,
-    next_shared: set[int] | None = None,
-) -> tuple[PairStep, LeafTensor]:
-    """Build one contraction step.
-
-    ``next_shared``: the legs of this step's *output* that its consumer
-    step will contract away. When known, both keep-groups are emitted as
-    [kept-by-consumer…, contracted-by-consumer…] (sorted by leg id within
-    each), so the consumer's transpose degrades from a per-leg
-    interleave (rank ~ tensor rank) to a handful of contiguous segments
-    — the reorder is free here because it rides this step's transpose.
-    """
-    b_leg_set = set(tb.legs)
-    a_leg_set = set(ta.legs)
-
-    a_keep = [(pos, leg, dim) for pos, (leg, dim) in enumerate(ta.edges()) if leg not in b_leg_set]
-    a_shared = [(pos, leg, dim) for pos, (leg, dim) in enumerate(ta.edges()) if leg in b_leg_set]
-    b_keep = [(pos, leg, dim) for pos, (leg, dim) in enumerate(tb.edges()) if leg not in a_leg_set]
-
-    if next_shared is not None:
-        group = lambda item: (item[1] in next_shared, item[1])  # noqa: E731
-        a_keep.sort(key=group)
-        b_keep.sort(key=group)
-
-    # The k-dimension needs one common shared-leg order. Follow the
-    # *larger* operand's axis order: its shared segment then stays
-    # contiguous (cheap transpose on the expensive tensor) and only the
-    # smaller operand pays the interleaved reorder.
-    b_pos_of_leg = {leg: pos for pos, leg in enumerate(tb.legs)}
-    if tb.size() > ta.size():
-        b_shared = [
-            (pos, leg, dim)
-            for pos, (leg, dim) in enumerate(tb.edges())
-            if leg in a_leg_set
-        ]
-        a_pos_of_leg = {leg: pos for pos, leg in enumerate(ta.legs)}
-        a_dim_of_leg = {leg: dim for leg, dim in ta.edges()}
-        a_shared = [
-            (a_pos_of_leg[leg], leg, a_dim_of_leg[leg])
-            for (_, leg, _) in b_shared
-        ]
-    else:
-        b_shared = [(b_pos_of_leg[leg], leg, dim) for (_, leg, dim) in a_shared]
-
-    m = 1
-    for _, _, d in a_keep:
-        m *= d
-    k = 1
-    for _, _, d in a_shared:
-        k *= d
-    n = 1
-    for _, _, d in b_keep:
-        n *= d
-
-    lhs_perm = tuple(p for p, _, _ in a_keep) + tuple(p for p, _, _ in a_shared)
-    rhs_perm = tuple(p for p, _, _ in b_shared) + tuple(p for p, _, _ in b_keep)
-
-    out_legs = [leg for _, leg, _ in a_keep] + [leg for _, leg, _ in b_keep]
-    out_dims = [dim for _, _, dim in a_keep] + [dim for _, _, dim in b_keep]
-    result = LeafTensor(out_legs, out_dims)
-
-    a_dims = tuple(d for _, d in ta.edges())
-    b_dims = tuple(d for _, d in tb.edges())
-    lhs_pre, lhs_mperm = _fuse_perm(a_dims, lhs_perm)
-    rhs_pre, rhs_mperm = _fuse_perm(b_dims, rhs_perm)
-
-    step = PairStep(
-        lhs=lhs,
-        rhs=rhs,
-        lhs_perm=lhs_perm,
-        rhs_perm=rhs_perm,
-        lhs_mat=(m, k),
-        rhs_mat=(k, n),
-        out_shape=tuple(out_dims),
-        lhs_pre=lhs_pre,
-        lhs_mperm=lhs_mperm,
-        rhs_pre=rhs_pre,
-        rhs_mperm=rhs_mperm,
-    )
-    return step, result
 
 
 def build_program(tn: CompositeTensor, contract_path: ContractionPath) -> ContractionProgram:
@@ -201,9 +332,8 @@ def build_program(tn: CompositeTensor, contract_path: ContractionPath) -> Contra
         tensors: list[Tensor], cpath: ContractionPath
     ) -> tuple[int, LeafTensor]:
         """Returns the global slot holding this subnetwork's result and the
-        result's metadata in the slot buffer's *actual* axis order (the fold
-        of ``^`` along this path — NOT ``external_tensor()``, whose leg
-        order follows child order instead of contraction order)."""
+        result's metadata (leg-set level; buffer order is resolved in the
+        second pass)."""
         slot_of: list[int] = []
         current: list[LeafTensor | None] = []
         for child in tensors:
@@ -260,29 +390,23 @@ def build_program(tn: CompositeTensor, contract_path: ContractionPath) -> Contra
 
     result_slot, final = compile_composite(list(tn.tensors), contract_path)
 
-    # Consumer-alignment pass: each step's output is consumed by exactly
-    # one later step (the path is a tree); knowing which of its legs that
-    # consumer contracts lets _pair_step group them contiguously, keeping
-    # every transpose low-rank after run fusion (see PairStep docstring).
-    n_steps = len(step_plan)
-    next_shared: list[set[int] | None] = [None] * n_steps
-    producer: dict[int, int] = {}  # slot -> step index of current content
-    for t, (t_lhs, t_rhs, t_la, t_lb) in enumerate(step_plan):
-        s = producer.get(t_lhs)
-        if s is not None:
-            next_shared[s] = set((step_plan[s][2] ^ step_plan[s][3]) & t_lb)
-        s = producer.get(t_rhs)
-        if s is not None:
-            next_shared[s] = set((step_plan[s][2] ^ step_plan[s][3]) & t_la)
-        producer[t_lhs] = t
+    # Death-schedule pass: a leg of a tree-shaped path is contracted at
+    # exactly one step. _pair_step uses the death times to stop storage
+    # merges at each buffer's immediate consumer's contract/keep boundary
+    # (see _pair_step docstring).
+    death: dict[int, int] = {}
+    for t, (_, _, t_la, t_lb) in enumerate(step_plan):
+        for leg in t_la & t_lb:
+            death[leg] = t
 
     steps: list[PairStep] = []
     meta: dict[int, LeafTensor] = {
         slot: leaf for slot, leaf in enumerate(flat_slots)
     }
-    for s, (lhs_slot, rhs_slot, _, _) in enumerate(step_plan):
+    canonical = final  # pass-1 ^-fold order (reference semantics)
+    for lhs_slot, rhs_slot, _, _ in step_plan:
         step, result = _pair_step(
-            lhs_slot, rhs_slot, meta[lhs_slot], meta[rhs_slot], next_shared[s]
+            lhs_slot, rhs_slot, meta[lhs_slot], meta[rhs_slot], death
         )
         steps.append(step)
         meta[lhs_slot] = result
@@ -294,6 +418,7 @@ def build_program(tn: CompositeTensor, contract_path: ContractionPath) -> Contra
         result_slot=result_slot,
         result_legs=tuple(final.legs),
         result_shape=tuple(final.bond_dims),
+        canonical_legs=tuple(canonical.legs),
     )
 
 

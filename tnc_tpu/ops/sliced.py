@@ -124,7 +124,7 @@ def execute_sliced_numpy(
     baselines that extrapolate from a slice subset.
     """
     full = [np.asarray(a, dtype=dtype) for a in arrays]
-    acc = np.zeros(sp.program.result_shape, dtype=dtype)
+    acc = np.zeros(sp.program.stored_result_shape, dtype=dtype)
     num = sp.slicing.num_slices
     if max_slices is not None:
         num = min(num, max_slices)
@@ -135,7 +135,7 @@ def execute_sliced_numpy(
             for arr, info in zip(full, sp.slot_slices)
         ]
         acc = acc + _run_steps(np, sp.program, buffers)
-    return acc
+    return acc.reshape(sp.program.result_shape)
 
 
 def make_jax_sliced_fn(
@@ -179,8 +179,8 @@ def make_jax_sliced_fn(
 
             dtype = full_buffers[0][0].dtype
             acc0 = (
-                jnp.zeros(sp.program.result_shape, dtype=dtype),
-                jnp.zeros(sp.program.result_shape, dtype=dtype),
+                jnp.zeros(sp.program.stored_result_shape, dtype=dtype),
+                jnp.zeros(sp.program.stored_result_shape, dtype=dtype),
             )
             return lax.fori_loop(0, num, body, acc0)
 
@@ -195,7 +195,9 @@ def make_jax_sliced_fn(
                 ]
                 return acc + _run_steps(jnp, sp.program, list(buffers))
 
-            acc0 = jnp.zeros(sp.program.result_shape, dtype=full_buffers[0].dtype)
+            acc0 = jnp.zeros(
+                sp.program.stored_result_shape, dtype=full_buffers[0].dtype
+            )
             return lax.fori_loop(0, num, body, acc0)
 
     return jax.jit(fn)

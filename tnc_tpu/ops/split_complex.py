@@ -38,33 +38,57 @@ def combine_array(re: Any, im: Any) -> np.ndarray:
     return np.asarray(re) + 1j * np.asarray(im)
 
 
-def gauss_matmul(xp, ar, ai, br, bi, precision=None):
-    """Complex matmul on split parts with 3 real matmuls."""
-    if precision is None:
-        k1 = xp.matmul(ar + ai, br)
-        k2 = xp.matmul(ar, bi - br)
-        k3 = xp.matmul(ai, br + bi)
-    else:
-        k1 = xp.matmul(ar + ai, br, precision=precision)
-        k2 = xp.matmul(ar, bi - br, precision=precision)
-        k3 = xp.matmul(ai, br + bi, precision=precision)
+def _resolve_precision(precision):
+    """Map the backend's precision knob to a lax.Precision (device only)."""
+    if precision in (None, "default"):
+        return None
+    from jax import lax
+
+    return lax.Precision.HIGHEST
+
+
+def gauss_matmul(xp, ar, ai, br, bi):
+    """Complex matmul on split 2-D parts with 3 real matmuls (host path;
+    device precision is handled by `_resolve_precision` + dot_general)."""
+    k1 = xp.matmul(ar + ai, br)
+    k2 = xp.matmul(ar, bi - br)
+    k3 = xp.matmul(ai, br + bi)
     return k1 - k3, k1 + k2
-
-
-def _prep(xp, part, pre: tuple[int, ...], mperm: tuple[int, ...], mat: tuple[int, int]):
-    # fused low-rank transpose (see PairStep docstring)
-    return xp.transpose(part.reshape(pre), mperm).reshape(mat)
 
 
 def apply_step_split(xp, apair, bpair, step, precision=None):
     """Split-complex analogue of ``backends.apply_step``: one pairwise
-    contraction of (real, imag) pairs via three real matmuls. The single
-    source of truth shared by every split-mode executor."""
-    ar = _prep(xp, apair[0], step.lhs_pre, step.lhs_mperm, step.lhs_mat)
-    ai = _prep(xp, apair[1], step.lhs_pre, step.lhs_mperm, step.lhs_mat)
-    br = _prep(xp, bpair[0], step.rhs_pre, step.rhs_mperm, step.rhs_mat)
-    bi = _prep(xp, bpair[1], step.rhs_pre, step.rhs_mperm, step.rhs_mat)
-    return gauss_matmul(xp, ar, ai, br, bi, precision)
+    contraction of (real, imag) pairs via three real dots (Gauss). The
+    single source of truth shared by every split-mode executor."""
+    from tnc_tpu.ops.backends import _prep_operand
+
+    ar = _prep_operand(xp, apair[0], step.a_view, step.a_perm, step.a_dot)
+    ai = _prep_operand(xp, apair[1], step.a_view, step.a_perm, step.a_dot)
+    br = _prep_operand(xp, bpair[0], step.b_view, step.b_perm, step.b_dot)
+    bi = _prep_operand(xp, bpair[1], step.b_view, step.b_perm, step.b_dot)
+    if xp is np:
+        ar, ai = ar.reshape(step.a_mat), ai.reshape(step.a_mat)
+        br, bi = br.reshape(step.b_mat), bi.reshape(step.b_mat)
+        if step.swap:
+            re, im = gauss_matmul(np, br.T, bi.T, ar, ai)
+        else:
+            re, im = gauss_matmul(np, ar.T, ai.T, br, bi)
+        return re.reshape(step.out_store), im.reshape(step.out_store)
+
+    from jax import lax
+
+    prec = _resolve_precision(precision)
+    dims = (((0,), (0,)), ((), ()))
+
+    def dot(x, y):
+        if step.swap:
+            return lax.dot_general(y, x, dims, precision=prec)
+        return lax.dot_general(x, y, dims, precision=prec)
+
+    k1 = dot(ar + ai, br)
+    k2 = dot(ar, bi - br)
+    k3 = dot(ai, br + bi)
+    return (k1 - k3).reshape(step.out_store), (k1 + k2).reshape(step.out_store)
 
 
 def run_steps_split(
@@ -74,12 +98,11 @@ def run_steps_split(
     precision=None,
 ):
     """Split-complex analogue of ``backends._run_steps``; ``buffers`` are
-    (real, imag) pairs and the result is a pair. Intermediates stay
-    matrix-shaped between steps."""
+    (real, imag) pairs and the result is a pair in **stored** shape
+    (callers reshape to ``result_shape`` on the host)."""
     for step in program.steps:
         buffers[step.lhs] = apply_step_split(
             xp, buffers[step.lhs], buffers[step.rhs], step, precision
         )
         buffers[step.rhs] = None
-    re, im = buffers[program.result_slot]
-    return re.reshape(program.result_shape), im.reshape(program.result_shape)
+    return buffers[program.result_slot]

@@ -273,6 +273,8 @@ def distributed_partitioned_contraction(
         data = combine_array(*final)
     else:
         data = np.asarray(final)
+    # device buffers live in stored (merged) shape; restore leg granularity
+    data = data.reshape(tuple(meta.bond_dims))
     return LeafTensor(list(meta.legs), list(meta.bond_dims), TensorData.matrix(data))
 
 

@@ -110,8 +110,8 @@ def _make_spmd_fn(
                 return acc[0] + re, acc[1] + im
 
             acc0 = (
-                jnp.zeros(sp.program.result_shape, dtype=part_dtype),
-                jnp.zeros(sp.program.result_shape, dtype=part_dtype),
+                jnp.zeros(sp.program.stored_result_shape, dtype=part_dtype),
+                jnp.zeros(sp.program.stored_result_shape, dtype=part_dtype),
             )
             partial = lax.fori_loop(0, chunk, body, acc0)
             return lax.psum(partial, axis)
@@ -130,7 +130,7 @@ def _make_spmd_fn(
                 ]
                 return acc + _run_steps(jnp, sp.program, list(buffers))
 
-            acc0 = jnp.zeros(sp.program.result_shape, dtype=dtype)
+            acc0 = jnp.zeros(sp.program.stored_result_shape, dtype=dtype)
             partial = lax.fori_loop(0, chunk, body, acc0)
             return lax.psum(partial, axis)
 
@@ -188,12 +188,12 @@ def distributed_sliced_contraction(
             re, im = split_array(leaf.data.into_data(), part_dtype)
             arrays.append((jnp.asarray(re), jnp.asarray(im)))
         re, im = fn(*arrays)
-        result = combine_array(re, im)
+        result = combine_array(re, im).reshape(sp.program.result_shape)
     else:
         arrays = [
             jnp.asarray(leaf.data.into_data(), dtype=dtype) for leaf in leaves
         ]
-        result = np.asarray(fn(*arrays))
+        result = np.asarray(fn(*arrays)).reshape(sp.program.result_shape)
     return LeafTensor(
         list(sp.program.result_legs),
         list(sp.program.result_shape),

@@ -32,9 +32,11 @@ def contract_tensor_network(
 ) -> LeafTensor:
     """Fully contract ``tn`` along ``contract_path`` (replace-left format).
 
-    Returns a :class:`LeafTensor` whose legs follow the fold of the
-    ``^`` (symmetric-difference) operator over the path, as in the
-    reference, and whose data is a materialized matrix.
+    Returns a :class:`LeafTensor` holding the fully-contracted data. Its
+    legs carry the same ids as the reference's ``^``-fold
+    (``contraction.rs:70-86``) but may be ordered differently — the
+    program compiler picks the buffer order that tiles best on TPU, and
+    ``result_legs`` records it; consumers address legs by id.
     """
     backend_obj = get_backend(backend)
     program = build_program(tn, contract_path)
@@ -52,9 +54,21 @@ def contract_tensor_network(
     logger.debug(
         "contract done: result shape %s", tuple(program.result_shape)
     )
+    return _canonical_result(program, result)
+
+
+def _canonical_result(program, result) -> LeafTensor:
+    """Permute a result buffer to the reference's ``^``-fold leg order
+    (host-side; the device buffer keeps its TPU-friendly order)."""
+    import numpy as np
+
+    perm = program.canonical_perm()
+    if perm is not None:
+        result = np.transpose(np.asarray(result), perm)
+    dim_of = dict(zip(program.result_legs, program.result_shape))
     return LeafTensor(
-        list(program.result_legs),
-        list(program.result_shape),
+        list(program.canonical_legs),
+        [dim_of[leg] for leg in program.canonical_legs],
         TensorData.matrix(result),
     )
 
@@ -77,8 +91,4 @@ def contract_tensor_network_sliced(
     leaves = flat_leaf_tensors(tn)
     arrays = [leaf.data.into_data() for leaf in leaves]
     result = backend_obj.execute_sliced(sp, arrays)
-    return LeafTensor(
-        list(sp.program.result_legs),
-        list(sp.program.result_shape),
-        TensorData.matrix(result),
-    )
+    return _canonical_result(sp.program, result)

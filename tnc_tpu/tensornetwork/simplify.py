@@ -28,15 +28,22 @@ from tnc_tpu.tensornetwork.tensordata import TensorData
 
 
 def _contract_pair_np(a: LeafTensor, b: LeafTensor) -> LeafTensor:
-    """Pairwise contraction on host, legs ordered as ``a ^ b``."""
-    from tnc_tpu.ops.program import _pair_step
-
-    step, result = _pair_step(0, 1, a, b)
+    """Pairwise contraction on host, legs ordered as ``a ^ b``
+    (``tensordot`` free-leg order matches the reference's ``^``)."""
+    b_set = set(b.legs)
+    a_set = set(a.legs)
+    shared = [leg for leg in a.legs if leg in b_set]
+    a_pos = [a.legs.index(leg) for leg in shared]
+    b_pos = [b.legs.index(leg) for leg in shared]
     da = np.asarray(a.data.into_data(), dtype=np.complex128)
     db = np.asarray(b.data.into_data(), dtype=np.complex128)
-    da = np.transpose(da, step.lhs_perm).reshape(step.lhs_mat)
-    db = np.transpose(db, step.rhs_perm).reshape(step.rhs_mat)
-    out = (da @ db).reshape(step.out_shape)
+    out = np.tensordot(da, db, axes=(a_pos, b_pos))
+    out_legs = [leg for leg in a.legs if leg not in b_set] + [
+        leg for leg in b.legs if leg not in a_set
+    ]
+    dim_of = dict(a.edges())
+    dim_of.update(b.edges())
+    result = LeafTensor(out_legs, [dim_of[leg] for leg in out_legs])
     result.data = TensorData.matrix(out)
     return result
 
