@@ -20,14 +20,19 @@ Robustness contract (the driver parses stdout): exactly one JSON line is
 printed no matter what. Backend init is probed in a subprocess with a
 timeout first; if the accelerator is unreachable the run falls back to a
 pinned CPU platform (honest numeric result, ``device: cpu-fallback``); if
-a config run dies on the accelerator it is retried once on CPU; only if
-that also fails does the line carry an ``error`` field.
+a config run dies on the accelerator, an on-accelerator retry ladder runs
+in fresh subprocesses (batch=1 → deeper slicing → other executor) before
+the CPU fallback; only if everything fails does the line carry an
+``error`` field.
 
 Env knobs:
   BENCH_CONFIG  sycamore_amplitude (default) | ghz3 | random20 | qaoa30
   BENCH_QUBITS / BENCH_DEPTH / BENCH_SEED
   BENCH_TARGET_LOG2_PEAK (28), BENCH_NTRIALS (64),
-  BENCH_CPU_SLICES (2), BENCH_REPS (3), BENCH_PEAK_FLOPS (per device)
+  BENCH_CPU_SLICES (2), BENCH_REPS (3), BENCH_PEAK_FLOPS (per device),
+  BENCH_EXEC loop|chunked, BENCH_BATCH (8), BENCH_PROBE_SLICES (64),
+  BENCH_FULL_SECONDS (900; run all slices if projected under this),
+  BENCH_TRACE 0|1 (profiler trace; default on-accelerator only)
 """
 
 import json
@@ -192,44 +197,44 @@ def bench_sycamore_amplitude():
     sp = build_sliced_program(tn, replace, slicing)
     arrays = [leaf.data.into_data() for leaf in flat_leaf_tensors(tn)]
 
-    strategy = os.environ.get("BENCH_EXEC", "chunked")
+    strategy = os.environ.get("BENCH_EXEC", "loop")
     backend = JaxBackend(
         dtype="complex64",
         sliced_strategy=strategy,
         slice_batch=_env_int("BENCH_BATCH", 8),
         chunk_steps=_env_int("BENCH_CHUNK_STEPS", 48),
     )
+    log(f"[bench] executor: {strategy}")
     extra = {}
-    max_slices = _env_int("BENCH_MAX_SLICES", 0)
-    if max_slices and max_slices < slicing.num_slices:
-        # Slice-subset mode (CPU fallback): time K slices through the
-        # plain per-slice executor and extrapolate — slices are identical
-        # work by construction. Marked in the output; the full-loop
-        # executors amortize better, so this overestimates wall-clock.
-        log(f"[bench] subset mode: timing {max_slices}/{slicing.num_slices} slices")
+    num = slicing.num_slices
 
-        def run_subset():
-            acc = np.zeros(sp.program.result_shape, dtype=np.complex128)
-            for s in range(max_slices):
-                idx = [int(x) for x in _slice_indices_host(sp.slicing, s)]
-                sliced_arrays = [
-                    _index_host(arr, info, idx)
-                    for arr, info in zip(arrays, sp.slot_slices)
-                ]
-                acc = acc + np.asarray(backend.execute(sp.program, sliced_arrays))
-            return acc
+    # -- probe: time a slice subset through the real executor --------------
+    probe = _env_int("BENCH_MAX_SLICES", 0) or _env_int("BENCH_PROBE_SLICES", 64)
+    probe = max(1, min(probe, num))
+    log(f"[bench] probe: timing {probe}/{num} slices")
+    probe_s, amp = _time_backend(
+        lambda: backend.execute_sliced(sp, arrays, max_slices=probe), reps
+    )
+    per_slice = probe_s / probe
+    projected = per_slice * num
+    log(f"[bench] {per_slice*1000:.2f} ms/slice -> projected full {projected:.1f}s")
 
-        sub_s, amp = _time_backend(run_subset, reps)
-        tpu_s = sub_s * (slicing.num_slices / max_slices)
-        extra["extrapolated_from_slices"] = max_slices
-        log(f"[bench] extrapolated full wall-clock: {tpu_s:.1f}s")
-    else:
-        log(f"[bench] executor: {strategy}")
+    _maybe_trace(backend, sp, arrays, probe, extra)
+
+    forced_subset = bool(_env_int("BENCH_MAX_SLICES", 0))
+    full_limit = float(os.environ.get("BENCH_FULL_SECONDS", "900"))
+    if not forced_subset and probe < num and projected <= full_limit:
+        # cheap enough: run and time ALL slices (the honest number)
         tpu_s, amp = _time_backend(
             lambda: backend.execute_sliced(sp, arrays), reps
         )
+    else:
+        tpu_s = projected
+        if probe < num:
+            extra["extrapolated_from_slices"] = probe
+            log(f"[bench] extrapolated full wall-clock: {tpu_s:.1f}s")
     amplitude = complex(np.asarray(amp).reshape(-1)[0])
-    log(f"[bench] amplitude: {amplitude}")
+    log(f"[bench] amplitude (partial sum ok): {amplitude}")
 
     # -- achieved throughput / MFU -----------------------------------------
     import jax
@@ -249,16 +254,9 @@ def bench_sycamore_amplitude():
     want_partial = execute_sliced_numpy(
         sp, arrays, dtype=np.complex128, max_slices=n_sub
     )
-    got_partial = np.zeros(sp.program.result_shape, dtype=np.complex128)
-    for s in range(n_sub):
-        idx = [int(x) for x in _slice_indices_host(sp.slicing, s)]
-        sliced_arrays = [
-            _index_host(arr, info, idx)
-            for arr, info in zip(arrays, sp.slot_slices)
-        ]
-        got_partial = got_partial + np.asarray(
-            backend.execute(sp.program, sliced_arrays)
-        )
+    got_partial = np.asarray(
+        backend.execute_sliced(sp, arrays, max_slices=n_sub)
+    ).astype(np.complex128)
     denom = max(float(np.max(np.abs(want_partial))), 1e-30)
     parity = float(np.max(np.abs(got_partial - want_partial))) / denom
     log(f"[bench] parity vs numpy oracle ({n_sub} slices): {parity:.2e}")
@@ -280,16 +278,26 @@ def bench_sycamore_amplitude():
     )
 
 
-def _slice_indices_host(slicing, s):
-    from tnc_tpu.ops.sliced import _slice_indices
+def _maybe_trace(backend, sp, arrays, probe, extra):
+    """Capture a jax.profiler device trace of a subset run (SURVEY §5:
+    trace-based profiling alongside the analytic cost model). Enabled on
+    accelerators by default; BENCH_TRACE=0 disables, =1 forces on CPU."""
+    import jax
 
-    return _slice_indices(slicing, s)
-
-
-def _index_host(arr, info, indices):
-    from tnc_tpu.ops.sliced import index_buffer
-
-    return index_buffer(np, np.asarray(arr), info, indices)
+    flag = os.environ.get("BENCH_TRACE")
+    on_accel = jax.devices()[0].platform != "cpu"
+    if flag == "0" or (flag != "1" and not on_accel):
+        return
+    trace_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_trace"
+    )
+    try:
+        with jax.profiler.trace(trace_dir):
+            backend.execute_sliced(sp, arrays, max_slices=min(probe, 8))
+        extra["trace_dir"] = trace_dir
+        log(f"[bench] profiler trace captured in {trace_dir}")
+    except Exception as e:  # tunnel backends may not support profiling
+        log(f"[bench] profiler trace unavailable: {type(e).__name__}: {e}")
 
 
 def bench_ghz3():
@@ -495,40 +503,75 @@ def main() -> None:
             )
             raise SystemExit(1)
 
-    # Accelerator run died mid-config: retry once on CPU in a subprocess
-    # (this process may hold a broken backend).
-    log("[bench] retrying on CPU in a subprocess")
-    env = {
-        k: v
-        for k, v in os.environ.items()
-        if not k.startswith(("JAX_", "XLA_", "TPU_", "LIBTPU"))
-    }
-    env["BENCH_FORCE_CPU"] = "1"
-    try:
-        r = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)],
-            env=env,
-            capture_output=True,
-            text=True,
-            timeout=3600,
-        )
-        sys.stderr.write(r.stderr)
-        line = [l for l in r.stdout.splitlines() if l.strip().startswith("{")]
-        if r.returncode == 0 and line:
-            record = json.loads(line[-1])
-            record["device"] = "cpu-fallback"
-            record["note"] = "accelerator run failed; measured on CPU"
-            _emit(record)
-            return
-    except subprocess.TimeoutExpired:
-        pass
+    if os.environ.get("BENCH_NO_RETRY") == "1":
+        raise SystemExit(1)
+
+    # Accelerator run died mid-config. Before abandoning the hardware,
+    # climb the on-accelerator retry ladder in fresh subprocesses (this
+    # process may hold a poisoned backend): smaller slice batch → deeper
+    # slicing → the other executor. Only then fall back to CPU.
+    target = float(os.environ.get("BENCH_TARGET_LOG2_PEAK", "28"))
+    ladder: list[tuple[str, dict]] = []
+    if config == "sycamore_amplitude":
+        ladder = [
+            ("batch=1", {"BENCH_BATCH": "1"}),
+            (
+                f"target_log2={target - 2:g}",
+                {"BENCH_TARGET_LOG2_PEAK": f"{target - 2:g}", "BENCH_BATCH": "4"},
+            ),
+            (
+                "exec=chunked"
+                if os.environ.get("BENCH_EXEC", "loop") == "loop"
+                else "exec=loop",
+                {
+                    "BENCH_EXEC": "chunked"
+                    if os.environ.get("BENCH_EXEC", "loop") == "loop"
+                    else "loop"
+                },
+            ),
+        ]
+    ladder.append(("cpu", {"BENCH_FORCE_CPU": "1"}))
+
+    for stage, overrides in ladder:
+        cpu_stage = "BENCH_FORCE_CPU" in overrides
+        log(f"[bench] retrying in a subprocess: {stage}")
+        env = dict(os.environ)
+        if cpu_stage:
+            env = {
+                k: v
+                for k, v in env.items()
+                if not k.startswith(("JAX_", "XLA_", "TPU_", "LIBTPU"))
+            }
+        env.update(overrides)
+        env["BENCH_NO_RETRY"] = "1"
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env,
+                capture_output=True,
+                text=True,
+                timeout=3600,
+            )
+            sys.stderr.write(r.stderr)
+            line = [l for l in r.stdout.splitlines() if l.strip().startswith("{")]
+            if r.returncode == 0 and line:
+                record = json.loads(line[-1])
+                if cpu_stage:
+                    record["device"] = "cpu-fallback"
+                    record["note"] = "accelerator run failed; measured on CPU"
+                else:
+                    record["retry_stage"] = stage
+                _emit(record)
+                return
+        except subprocess.TimeoutExpired:
+            log(f"[bench] retry stage {stage}: timed out")
     _emit(
         {
             "metric": config,
             "value": 0.0,
             "unit": "s",
             "vs_baseline": 0.0,
-            "error": "accelerator run failed and CPU retry failed",
+            "error": "accelerator run failed and every retry failed",
         }
     )
     raise SystemExit(1)

@@ -36,7 +36,9 @@ class Backend:
     def execute(self, program: ContractionProgram, arrays: Sequence[Any]) -> np.ndarray:
         raise NotImplementedError
 
-    def execute_sliced(self, sp, arrays: Sequence[Any]) -> np.ndarray:
+    def execute_sliced(
+        self, sp, arrays: Sequence[Any], max_slices: int | None = None
+    ) -> np.ndarray:
         raise NotImplementedError
 
 
@@ -188,10 +190,14 @@ class NumpyBackend(Backend):
         out = _run_steps(np, program, buffers)
         return np.asarray(out).reshape(program.result_shape)
 
-    def execute_sliced(self, sp, arrays: Sequence[Any]) -> np.ndarray:
+    def execute_sliced(
+        self, sp, arrays: Sequence[Any], max_slices: int | None = None
+    ) -> np.ndarray:
         from tnc_tpu.ops.sliced import execute_sliced_numpy
 
-        return execute_sliced_numpy(sp, arrays, dtype=self.dtype)
+        return execute_sliced_numpy(
+            sp, arrays, dtype=self.dtype, max_slices=max_slices
+        )
 
 
 class JaxBackend(Backend):
@@ -259,8 +265,11 @@ class JaxBackend(Backend):
     def _run(self, program: ContractionProgram, buffers: list[Any]):
         return self._compiled(program)(buffers)
 
-    def execute_sliced(self, sp, arrays: Sequence[Any]) -> np.ndarray:
-        """Run a sliced program; the slice loop executes on device."""
+    def execute_sliced(
+        self, sp, arrays: Sequence[Any], max_slices: int | None = None
+    ) -> np.ndarray:
+        """Run a sliced program; the slice loop executes on device.
+        ``max_slices`` caps the loop (partial sum — benchmark subsets)."""
 
         from tnc_tpu.ops.sliced import make_jax_sliced_fn
 
@@ -279,13 +288,23 @@ class JaxBackend(Backend):
                 precision=self.precision,
                 dtype=self.dtype,
                 device=self.device,
+                max_slices=max_slices,
             )
 
-        key = ("sliced", sp.signature(), str(self.dtype), self.split_complex)
+        key = (
+            "sliced",
+            sp.signature(),
+            str(self.dtype),
+            self.split_complex,
+            max_slices,
+        )
         fn = self._cache.get(key)
         if fn is None:
             fn = make_jax_sliced_fn(
-                sp, split_complex=self.split_complex, precision=self.precision
+                sp,
+                split_complex=self.split_complex,
+                precision=self.precision,
+                num_slices=max_slices,
             )
             self._cache[key] = fn
         buffers = self._device_buffers(arrays)

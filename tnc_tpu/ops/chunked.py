@@ -244,12 +244,17 @@ def execute_sliced_batched_jax(
     precision: str | None = "float32",
     dtype: str = "complex64",
     device=None,
+    enforce_budget: bool = True,
+    max_slices: int | None = None,
 ):
     """Run a sliced program as chunked, slice-batched jitted calls.
 
     Returns the accumulated result: a complex ndarray (or a
     (real, imag) pair is combined before returning). ``batch`` is
-    clamped to the largest divisor of the slice count <= the request.
+    clamped to the HBM budget (see :mod:`tnc_tpu.ops.budget`; disable
+    with ``enforce_budget=False``) and then to the largest divisor of
+    the slice count <= the request. ``max_slices`` caps the loop (a
+    partial sum over the first slices — benchmark subset mode).
     """
     import jax.numpy as jnp
 
@@ -259,6 +264,18 @@ def execute_sliced_batched_jax(
             "execute_sliced_batched_jax expects a sliced program; "
             "use JaxBackend.execute for unsliced networks"
         )
+    if enforce_budget:
+        from tnc_tpu.ops.budget import clamp_slice_batch
+
+        batch = clamp_slice_batch(
+            sp.program,
+            batch,
+            device=device,
+            split_complex=split_complex,
+            dtype_bytes=8 if "128" in str(dtype) else 4,
+        )
+    if max_slices is not None:
+        num = max(1, min(num, max_slices))
     batch = max(1, min(batch, num))
     while num % batch:  # largest divisor <= requested (dims are tiny)
         batch -= 1

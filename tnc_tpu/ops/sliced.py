@@ -142,16 +142,20 @@ def make_jax_sliced_fn(
     sp: SlicedProgram,
     split_complex: bool = False,
     precision: str | None = None,
+    num_slices: int | None = None,
 ):
     """Build a jittable ``fn(full_buffers) -> result`` running the whole
     slice loop on device. In split mode, buffers and result are
-    (real, imag) pairs of float arrays."""
+    (real, imag) pairs of float arrays. ``num_slices`` caps the loop
+    (partial sum over the first slices — benchmark subset mode)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
 
     dims = sp.slicing.dims
     num = sp.slicing.num_slices
+    if num_slices is not None:
+        num = max(1, min(num, num_slices))
 
     def decompose(s):
         idx = []
