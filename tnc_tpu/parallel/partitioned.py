@@ -95,11 +95,16 @@ class DeviceTensorMapping:
 @dataclass
 class Communication:
     """Executor state for one distributed contraction (cf. ``Communication``
-    in ``communication.rs:118-122``)."""
+    in ``communication.rs:118-122``).
+
+    ``programs[i]`` is either a :class:`ContractionProgram` (partition
+    fits HBM) or a :class:`~tnc_tpu.ops.sliced.SlicedProgram` (partition
+    sliced to fit — the slicing × partitioning composition the reference
+    lists as future work, ``book/src/future_work.md`` item 2)."""
 
     mapping: DeviceTensorMapping
     devices: list
-    programs: list[ContractionProgram]
+    programs: list[Any]
     results_meta: list[LeafTensor]
 
 
@@ -121,15 +126,68 @@ def _leaf_arrays(child: CompositeTensor) -> list[np.ndarray]:
     return [np.asarray(leaf.data.into_data()) for leaf in flat_leaf_tensors(child)]
 
 
+def _slice_partition(child: CompositeTensor, nested: ContractionPath, hbm_bytes: int):
+    """Slice one partition's local path until its program fits the HBM
+    budget. Returns a SlicedProgram (or None if the unsliced program
+    already fits)."""
+    from tnc_tpu.contractionpath.slicing import find_slicing
+    from tnc_tpu.ops.budget import fits_hbm, program_peak_bytes
+    from tnc_tpu.ops.sliced import build_sliced_program
+
+    program = build_program(child, nested)
+    if fits_hbm(program, hbm_bytes=hbm_bytes):
+        return None
+    if nested.nested:
+        raise ValueError(
+            "HBM budget exceeded on a partition with a nested local path; "
+            "slicing supports flat partition paths"
+        )
+    inputs = [t for t in child.tensors if isinstance(t, LeafTensor)]
+    est = program_peak_bytes(program)
+    # element targets, descending from the current peak: first slicing
+    # that fits the budget wins; keep the deepest achievable as best
+    # effort if even it does not fit (find_slicing raises when a target
+    # needs more slices than its cap).
+    target = 2.0 ** np.floor(np.log2(max(est.peak_bytes / 8.0, 2.0)))
+    best = None
+    while target >= 4:
+        try:
+            slicing = find_slicing(inputs, nested.toplevel, target)
+        except ValueError:
+            break
+        sp = build_sliced_program(child, nested, slicing)
+        best = sp
+        if fits_hbm(sp.program, hbm_bytes=hbm_bytes):
+            break
+        target /= 4.0
+    if best is None:
+        raise ValueError(
+            "partition cannot be sliced to the HBM budget "
+            f"({hbm_bytes} bytes)"
+        )
+    logger.debug(
+        "partition sliced: %d legs, %d slices",
+        len(best.slicing.legs),
+        best.slicing.num_slices,
+    )
+    return best
+
+
 def scatter_partitions(
     tn: CompositeTensor,
     contract_path: ContractionPath,
     devices: list,
     dtype: str,
     split_complex: bool,
+    hbm_bytes: int | None = None,
 ) -> tuple[Communication, list[list[Any]]]:
     """Compile per-partition programs and place each partition's leaves on
     its device (``scatter_tensor_network``, ``communication.rs:125-195``).
+
+    With ``hbm_bytes`` set, any partition whose program exceeds the
+    per-device budget is sliced locally (sum over slice programs on its
+    own device) before the fan-in — composing partition parallelism with
+    slicing.
     """
     children = list(tn.tensors)
     k = len(children)
@@ -143,12 +201,19 @@ def scatter_partitions(
 
     mapping = DeviceTensorMapping.for_path(k, contract_path.toplevel)
 
-    programs: list[ContractionProgram] = []
+    programs: list[Any] = []
     metas: list[LeafTensor] = []
     buffers: list[list[Any]] = []
     for i, child in enumerate(children):
-        program = build_program(child, contract_path.nested[i])
-        programs.append(program)
+        sp = None
+        if hbm_bytes is not None:
+            sp = _slice_partition(child, contract_path.nested[i], hbm_bytes)
+        if sp is not None:
+            programs.append(sp)
+            program = sp.program
+        else:
+            program = build_program(child, contract_path.nested[i])
+            programs.append(program)
         metas.append(
             LeafTensor(list(program.result_legs), list(program.result_shape))
         )
@@ -160,11 +225,12 @@ def scatter_partitions(
         )
         # mirror of "Scattering tensor network" (communication.rs:132)
         logger.debug(
-            "scatter: partition %d -> device %d (%d tensors, %d steps)",
+            "scatter: partition %d -> device %d (%d tensors, %d steps%s)",
             i,
             mapping.device(i),
             len(child),
             len(program.steps),
+            ", sliced" if sp is not None else "",
         )
 
     comm = Communication(mapping, list(devices), programs, metas)
@@ -176,9 +242,12 @@ def local_contract_partitions(
     buffers: list[list[Any]],
     split_complex: bool,
     precision,
+    max_slices: int | None = None,
 ) -> list[Any]:
     """Dispatch every partition's compiled program to its device. Async
     dispatch → all devices run concurrently (the per-rank local phase).
+    ``max_slices`` caps sliced partitions' loops (benchmark subset mode —
+    the partial sums are NOT the correct partition tensors).
 
     First-run XLA compiles are driven from a thread pool: k distinct
     partition programs would otherwise compile back-to-back on the main
@@ -186,8 +255,20 @@ def local_contract_partitions(
     phase that should overlap. Warm runs take the sequential fast path.
     """
     logger.debug("local phase: %d partition programs", len(comm.programs))
+    from tnc_tpu.ops.sliced import SlicedProgram, make_jax_sliced_fn
+
+    def compile_one(program):
+        if isinstance(program, SlicedProgram):
+            return make_jax_sliced_fn(
+                program,
+                split_complex=split_complex,
+                precision=precision,
+                num_slices=max_slices,
+            )
+        return jit_program(program, split_complex, precision)
+
     jobs = [
-        (jit_program(program, split_complex, precision), list(bufs))
+        (compile_one(program), list(bufs))
         for program, bufs in zip(comm.programs, buffers)
     ]
     if len(jobs) > 1:
@@ -240,6 +321,7 @@ def distributed_partitioned_contraction(
     dtype: str = "complex64",
     split_complex: bool | None = None,
     precision: str | None = "float32",
+    hbm_bytes: int | None = None,
 ) -> LeafTensor:
     """Contract a partitioned network with one partition per device.
 
@@ -247,6 +329,8 @@ def distributed_partitioned_contraction(
     children = partitions) and ``contract_path`` must carry a nested path
     per partition plus the toplevel communication schedule — the same
     contract as the reference's distributed pipeline (§3.2 of SURVEY.md).
+    ``hbm_bytes`` sets a per-device budget; partitions that exceed it are
+    locally sliced (partitioning × slicing composition).
     """
     import jax
 
@@ -261,7 +345,9 @@ def distributed_partitioned_contraction(
     if split_complex is None:
         split_complex = devices[0].platform != "cpu"
 
-    comm, buffers = scatter_partitions(tn, contract_path, devices, dtype, split_complex)
+    comm, buffers = scatter_partitions(
+        tn, contract_path, devices, dtype, split_complex, hbm_bytes=hbm_bytes
+    )
     results = local_contract_partitions(comm, buffers, split_complex, precision)
     final, meta = intermediate_reduce(
         comm, contract_path.toplevel, results, split_complex, precision
@@ -276,6 +362,239 @@ def distributed_partitioned_contraction(
     # device buffers live in stored (merged) shape; restore leg granularity
     data = data.reshape(tuple(meta.bond_dims))
     return LeafTensor(list(meta.legs), list(meta.bond_dims), TensorData.matrix(data))
+
+
+def flatten_partitioned_path(
+    tn: CompositeTensor, contract_path: ContractionPath
+) -> tuple[list[LeafTensor], list[tuple[int, int]]]:
+    """Inline a partitioned path into one flat replace-left path over the
+    global leaf list (children in index order, as `flat_leaf_tensors`
+    orders them) — the form the slicing planner consumes."""
+    flat_leaves: list[LeafTensor] = []
+    start: dict[int, int] = {}
+    children = list(tn.tensors)
+    for ci, child in enumerate(children):
+        if not isinstance(child, CompositeTensor):
+            raise TypeError(f"top-level child {ci} is not a partition composite")
+        start[ci] = len(flat_leaves)
+        flat_leaves.extend(child.tensors)  # type: ignore[arg-type]
+
+    pairs: list[tuple[int, int]] = []
+    rep: dict[int, int] = {}
+    for ci, child in enumerate(children):
+        local = contract_path.nested[ci].toplevel
+        base = start[ci]
+        for i, j in local:
+            pairs.append((base + i, base + j))
+        rep[ci] = base + _fanin_survivor(len(child.tensors), local)
+    for x, y in contract_path.toplevel:
+        pairs.append((rep[x], rep[y]))
+    return flat_leaves, pairs
+
+
+def distributed_partitioned_sliced_contraction(
+    tn: CompositeTensor,
+    contract_path: ContractionPath,
+    devices: list | None = None,
+    n_devices: int | None = None,
+    dtype: str = "complex64",
+    split_complex: bool | None = None,
+    precision: str | None = "float32",
+    hbm_bytes: int | None = None,
+    target_size: float | None = None,
+    max_slices: int | None = None,
+) -> tuple[LeafTensor, "Slicing"]:
+    """Partitioning × **global** slicing (BASELINE config #5; the
+    composition the reference lists as future work,
+    ``book/src/future_work.md`` item 2).
+
+    Legs are sliced across the *whole* network — including partition cut
+    edges, which shrinks the externals that dominate partition memory —
+    and for every slice index each device contracts its partition
+    concurrently, the fan-in schedule reduces the per-slice result over
+    the devices, and results accumulate on the root device.
+
+    ``target_size`` (elements) fixes the slicing directly; otherwise it
+    is derived from ``hbm_bytes`` (default: the device's budget).
+    ``max_slices`` caps the loop (benchmark subset mode — the sum is then
+    partial). Returns (result leaf, slicing).
+    """
+    run, slicing, final_meta = partitioned_sliced_executor(
+        tn,
+        contract_path,
+        devices=devices,
+        n_devices=n_devices,
+        dtype=dtype,
+        split_complex=split_complex,
+        precision=precision,
+        hbm_bytes=hbm_bytes,
+        target_size=target_size,
+    )
+    data = run(max_slices)
+    return (
+        LeafTensor(
+            list(final_meta.legs),
+            list(final_meta.bond_dims),
+            TensorData.matrix(data),
+        ),
+        slicing,
+    )
+
+
+def partitioned_sliced_executor(
+    tn: CompositeTensor,
+    contract_path: ContractionPath,
+    devices: list | None = None,
+    n_devices: int | None = None,
+    dtype: str = "complex64",
+    split_complex: bool | None = None,
+    precision: str | None = "float32",
+    hbm_bytes: int | None = None,
+    target_size: float | None = None,
+):
+    """Compile the partitioned × globally-sliced pipeline once and return
+    ``(run, slicing, final_meta)`` where ``run(max_slices=None)`` executes
+    the slice loop (partial sum when capped) and returns the accumulated
+    host array — compiled executables are reused across calls (the
+    benchmark warms up with one slice, then times a subset)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tnc_tpu.contractionpath.slicing import find_slicing
+    from tnc_tpu.ops.backends import _run_steps
+    from tnc_tpu.ops.budget import device_hbm_bytes
+    from tnc_tpu.ops.sliced import (
+        _slice_indices,
+        build_sliced_program,
+        index_buffer,
+    )
+    from tnc_tpu.ops.split_complex import run_steps_split
+
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            if len(devices) < n_devices:
+                raise ValueError(f"need {n_devices} devices, have {len(devices)}")
+            devices = devices[:n_devices]
+    if split_complex is None:
+        split_complex = devices[0].platform != "cpu"
+
+    flat_leaves, flat_pairs = flatten_partitioned_path(tn, contract_path)
+    if target_size is None:
+        if hbm_bytes is None:
+            hbm_bytes = device_hbm_bytes(devices[0])
+        # padded split-complex working set ~8 bytes/elem x ~8 live copies
+        target_size = max(float(hbm_bytes) / 64.0, 4.0)
+    while True:
+        try:
+            slicing = find_slicing(flat_leaves, flat_pairs, target_size)
+            break
+        except ValueError:
+            # target needs more slices than the planner's cap: back off —
+            # the per-slice footprint then overshoots the budget (best
+            # effort; the caller sees the slicing and can re-plan)
+            if target_size > 2.0**62:
+                raise
+            target_size *= 4.0
+            logger.warning(
+                "global slicing target relaxed to %g elements", target_size
+            )
+    logger.debug(
+        "global slicing: %d legs, %d slices (target %g elems)",
+        len(slicing.legs),
+        slicing.num_slices,
+        target_size,
+    )
+
+    children = list(tn.tensors)
+    k = len(children)
+    mapping = DeviceTensorMapping.for_path(k, contract_path.toplevel)
+    sps = [
+        build_sliced_program(child, contract_path.nested[i], slicing)
+        for i, child in enumerate(children)
+    ]
+    metas = [
+        LeafTensor(list(sp.program.result_legs), list(sp.program.result_shape))
+        for sp in sps
+    ]
+    buffers = [
+        place_buffers(
+            _leaf_arrays(child), dtype, split_complex, devices[mapping.device(i)]
+        )
+        for i, child in enumerate(children)
+    ]
+
+    def make_local_fn(sp):
+        def fn(bufs, indices):
+            if split_complex:
+                sliced = [
+                    (
+                        index_buffer(jnp, re, info, indices),
+                        index_buffer(jnp, im, info, indices),
+                    )
+                    for (re, im), info in zip(bufs, sp.slot_slices)
+                ]
+                return run_steps_split(jnp, sp.program, sliced, precision)
+            sliced = [
+                index_buffer(jnp, arr, info, indices)
+                for arr, info in zip(bufs, sp.slot_slices)
+            ]
+            return _run_steps(jnp, sp.program, list(sliced))
+
+        return jax.jit(fn)
+
+    local_fns = [make_local_fn(sp) for sp in sps]
+
+    # fan-in pair programs are slice-independent (legs already reduced)
+    pair_programs = []
+    pair_metas = list(metas)
+    for x, y in contract_path.toplevel:
+        program, result_meta = _pair_program(pair_metas[x], pair_metas[y])
+        pair_programs.append(program)
+        pair_metas[x] = result_meta
+    root = (
+        _fanin_survivor(k, contract_path.toplevel)
+        if contract_path.toplevel
+        else 0
+    )
+    final_meta = pair_metas[root]
+
+    def run(max_slices: int | None = None):
+        num = slicing.num_slices if max_slices is None else min(
+            slicing.num_slices, max_slices
+        )
+        acc = None
+        for s in range(num):
+            # host (uncommitted) indices: each jit transfers them to its
+            # own partition's device
+            indices = np.asarray(_slice_indices(slicing, s), dtype=np.int32)
+            held = [
+                fn(bufs, indices) for fn, bufs in zip(local_fns, buffers)
+            ]  # async: all devices work concurrently
+            for pi, (x, y) in enumerate(contract_path.toplevel):
+                target = devices[mapping.device(x)]
+                moved = jax.device_put(held[y], target)
+                pair_fn = jit_program(
+                    pair_programs[pi], split_complex, precision, donate=False
+                )
+                held[x] = pair_fn([held[x], moved])
+                held[y] = None
+            if acc is None:
+                acc = held[root]
+            elif split_complex:
+                acc = (acc[0] + held[root][0], acc[1] + held[root][1])
+            else:
+                acc = acc + held[root]
+
+        if split_complex:
+            from tnc_tpu.ops.split_complex import combine_array
+
+            data = combine_array(*acc)
+        else:
+            data = np.asarray(acc)
+        return data.reshape(tuple(final_meta.bond_dims))
+
+    return run, slicing, final_meta
 
 
 def broadcast_path(path_: ContractionPath, root: int = 0) -> ContractionPath:
