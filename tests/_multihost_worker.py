@@ -1,0 +1,105 @@
+"""Worker for the 2-process multi-host test (the ``#[mpi_test(2)]``
+analogue, reference ``tnc/tests/integration_tests.rs:88-119``).
+
+Run as: python _multihost_worker.py <pid> <nprocs> <port>
+
+Process 0 plans (partitioning + paths); the path reaches process 1 only
+through ``broadcast_path``'s multi-host branch
+(``tnc_tpu/parallel/partitioned.py``). Each process contracts its own
+partition, partition 1's result is broadcast to process 0, and process 0
+contracts the fan-in pair and checks the full-network oracle.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
+jax.distributed.initialize(f"127.0.0.1:{port}", num_processes=nprocs, process_id=pid)
+assert jax.process_count() == nprocs, jax.process_count()
+
+import numpy as np
+from jax.experimental import multihost_utils
+
+from tnc_tpu.builders.connectivity import ConnectivityLayout
+from tnc_tpu.builders.random_circuit import random_circuit
+from tnc_tpu.contractionpath.contraction_path import ContractionPath
+from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+from tnc_tpu.parallel.partitioned import broadcast_path
+from tnc_tpu.tensornetwork.contraction import contract_tensor_network
+from tnc_tpu.tensornetwork.partitioning import (
+    find_partitioning,
+    partition_tensor_network,
+)
+from tnc_tpu.tensornetwork.simplify import simplify_network
+
+# every process builds the same network (deterministic seed) — mirrors
+# the reference, where the circuit is constructed on every rank and only
+# the path is broadcast (distributed_contraction.rs:20-42)
+rng = np.random.default_rng(9)
+tn = simplify_network(
+    random_circuit(10, 6, 0.5, 0.5, rng, ConnectivityLayout.LINE, bitstring="0" * 10)
+)
+parts = find_partitioning(tn, nprocs)
+grouped = partition_tensor_network(tn, parts)
+
+if pid == 0:
+    path = Greedy(OptMethod.GREEDY).find_path(grouped).replace_path()
+else:
+    path = ContractionPath.simple([])  # placeholder; real path arrives by bcast
+
+path = broadcast_path(path, root=0)
+assert path.toplevel and len(path.nested) == nprocs, "broadcast path incomplete"
+print(f"proc {pid}: broadcast_path ok ({len(path.nested)} nested)", flush=True)
+
+# local phase: this process contracts ITS partition only
+mine = contract_tensor_network(
+    grouped[pid] if hasattr(grouped, "__getitem__") else list(grouped.tensors)[pid],
+    path.nested[pid],
+    backend="numpy",
+)
+local = np.ascontiguousarray(np.asarray(mine.data.into_data(), dtype=np.complex128))
+
+# fan-in across processes: partition 1's tensor travels to process 0
+# (broadcast_one_to_all is the single-controller-free transport here)
+re_im = np.stack([local.real, local.imag])
+other = multihost_utils.broadcast_one_to_all(re_im, is_source=pid == 1)
+if pid == 0:
+    other = np.asarray(other)
+    theirs_data = other[0] + 1j * other[1]
+    # rebuild the remote partition's metadata from the broadcast path
+    from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+    from tnc_tpu.tensornetwork.tensordata import TensorData
+
+    remote_meta = contract_tensor_network(
+        list(grouped.tensors)[1], path.nested[1], backend="numpy"
+    )  # deterministic: same legs/shape as process 1 computed
+    pair = CompositeTensor(
+        [
+            LeafTensor(list(mine.legs), list(mine.bond_dims), TensorData.matrix(local)),
+            LeafTensor(
+                list(remote_meta.legs),
+                list(remote_meta.bond_dims),
+                TensorData.matrix(theirs_data.reshape(remote_meta.bond_dims)),
+            ),
+        ]
+    )
+    out = contract_tensor_network(pair, ContractionPath.simple([(0, 1)]), backend="numpy")
+    got = complex(np.asarray(out.data.into_data()).reshape(-1)[0])
+
+    flat = Greedy(OptMethod.GREEDY).find_path(tn)
+    oracle = contract_tensor_network(tn, flat.replace_path(), backend="numpy")
+    want = complex(np.asarray(oracle.data.into_data()).reshape(-1)[0])
+    assert abs(got - want) <= 1e-8 * max(1.0, abs(want)), (got, want)
+    print(f"proc 0: MULTIHOST OK {got}", flush=True)
+else:
+    print(f"proc {pid}: MULTIHOST OK (sent partition)", flush=True)
