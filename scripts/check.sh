@@ -9,6 +9,12 @@ cd "$(dirname "$0")/.."
 echo "== syntax =="
 python -m compileall -q tnc_tpu tests examples scripts bench.py __graft_entry__.py
 
+echo "== lint =="
+python scripts/lint.py
+
+echo "== doctests (docs-as-spec, cargo test --doc analogue) =="
+python scripts/run_doctests.py
+
 echo "== tests + coverage (floor ${COVERAGE_MIN:-75}%) =="
 python scripts/coverage_gate.py tests/ -q
 

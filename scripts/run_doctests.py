@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""Docs-as-spec runner (the reference compiles every docstring example in
+CI — ``cargo test --doc``, ``.github/workflows/test.yml``): executes the
+doctest examples on the public API modules. Pins the CPU platform first —
+examples must not depend on accelerator hardware."""
+
+from __future__ import annotations
+
+import doctest
+import importlib
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+MODULES = [
+    "tnc_tpu.tensornetwork.tensor",
+    "tnc_tpu.tensornetwork.contraction",
+    "tnc_tpu.tensornetwork.simplify",
+    "tnc_tpu.tensornetwork.partitioning",
+    "tnc_tpu.contractionpath.contraction_path",
+    "tnc_tpu.contractionpath.contraction_cost",
+    "tnc_tpu.contractionpath.slicing",
+    "tnc_tpu.gates",
+    "tnc_tpu.io.qasm.importer",
+    "tnc_tpu.ops.budget",
+]
+
+
+def main() -> int:
+    failures = attempts = 0
+    for name in MODULES:
+        mod = importlib.import_module(name)
+        result = doctest.testmod(mod, verbose=False)
+        failures += result.failed
+        attempts += result.attempted
+        status = "ok" if result.failed == 0 else f"{result.failed} FAILED"
+        print(f"{name}: {result.attempted} examples, {status}")
+    print(f"doctests: {attempts} examples, {failures} failures")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,7 +1,6 @@
 """Benchmark harness: protocol crash-resume, artifact cache, sweep/run
 end-to-end (reference: ``benchmark/src/{protocol,main,results}.rs``)."""
 
-import json
 
 import pytest
 

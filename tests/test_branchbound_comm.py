@@ -6,13 +6,8 @@ import numpy as np
 import pytest
 
 from tnc_tpu import CompositeTensor, LeafTensor
-from tnc_tpu.builders.random_circuit import random_circuit
-from tnc_tpu.builders.connectivity import ConnectivityLayout
 from tnc_tpu.contractionpath.communication_schemes import CommunicationScheme
-from tnc_tpu.contractionpath.contraction_cost import (
-    communication_path_cost,
-    contract_path_cost,
-)
+from tnc_tpu.contractionpath.contraction_cost import communication_path_cost
 from tnc_tpu.contractionpath.contraction_path import validate_path
 from tnc_tpu.contractionpath.paths import Greedy, Optimal, OptMethod
 from tnc_tpu.contractionpath.paths.base import CostType

@@ -2,10 +2,7 @@
 compiled-peak preflight — the regression tests for the BENCH_r02 OOM
 (a 34 GB tile-padded allocation compiled into 16 GB of HBM)."""
 
-import math
-
 import numpy as np
-import pytest
 
 from tnc_tpu.ops.budget import (
     clamp_slice_batch,

@@ -11,7 +11,6 @@ import pytest
 
 from tnc_tpu import CompositeTensor, LeafTensor, path
 from tnc_tpu.builders.circuit_builder import Circuit
-from tnc_tpu.contractionpath.contraction_path import ssa_replace_ordering
 from tnc_tpu.contractionpath.paths import Greedy, OptMethod
 from tnc_tpu.tensornetwork.contraction import contract_tensor_network
 from tnc_tpu.tensornetwork.tensordata import TensorData

@@ -10,7 +10,6 @@ from tnc_tpu import CompositeTensor
 from tnc_tpu.builders.connectivity import ConnectivityLayout
 from tnc_tpu.builders.random_circuit import random_circuit
 from tnc_tpu.contractionpath.communication_schemes import CommunicationScheme
-from tnc_tpu.contractionpath.contraction_path import validate_path
 from tnc_tpu.contractionpath.repartitioning import compute_solution
 from tnc_tpu.contractionpath.repartitioning.genetic import (
     GeneticSettings,

@@ -5,7 +5,6 @@ from __future__ import annotations
 import logging
 import time
 from dataclasses import dataclass
-from pathlib import Path
 
 from tnc_tpu.benchmark.cache import ArtifactCache, cache_key
 from tnc_tpu.benchmark.methods import METHODS, MethodContext

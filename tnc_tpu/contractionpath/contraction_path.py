@@ -33,6 +33,12 @@ class ContractionPath:
 
     @classmethod
     def simple(cls, toplevel: Sequence[tuple[int, int]]) -> "ContractionPath":
+        """A flat (un-nested) path.
+
+        >>> p = ContractionPath.simple([(0, 1), (0, 2)])
+        >>> p.is_simple(), len(p)
+        (True, 2)
+        """
         return cls({}, list(toplevel))
 
     def is_simple(self) -> bool:
@@ -85,6 +91,10 @@ def ssa_replace_ordering(
     """SSA → replace-left, recursing into nested paths
     (``contractionpath.rs:197-215``). ``num_inputs`` defaults to
     ``len(toplevel) + 1`` (a fully-contracting path).
+
+    >>> ssa = ContractionPath.simple([(0, 1), (3, 2), (4, 5)])
+    >>> ssa_replace_ordering(ssa, num_inputs=4).toplevel
+    [(0, 1), (3, 2), (0, 3)]
     """
     nested = {i: ssa_replace_ordering(p) for i, p in ssa.nested.items()}
     n = num_inputs if num_inputs is not None else len(ssa.toplevel) + 1

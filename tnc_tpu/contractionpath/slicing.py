@@ -17,7 +17,6 @@ predicted peak intermediate size, until the peak fits the target.
 
 from __future__ import annotations
 
-import math
 from dataclasses import dataclass
 from typing import Sequence
 
@@ -87,6 +86,13 @@ def find_slicing(
 
     Only *closed* legs (absent from the final result) are sliceable.
     Raises if the target cannot be met within ``max_slices``.
+
+    >>> from tnc_tpu.tensornetwork.tensor import LeafTensor
+    >>> ts = [LeafTensor.from_const([0, 1], 4), LeafTensor.from_const([1, 2], 4),
+    ...       LeafTensor.from_const([2, 0], 4)]   # closed triangle
+    >>> s = find_slicing(ts, [(0, 1), (0, 2)], target_size=12)
+    >>> s.num_slices >= 4 and len(s.legs) >= 1
+    True
     """
     dims: dict[int, int] = {}
     open_legs: set[int] = set()

@@ -268,10 +268,23 @@ _register_builtins()
 
 
 def is_gate_known(name: str) -> bool:
+    """Is ``name`` in the registry (``gates.rs:70-74``)?
+
+    >>> is_gate_known("h"), is_gate_known("nonsense")
+    (True, False)
+    """
     return name in _GATES
 
 
 def load_gate(name: str, angles: Sequence[float] = ()) -> np.ndarray:
+    """Materialize a registered gate's matrix (``gates.rs:51-57``).
+
+    >>> import numpy as np
+    >>> np.allclose(load_gate("x"), [[0, 1], [1, 0]])
+    True
+    >>> load_gate("rz", [0.0]).shape
+    (2, 2)
+    """
     if name not in _GATES:
         raise KeyError(f"Gate '{name}' not found.")
     return _GATES[name].compute(angles)

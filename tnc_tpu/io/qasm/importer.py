@@ -272,6 +272,16 @@ class _Importer:
 
 
 def import_qasm(code: str, include_dir: str | Path | None = None) -> Circuit:
-    """Create a :class:`Circuit` from OpenQASM 2.0 source."""
+    """Create a :class:`Circuit` from OpenQASM 2.0 source.
+
+    >>> c = import_qasm('''OPENQASM 2.0;
+    ... include "qelib1.inc";
+    ... qreg q[2];
+    ... h q[0];
+    ... cx q[0], q[1];''')
+    >>> tn, _ = c.into_statevector_network()
+    >>> len(tn)   # 2 kets + 2 gates
+    4
+    """
     importer = _Importer(Path(include_dir) if include_dir else None)
     return importer.run(code)

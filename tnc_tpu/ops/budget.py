@@ -71,7 +71,11 @@ def device_hbm_bytes(device=None) -> int:
 
 def padded_elems(shape: tuple[int, ...]) -> int:
     """Tile-padded element count of an f32 buffer: the minor dim pads up
-    to 128 (XLA shrinks sublane tiles, so the second-minor does not pad)."""
+    to 128 (XLA shrinks sublane tiles, so the second-minor does not pad).
+
+    >>> padded_elems((4, 128)), padded_elems((4, 2)), padded_elems((1024,))
+    (512, 512, 1024)
+    """
     if not shape:
         return 1
     n = math.prod(shape[:-1]) if len(shape) > 1 else 1

@@ -15,7 +15,7 @@ and accumulates — no host round-trips between slices.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Sequence
+from typing import Sequence
 
 import numpy as np
 

@@ -19,11 +19,11 @@ happens at the host→device boundary.
 
 from __future__ import annotations
 
-from typing import Any, Sequence
+from typing import Any
 
 import numpy as np
 
-from tnc_tpu.ops.program import ContractionProgram, PairStep
+from tnc_tpu.ops.program import ContractionProgram
 
 
 def split_array(array: np.ndarray, dtype: str = "float32") -> tuple[np.ndarray, np.ndarray]:

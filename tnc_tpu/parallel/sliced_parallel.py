@@ -17,8 +17,6 @@ device count used in tests (the ``mpi_test`` analogue).
 from __future__ import annotations
 
 import logging
-from typing import Any, Sequence
-
 import numpy as np
 
 logger = logging.getLogger(__name__)

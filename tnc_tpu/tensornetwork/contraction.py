@@ -37,6 +37,16 @@ def contract_tensor_network(
     (``contraction.rs:70-86``) but may be ordered differently — the
     program compiler picks the buffer order that tiles best on TPU, and
     ``result_legs`` records it; consumers address legs by id.
+
+    >>> import numpy as np
+    >>> from tnc_tpu.contractionpath.contraction_path import path
+    >>> from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+    >>> from tnc_tpu.tensornetwork.tensordata import TensorData
+    >>> a = LeafTensor([0], [2]); a.data = TensorData.matrix(np.array([1.0, 2.0]))
+    >>> b = LeafTensor([0], [2]); b.data = TensorData.matrix(np.array([3.0, 4.0]))
+    >>> out = contract_tensor_network(CompositeTensor([a, b]), path((0, 1)))
+    >>> complex(out.data.into_data())   # 1*3 + 2*4
+    (11+0j)
     """
     backend_obj = get_backend(backend)
     program = build_program(tn, contract_path)

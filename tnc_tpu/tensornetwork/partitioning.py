@@ -50,7 +50,14 @@ def find_partitioning(
     imbalance: float = 0.03,
     seed: int = 42,
 ) -> list[int]:
-    """Block id per top-level tensor of ``tn``, in ``0..k``."""
+    """Block id per top-level tensor of ``tn``, in ``0..k``.
+    >>> from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+    >>> tn = CompositeTensor([LeafTensor.from_const([i, i + 1], 2)
+    ...                       for i in range(6)])
+    >>> parts = find_partitioning(tn, 2)
+    >>> len(parts), sorted(set(parts))
+    (6, [0, 1])
+    """
     if k <= 0:
         raise ValueError("k must be positive")
     if k == 1:

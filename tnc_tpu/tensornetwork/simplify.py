@@ -56,6 +56,17 @@ def simplify_network(tn: CompositeTensor, max_rank: int = 2) -> CompositeTensor:
     Disconnected low-rank tensors (no shared legs) are left in place.
     The result is numerically identical to contracting the original
     network: only exact pairwise contractions are applied.
+
+    >>> import numpy as np
+    >>> from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+    >>> from tnc_tpu.tensornetwork.tensordata import TensorData
+    >>> ket0 = LeafTensor([0], [2]); ket0.data = TensorData.matrix(np.array([1.0, 0]))
+    >>> ket1 = LeafTensor([1], [2]); ket1.data = TensorData.matrix(np.array([0, 1.0]))
+    >>> core = LeafTensor([0, 1, 2], [2, 2, 2])
+    >>> core.data = TensorData.matrix(np.arange(8.0).reshape(2, 2, 2))
+    >>> reduced = simplify_network(CompositeTensor([ket0, ket1, core]))
+    >>> len(reduced)   # one ket absorbed; networks stop shrinking at 2
+    2
     """
     tensors: dict[int, LeafTensor] = {i: t for i, t in enumerate(tn.tensors)}
     if any(isinstance(t, CompositeTensor) for t in tn.tensors):

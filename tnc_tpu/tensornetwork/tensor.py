@@ -65,7 +65,14 @@ class LeafTensor:
     def from_map(
         cls, legs: Sequence[EdgeIndex], bond_dims_map: Mapping[EdgeIndex, int]
     ) -> "LeafTensor":
-        """Build from a ``{leg: dim}`` map (``tensor.rs:476`` new_from_map)."""
+        """Build from a ``{leg: dim}`` map (``tensor.rs:476`` new_from_map).
+
+        >>> t = LeafTensor.from_map([0, 2], {0: 2, 2: 4})
+        >>> t.shape
+        (2, 4)
+        >>> t.size()
+        8.0
+        """
         return cls(legs, [bond_dims_map[leg] for leg in legs])
 
     @classmethod
@@ -133,7 +140,15 @@ class LeafTensor:
         return LeafTensor(legs, dims)
 
     def symmetric_difference(self, other: "LeafTensor") -> "LeafTensor":
-        """``(self - other) ++ (other - self)`` — the contraction-result legs."""
+        """``(self - other) ++ (other - self)`` — the contraction-result legs.
+
+        >>> a = LeafTensor.from_const([0, 1, 2], 2)
+        >>> b = LeafTensor.from_const([1, 2, 3], 2)
+        >>> (a ^ b).legs   # contraction result of a·b
+        [0, 3]
+        >>> (a & b).legs   # shared (contracted) legs
+        [1, 2]
+        """
         self_legs = set(self.legs)
         other_legs = set(other.legs)
         legs, dims = [], []
