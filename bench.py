@@ -33,7 +33,8 @@ Env knobs:
   BENCH_CPU_SLICES (2), BENCH_REPS (3), BENCH_PEAK_FLOPS (per device),
   BENCH_EXEC loop|chunked, BENCH_BATCH (8), BENCH_PROBE_SLICES (64),
   BENCH_FULL_SECONDS (900; run all slices if projected under this),
-  BENCH_TRACE 0|1 (profiler trace; default on-accelerator only)
+  BENCH_TRACE 0|1 (profiler trace; default on-accelerator only),
+  BENCH_PRECISION float32 (full-f32 dots) | default (bf16 3-pass, faster)
 """
 
 import json
@@ -204,6 +205,7 @@ def bench_sycamore_amplitude():
         sliced_strategy=strategy,
         slice_batch=_env_int("BENCH_BATCH", 8),
         chunk_steps=_env_int("BENCH_CHUNK_STEPS", 48),
+        precision=os.environ.get("BENCH_PRECISION", "float32"),
     )
     log(f"[bench] executor: {strategy}")
     extra = {}

@@ -1,0 +1,94 @@
+"""Differentiable contraction: gradients of expectation values through
+the compiled program vs finite differences and the analytic formula —
+the variational-circuit workflow the Rust reference cannot express."""
+
+import numpy as np
+
+from tnc_tpu.builders.circuit_builder import Circuit
+from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+from tnc_tpu.ops.autodiff import contraction_value_and_grad
+from tnc_tpu.ops.program import flat_leaf_tensors
+from tnc_tpu.tensornetwork.tensordata import TensorData
+
+
+def _rx_expectation_network(theta: float):
+    """⟨0|Rx(θ)† Z Rx(θ)|0⟩ network; expectation = cos(θ)."""
+    c = Circuit()
+    reg = c.allocate_register(1)
+    c.append_gate(TensorData.gate("rx", [theta]), [reg.qubit(0)])
+    return c.into_expectation_value_network()
+
+
+def _gate_slots(tn):
+    """Flat slots holding gate tensors (the differentiable parameters)."""
+    from tnc_tpu.tensornetwork.tensordata import DataKind
+
+    return [
+        i
+        for i, leaf in enumerate(flat_leaf_tensors(tn))
+        if leaf.data.kind in (DataKind.GATE, DataKind.MATRIX)
+        and leaf.dims() == 2
+    ]
+
+
+def test_rx_expectation_gradient_matches_analytic():
+    theta = 0.7
+    tn = _rx_expectation_network(theta)
+    result = Greedy(OptMethod.GREEDY).find_path(tn)
+    path = result.replace_path()
+
+    value, grads = contraction_value_and_grad(tn, path, dtype="complex128")
+    ev = complex(np.asarray(value).reshape(-1)[0])
+    assert abs(ev - np.cos(theta)) < 1e-8
+
+    assert grads  # gradient sweep ran
+    # finite-difference check on θ: d cos(θ)/dθ = −sin(θ)
+    eps = 1e-6
+    tn2 = _rx_expectation_network(theta + eps)
+    v2, _ = contraction_value_and_grad(
+        tn2, Greedy(OptMethod.GREEDY).find_path(tn2).replace_path(),
+        dtype="complex128",
+    )
+    fd = (complex(np.asarray(v2).reshape(-1)[0]).real - ev.real) / eps
+    assert abs(fd - (-np.sin(theta))) < 1e-4
+
+
+def test_gradient_matches_finite_difference_per_entry():
+    """Cotangent of a gate leaf vs entrywise finite differences."""
+    theta = 0.3
+    tn = _rx_expectation_network(theta)
+    path = Greedy(OptMethod.GREEDY).find_path(tn).replace_path()
+    slots = _gate_slots(tn)
+    slot = slots[0]
+
+    value, grads = contraction_value_and_grad(
+        tn, path, wrt=[slot], dtype="complex128"
+    )
+    grad = grads[0]
+
+    leaves = flat_leaf_tensors(tn)
+    base = np.asarray(leaves[slot].data.into_data()).astype(np.complex128)
+    f0 = complex(np.asarray(value).reshape(-1)[0]).real
+
+    eps = 1e-6
+    for idx in np.ndindex(*base.shape):
+        for direction in (1.0, 1.0j):
+            pert = base.copy()
+            pert[idx] += eps * direction
+            leaves2 = flat_leaf_tensors(tn)
+            arrays = [
+                np.asarray(leaf.data.into_data()).astype(np.complex128)
+                for leaf in leaves2
+            ]
+            arrays[slot] = pert
+            from tnc_tpu.ops.backends import NumpyBackend
+            from tnc_tpu.ops.program import build_program
+
+            program = build_program(tn, path)
+            out = NumpyBackend(np.complex128).execute(program, arrays)
+            f1 = complex(np.asarray(out).reshape(-1)[0]).real
+            fd = (f1 - f0) / eps
+            # JAX convention for real f of complex G (empirically
+            # validated here): df = Re(grad_entry · dG)
+            want = np.real(grad[idx] * direction)
+            assert abs(fd - want) < 1e-4, (idx, direction, fd, want)
