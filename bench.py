@@ -29,7 +29,7 @@ Env knobs:
   BENCH_CONFIG  sycamore_amplitude (default) | ghz3 | random20 | qaoa30 |
                 sycamore_m20_partitioned (runs on the virtual 8-CPU mesh)
   BENCH_QUBITS / BENCH_DEPTH / BENCH_SEED
-  BENCH_TARGET_LOG2_PEAK (28), BENCH_NTRIALS (64),
+  BENCH_TARGET_LOG2_PEAK (28), BENCH_NTRIALS (128),
   BENCH_CPU_SLICES (2), BENCH_REPS (3), BENCH_PEAK_FLOPS (per device),
   BENCH_EXEC loop|chunked, BENCH_BATCH (8), BENCH_PROBE_SLICES (64),
   BENCH_FULL_SECONDS (900; run all slices if projected under this),
@@ -157,7 +157,7 @@ def bench_sycamore_amplitude():
     depth = _env_int("BENCH_DEPTH", 14)
     seed = _env_int("BENCH_SEED", 42)
     target_log2 = float(os.environ.get("BENCH_TARGET_LOG2_PEAK", "28"))
-    ntrials = _env_int("BENCH_NTRIALS", 64)
+    ntrials = _env_int("BENCH_NTRIALS", 128)
     cpu_slices = _env_int("BENCH_CPU_SLICES", 2)
     reps = _env_int("BENCH_REPS", 3)
 

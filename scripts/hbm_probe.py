@@ -22,7 +22,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 CACHE = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".cache")
 
 
-def load_plan(qubits=53, depth=14, seed=42, target_log2=28.0, ntrials=64):
+def load_plan(qubits=53, depth=14, seed=42, target_log2=28.0, ntrials=128):
     os.makedirs(CACHE, exist_ok=True)
     key = f"northstar_{qubits}_{depth}_{seed}_{target_log2}_{ntrials}.pkl"
     path = os.path.join(CACHE, key)

@@ -91,7 +91,7 @@ def measure(depth: int, seed: int, ntrials: int, target_log2: float) -> dict:
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--depths", nargs="+", type=int, default=[14, 20])
-    ap.add_argument("--ntrials", type=int, default=64)
+    ap.add_argument("--ntrials", type=int, default=128)
     ap.add_argument("--target-log2", type=float, default=28.0)
     ap.add_argument("--out", default="PLANNER_QUALITY.json")
     args = ap.parse_args()
@@ -99,7 +99,7 @@ def main():
     out = {
         "description": (
             "Planner quality on the BASELINE north-star networks: native "
-            "Hyperoptimizer (64 trials, seed 42) vs Greedy, and "
+            "Hyperoptimizer (128 trials, seed 42) vs Greedy, and "
             "slice-and-reconfigure overhead at the single-chip HBM target. "
             "Reference comparator: cotengra HyperOptimizer bridge "
             "(paths/hyperoptimization.rs:66-73). Regenerate with "

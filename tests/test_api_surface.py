@@ -177,3 +177,35 @@ def test_balancing_schemes_complete():
         "ALTERNATING_TREE_TENSORS",
     ):
         assert hasattr(BalancingScheme, name)
+
+
+def test_round3_additions_surface():
+    """Round-3 public surface: HBM budget, autodiff, composed executors."""
+    from tnc_tpu.ops.budget import (
+        clamp_slice_batch,
+        compiled_peak_bytes,
+        device_hbm_bytes,
+        fits_hbm,
+        padded_elems,
+        program_peak_bytes,
+    )
+    from tnc_tpu.ops.autodiff import contraction_value_and_grad
+    from tnc_tpu.parallel.partitioned import (
+        distributed_partitioned_sliced_contraction,
+        flatten_partitioned_path,
+        partitioned_sliced_executor,
+    )
+
+    for fn in (
+        clamp_slice_batch,
+        compiled_peak_bytes,
+        device_hbm_bytes,
+        fits_hbm,
+        padded_elems,
+        program_peak_bytes,
+        contraction_value_and_grad,
+        distributed_partitioned_sliced_contraction,
+        flatten_partitioned_path,
+        partitioned_sliced_executor,
+    ):
+        assert callable(fn)
