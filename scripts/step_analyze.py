@@ -50,7 +50,7 @@ def main():
     rows.sort(reverse=True)
     for worst, i, st, pa, pb, a_sz, b_sz, o_sz in rows[:20]:
         print(
-            f"step {i:3d}: k={st.a_dot[0]:<6d} a={a_sz/2**20:7.1f}Mi "
+            f"step {i:3d}: k={(st.a_dot[0] if st.a_cfirst else st.a_dot[-1]):<6d} a={a_sz/2**20:7.1f}Mi "
             f"b={b_sz/2**20:7.1f}Mi o={o_sz/2**20:7.1f}Mi "
             f"padded-worst={worst/2**20:9.1f}Mi"
         )

@@ -65,17 +65,26 @@ def apply_step(xp, a: Any, b: Any, step) -> Any:
     av = _prep_operand(xp, a, step.a_view, step.a_perm, step.a_dot)
     bv = _prep_operand(xp, b, step.b_view, step.b_perm, step.b_dot)
     if xp is np:
-        a2 = av.reshape(step.a_mat)  # (k, m)
-        b2 = bv.reshape(step.b_mat)  # (k, n)
+        a2 = (
+            av.reshape(step.a_mat)
+            if step.a_cfirst
+            else av.reshape(step.a_mat[::-1]).T
+        )  # (k, m)
+        b2 = (
+            bv.reshape(step.b_mat)
+            if step.b_cfirst
+            else bv.reshape(step.b_mat[::-1]).T
+        )  # (k, n)
         out = (b2.T @ a2) if step.swap else (a2.T @ b2)
         return out.reshape(step.out_store)
     from jax import lax
 
-    dims = (((0,), (0,)), ((), ()))
+    ca = (0,) if step.a_cfirst else (len(step.a_dot) - 1,)
+    cb = (0,) if step.b_cfirst else (len(step.b_dot) - 1,)
     if step.swap:
-        out = lax.dot_general(bv, av, dims)
+        out = lax.dot_general(bv, av, ((cb, ca), ((), ())))
     else:
-        out = lax.dot_general(av, bv, dims)
+        out = lax.dot_general(av, bv, ((ca, cb), ((), ())))
     return out.reshape(step.out_store)
 
 

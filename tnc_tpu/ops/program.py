@@ -64,22 +64,27 @@ class PairStep:
     lhs: int  # slot of left input (result replaces this slot)
     rhs: int  # slot of right input (freed after the step)
     a_view: tuple[int, ...]  # fused macro view of lhs stored buffer
-    a_perm: tuple[int, ...] | None  # macro transpose to (contract…, free…)
-    a_dot: tuple[int, ...]  # post-perm reshape to (k, free-run dims…)
+    a_perm: tuple[int, ...] | None  # macro transpose (contract/free grouped)
+    a_dot: tuple[int, ...]  # post-perm reshape: (k, frees…) or (frees…, k)
+    a_cfirst: bool  # True: k is a_dot[0]; False: k is a_dot[-1]
     b_view: tuple[int, ...]
     b_perm: tuple[int, ...] | None
     b_dot: tuple[int, ...]
+    b_cfirst: bool
     swap: bool  # issue dot as (b, a): output legs = b_free ++ a_free
     out_store: tuple[int, ...]  # storage shape of the result buffer
 
     @property
     def a_mat(self) -> tuple[int, int]:
-        """2-D (k, m) view for the host matmul oracle."""
-        return (self.a_dot[0], int(math.prod(self.a_dot[1:])))
+        """2-D (k, m) view for the host matmul oracle (orientation folded
+        out by ``apply_step``)."""
+        k = self.a_dot[0] if self.a_cfirst else self.a_dot[-1]
+        return (k, int(math.prod(self.a_dot)) // max(k, 1))
 
     @property
     def b_mat(self) -> tuple[int, int]:
-        return (self.b_dot[0], int(math.prod(self.b_dot[1:])))
+        k = self.b_dot[0] if self.b_cfirst else self.b_dot[-1]
+        return (k, int(math.prod(self.b_dot)) // max(k, 1))
 
 
 def _storage_merge(
@@ -120,18 +125,20 @@ def _fused_view(
     """Run-fuse one operand for a contraction.
 
     ``edges``: stored (leg, dim) list.  ``key``: leg → desired sort key;
-    contracted legs sort first (key[0] == 0), free legs after
-    (key[0] == 1).
+    contracted legs carry key[0] == 0, free legs key[0] == 1.
 
     Each operand fuses at its **own** run granularity — the two operands'
     contract parts need not match axis-for-axis, because the executor
-    merges every post-perm contract axis into one leading ``k`` dim (a
-    leading-axes reshape, layout-free on TPU) before the dot. This keeps
-    the big operand's transpose at its natural ≤6-ish rank instead of
-    refining it down to the small operand's fragmentation.
+    merges every post-perm contract axis into one ``k`` dim (an
+    edge-axes reshape, layout-free on TPU) before the dot. The operand's
+    **orientation** — contract runs leading ``(k, frees…)`` or trailing
+    ``(frees…, k)`` — is chosen per operand: identity permutations win
+    outright, otherwise the orientation whose materialized minor dim is
+    larger (a ``(k, tiny-frees)`` operand would pad its tiny minor up to
+    128 lanes; flipping it to ``(tiny-frees, k)`` stores perfectly).
 
-    Returns: fused view shape, macro perm (or None), dot shape
-    ``(k, free-run dims…)``, and the post-perm free (leg-group, dim) list.
+    Returns: fused view shape, macro perm (or None), dot shape,
+    contract_first flag, and the post-perm free (leg-group, dim) list.
     """
     runs: list[list[tuple[int, int]]] = []
     order = {
@@ -149,33 +156,62 @@ def _fused_view(
             runs.append([(leg, dim)])
 
     view = tuple(int(math.prod(d for _, d in run)) for run in runs)
-    perm_order = sorted(range(len(runs)), key=lambda i: key[runs[i][0][0]])
 
-    # Tail guard: the post-perm trailing run becomes the materialized
-    # operand's minor dim. Free runs keep stored order (contract-leg
-    # extraction is then a cheap leading-dim row gather over an intact
-    # tail); only when the trailing run is small — e.g. the stored tail
-    # itself got contracted — move the largest free run to the minor
-    # position so the relayout this step pays anyway stays well-tiled.
-    free_idx = [i for i in perm_order if key[runs[i][0][0]][0] != 0]
-    if free_idx and view[free_idx[-1]] < _MIN_MINOR:
-        biggest = max(free_idx, key=lambda i: view[i])
-        if biggest != free_idx[-1] and view[biggest] > view[free_idx[-1]]:
-            perm_order.remove(biggest)
-            perm_order.append(biggest)
+    def orientation(contract_first: bool):
+        def run_key(i):
+            leg_key = key[runs[i][0][0]]
+            group = leg_key[0] if contract_first else (1 - leg_key[0])
+            return (group, leg_key[1])
 
-    perm: tuple[int, ...] | None = tuple(perm_order)
-    if perm == tuple(range(len(runs))):
-        perm = None
+        perm_order = sorted(range(len(runs)), key=run_key)
+        # Tail guard: the trailing run becomes the materialized minor
+        # dim; if it is small and FREE, move the largest free run there
+        # (the relayout is paid anyway — keep it well-tiled). Contract
+        # runs must never reorder: their merged k-order is the pairing
+        # contract with the other operand.
+        if (
+            perm_order
+            and view[perm_order[-1]] < _MIN_MINOR
+            and key[runs[perm_order[-1]][0][0]][0] != 0
+        ):
+            free_idx = [
+                i for i in perm_order if key[runs[i][0][0]][0] != 0
+            ]
+            biggest = max(free_idx, key=lambda i: view[i])
+            if biggest != perm_order[-1] and view[biggest] > view[perm_order[-1]]:
+                perm_order.remove(biggest)
+                perm_order.append(biggest)
+        perm: tuple[int, ...] | None = tuple(perm_order)
+        if perm == tuple(range(len(runs))):
+            perm = None
+        minor = view[perm_order[-1]] if perm_order else 1
+        return perm_order, perm, minor
+
+    cf = orientation(True)
+    cl = orientation(False)
+    if cf[1] is None:
+        perm_order, perm, contract_first = cf[0], cf[1], True
+    elif cl[1] is None:
+        perm_order, perm, contract_first = cl[0], cl[1], False
+    elif cf[2] >= cl[2]:
+        perm_order, perm, contract_first = cf[0], cf[1], True
+    else:
+        perm_order, perm, contract_first = cl[0], cl[1], False
+
     k = 1
     free = []
+    free_dims = []
     for i in perm_order:
         if key[runs[i][0][0]][0] == 0:
             k *= view[i]
         else:
             free.append(([leg for leg, _ in runs[i]], view[i]))
-    dot_shape = (k,) + tuple(d for _, d in free)
-    return view, perm, dot_shape, free
+            free_dims.append(view[i])
+    if contract_first:
+        dot_shape = (k,) + tuple(free_dims)
+    else:
+        dot_shape = tuple(free_dims) + (k,)
+    return view, perm, dot_shape, contract_first, free
 
 
 _INF_DEATH = 1 << 60
@@ -204,33 +240,53 @@ def _pair_step(
     if death is None:
         death = {}
 
-    # k-order follows the larger operand's stored order: its contract part
-    # stays in few runs; only the smaller operand pays an interleave.
-    a_size = ta.size()
-    b_size = tb.size()
-    big_edges = b_edges if b_size > a_size else a_edges
-    contract_order = [leg for leg, _ in big_edges if leg in shared]
-
-    def keys(edges):
-        key: dict[int, tuple] = {}
-        stored_pos = {leg: i for i, (leg, _) in enumerate(edges)}
+    def build(contract_order):
+        """Candidate step for one agreed k-order. Cost models the data
+        movement: each operand that needs a transpose pays its size
+        times the tile-padding penalty of the materialized output."""
         cpos = {leg: i for i, leg in enumerate(contract_order)}
-        for leg, _ in edges:
-            if leg in shared:
-                key[leg] = (0, cpos[leg])
-            else:
-                # frees keep stored order: no merge-shuffle ever builds
-                # up, and the contract extraction is a leading-dim row
-                # gather over the intact trailing block
-                key[leg] = (1, stored_pos[leg])
-        return key
 
-    a_key = keys(a_edges)
-    b_key = keys(b_edges)
+        def keys(edges):
+            key: dict[int, tuple] = {}
+            for pos, (leg, _) in enumerate(edges):
+                if leg in shared:
+                    key[leg] = (0, cpos[leg])
+                else:
+                    # frees keep stored order: no merge-shuffle ever
+                    # builds up, and the contract extraction is a
+                    # leading-dim row gather over the intact tail
+                    key[leg] = (1, pos)
+            return key
 
-    a_view, a_perm, a_dot, a_free = _fused_view(a_edges, a_key)
-    b_view, b_perm, b_dot, b_free = _fused_view(b_edges, b_key)
-    assert a_dot[0] == b_dot[0], "contract dims must agree"
+        a = _fused_view(a_edges, keys(a_edges))
+        b = _fused_view(b_edges, keys(b_edges))
+        cost = 0.0
+        for view, perm, _, _, _ in (a, b):
+            if perm is None:
+                continue
+            size = float(math.prod(view)) if view else 1.0
+            minor = view[perm[-1]] if perm else 1
+            penalty = (_MIN_MINOR / minor) if minor < _MIN_MINOR else 1.0
+            cost += size * penalty
+        return a, b, cost
+
+    # the agreed k-order makes one operand's contract part contiguous in
+    # its own storage while the other pays a relayout — try both and
+    # keep the cheaper (big x big joins would otherwise shuffle the
+    # wrong side; see step-cost model in `build`)
+    order_a = [leg for leg, _ in a_edges if leg in shared]
+    order_b = [leg for leg, _ in b_edges if leg in shared]
+    cand_a = build(order_a)
+    if order_a == order_b:
+        best = cand_a
+    else:
+        cand_b = build(order_b)
+        best = cand_a if cand_a[2] <= cand_b[2] else cand_b
+    (a_view, a_perm, a_dot, a_cfirst, a_free) = best[0]
+    (b_view, b_perm, b_dot, b_cfirst, b_free) = best[1]
+    a_k = a_dot[0] if a_cfirst else a_dot[-1]
+    b_k = b_dot[0] if b_cfirst else b_dot[-1]
+    assert a_k == b_k, "contract dims must agree"
 
     # orientation: the dot-rhs supplies the output's trailing dims — pick
     # the operand with the larger trailing free run so the stored result
@@ -268,9 +324,11 @@ def _pair_step(
         a_view=a_view,
         a_perm=a_perm,
         a_dot=a_dot,
+        a_cfirst=a_cfirst,
         b_view=b_view,
         b_perm=b_perm,
         b_dot=b_dot,
+        b_cfirst=b_cfirst,
         swap=swap,
         out_store=out_store,
     )

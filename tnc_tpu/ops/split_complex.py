@@ -67,8 +67,14 @@ def apply_step_split(xp, apair, bpair, step, precision=None):
     br = _prep_operand(xp, bpair[0], step.b_view, step.b_perm, step.b_dot)
     bi = _prep_operand(xp, bpair[1], step.b_view, step.b_perm, step.b_dot)
     if xp is np:
-        ar, ai = ar.reshape(step.a_mat), ai.reshape(step.a_mat)
-        br, bi = br.reshape(step.b_mat), bi.reshape(step.b_mat)
+
+        def as_km(part, mat, cfirst):
+            return part.reshape(mat) if cfirst else part.reshape(mat[::-1]).T
+
+        ar = as_km(ar, step.a_mat, step.a_cfirst)
+        ai = as_km(ai, step.a_mat, step.a_cfirst)
+        br = as_km(br, step.b_mat, step.b_cfirst)
+        bi = as_km(bi, step.b_mat, step.b_cfirst)
         if step.swap:
             re, im = gauss_matmul(np, br.T, bi.T, ar, ai)
         else:
@@ -78,12 +84,13 @@ def apply_step_split(xp, apair, bpair, step, precision=None):
     from jax import lax
 
     prec = _resolve_precision(precision)
-    dims = (((0,), (0,)), ((), ()))
+    ca = (0,) if step.a_cfirst else (len(step.a_dot) - 1,)
+    cb = (0,) if step.b_cfirst else (len(step.b_dot) - 1,)
 
     def dot(x, y):
         if step.swap:
-            return lax.dot_general(y, x, dims, precision=prec)
-        return lax.dot_general(x, y, dims, precision=prec)
+            return lax.dot_general(y, x, ((cb, ca), ((), ())), precision=prec)
+        return lax.dot_general(x, y, ((ca, cb), ((), ())), precision=prec)
 
     k1 = dot(ar + ai, br)
     k2 = dot(ar, bi - br)
