@@ -31,7 +31,7 @@ Env knobs:
   BENCH_QUBITS / BENCH_DEPTH / BENCH_SEED
   BENCH_TARGET_LOG2_PEAK (28), BENCH_NTRIALS (128),
   BENCH_CPU_SLICES (2), BENCH_REPS (3), BENCH_PEAK_FLOPS (per device),
-  BENCH_EXEC loop|chunked, BENCH_BATCH (8), BENCH_PROBE_SLICES (64),
+  BENCH_EXEC chunked|loop, BENCH_BATCH (8), BENCH_PROBE_SLICES (64),
   BENCH_FULL_SECONDS (900; run all slices if projected under this),
   BENCH_TRACE 0|1 (profiler trace; default on-accelerator only),
   BENCH_PRECISION float32 (full-f32 dots) | default (bf16 3-pass, faster)
@@ -126,14 +126,24 @@ def _device_peak_flops(device) -> float | None:
 
 
 def _time_backend(run, reps):
-    """Median wall-clock of ``run()`` over ``reps`` after one warmup."""
+    """Median wall-clock of ``run()`` over ``reps`` after one warmup.
+
+    ``run()`` may return device arrays (host=False executors) — timing
+    blocks on readiness WITHOUT a device→host transfer: on tunneled
+    backends the first D2H permanently degrades dispatch ~400×
+    (TPU_EVIDENCE_r03.md), so every timed region must stay on device.
+    """
+    import jax
+
     t0 = time.monotonic()
     out = run()
+    jax.block_until_ready(out)
     log(f"[bench] warmup (incl. compile): {time.monotonic() - t0:.2f}s")
     times = []
     for _ in range(reps):
         t0 = time.monotonic()
         out = run()
+        jax.block_until_ready(out)
         times.append(time.monotonic() - t0)
     log(f"[bench] runs: {[round(t, 4) for t in times]}")
     return float(np.median(times)), out
@@ -199,7 +209,7 @@ def bench_sycamore_amplitude():
     sp = build_sliced_program(tn, replace, slicing)
     arrays = [leaf.data.into_data() for leaf in flat_leaf_tensors(tn)]
 
-    strategy = os.environ.get("BENCH_EXEC", "loop")
+    strategy = os.environ.get("BENCH_EXEC", "chunked")
     backend = JaxBackend(
         dtype="complex64",
         sliced_strategy=strategy,
@@ -212,32 +222,41 @@ def bench_sycamore_amplitude():
     num = slicing.num_slices
 
     # -- probe: time a slice subset through the real executor --------------
+    # All timed runs keep results ON DEVICE (host=False): on tunneled
+    # backends the first device->host transfer permanently degrades
+    # dispatch ~400x (TPU_EVIDENCE_r03.md), so the single D2H for the
+    # amplitude happens only after every timed region is done.
     probe = _env_int("BENCH_MAX_SLICES", 0) or _env_int("BENCH_PROBE_SLICES", 64)
     probe = max(1, min(probe, num))
     log(f"[bench] probe: timing {probe}/{num} slices")
     probe_s, amp = _time_backend(
-        lambda: backend.execute_sliced(sp, arrays, max_slices=probe), reps
+        lambda: backend.execute_sliced(sp, arrays, max_slices=probe, host=False),
+        reps,
     )
     per_slice = probe_s / probe
     projected = per_slice * num
     log(f"[bench] {per_slice*1000:.2f} ms/slice -> projected full {projected:.1f}s")
-
-    _maybe_trace(backend, sp, arrays, probe, extra)
 
     forced_subset = bool(_env_int("BENCH_MAX_SLICES", 0))
     full_limit = float(os.environ.get("BENCH_FULL_SECONDS", "900"))
     if not forced_subset and probe < num and projected <= full_limit:
         # cheap enough: run and time ALL slices (the honest number)
         tpu_s, amp = _time_backend(
-            lambda: backend.execute_sliced(sp, arrays), reps
+            lambda: backend.execute_sliced(sp, arrays, host=False), reps
         )
     else:
         tpu_s = projected
         if probe < num:
             extra["extrapolated_from_slices"] = probe
             log(f"[bench] extrapolated full wall-clock: {tpu_s:.1f}s")
+
+    # first D2H of the process: everything after this line is untimed
+    if backend.split_complex and isinstance(amp, tuple):
+        amp = np.asarray(amp[0]) + 1j * np.asarray(amp[1])
     amplitude = complex(np.asarray(amp).reshape(-1)[0])
     log(f"[bench] amplitude (partial sum ok): {amplitude}")
+
+    _maybe_trace(backend, sp, arrays, probe, extra)
 
     # -- achieved throughput / MFU -----------------------------------------
     import jax
@@ -677,11 +696,11 @@ def main() -> None:
             ),
             (
                 "exec=chunked"
-                if os.environ.get("BENCH_EXEC", "loop") == "loop"
+                if os.environ.get("BENCH_EXEC", "chunked") == "loop"
                 else "exec=loop",
                 {
                     "BENCH_EXEC": "chunked"
-                    if os.environ.get("BENCH_EXEC", "loop") == "loop"
+                    if os.environ.get("BENCH_EXEC", "chunked") == "loop"
                     else "loop"
                 },
             ),

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Per-step device timing of the north-star program: jit each big step
+alone (split-complex, random data) and measure its wall-clock on the
+real device. Attribution tool for the sliced executor's per-slice time.
+
+Usage: [MIN_MB=4] [STEPS=82,104,...] python scripts/step_time.py
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scripts.hbm_probe import load_plan  # noqa: E402
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tnc_tpu.ops.sliced import build_sliced_program
+    from tnc_tpu.ops.split_complex import apply_step_split
+
+    tn, replace, slicing, _ = load_plan()
+    sp = build_sliced_program(tn, replace, slicing)
+    program = sp.program
+    min_elems = float(os.environ.get("MIN_MB", "4")) * (1 << 20) / 4
+    only = os.environ.get("STEPS")
+    only = {int(s) for s in only.split(",")} if only else None
+    precision = os.environ.get("PRECISION", "float32")
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} ({dev.device_kind})", file=sys.stderr)
+    rng = np.random.default_rng(0)
+
+    def rand_pair(n):
+        return (
+            jax.device_put(jnp.asarray(rng.standard_normal(n), "float32")),
+            jax.device_put(jnp.asarray(rng.standard_normal(n), "float32")),
+        )
+
+    total_ms = 0.0
+    rows = []
+    for i, st in enumerate(program.steps):
+        a_n = int(math.prod(st.a_view)) if st.a_view else 1
+        b_n = int(math.prod(st.b_view)) if st.b_view else 1
+        o_n = int(math.prod(st.out_store))
+        if only is not None and i not in only:
+            continue
+        if only is None and max(a_n, b_n, o_n) < min_elems:
+            continue
+
+        def step_fn(ap, bp, _st=st):
+            return apply_step_split(jnp, ap, bp, _st, precision)
+
+        fn = jax.jit(step_fn)
+        ap, bp = rand_pair(a_n), rand_pair(b_n)
+        ap = (ap[0].reshape([a_n]), ap[1].reshape([a_n]))
+        bp = (bp[0].reshape([b_n]), bp[1].reshape([b_n]))
+        try:
+            t0 = time.monotonic()
+            out = fn(ap, bp)
+            jax.block_until_ready(out)
+            compile_s = time.monotonic() - t0
+            times = []
+            for _ in range(3):
+                t0 = time.monotonic()
+                jax.block_until_ready(fn(ap, bp))
+                times.append(time.monotonic() - t0)
+            ms = float(np.median(times)) * 1e3
+        except Exception as e:  # noqa: BLE001 — report and keep going
+            print(f"step {i:3d}: FAIL {type(e).__name__}: {str(e)[:120]}")
+            continue
+        total_ms += ms
+        k = st.a_dot[0] if st.a_cfirst else st.a_dot[-1]
+        flops = 8 * k * (a_n // k) * (b_n // k)  # complex pair step
+        note = []
+        if st.a_ops is not None:
+            note.append(
+                "aops:"
+                + ",".join(
+                    f"W{op[1]}" if op[0] == "lanemix" else op[0][0]
+                    for op in st.a_ops
+                )
+            )
+        if st.b_ops is not None:
+            note.append(
+                "bops:"
+                + ",".join(
+                    f"W{op[1]}" if op[0] == "lanemix" else op[0][0]
+                    for op in st.b_ops
+                )
+            )
+        if st.a_ops is None and st.a_perm is not None:
+            note.append("aperm")
+        if st.b_ops is None and st.b_perm is not None:
+            note.append("bperm")
+        rows.append((ms, i, a_n, b_n, o_n, compile_s, flops, " ".join(note)))
+        print(
+            f"step {i:3d}: {ms:8.3f} ms  (compile {compile_s:5.1f}s) "
+            f"a=2^{math.log2(max(a_n,1)):.0f} b=2^{math.log2(max(b_n,1)):.0f} "
+            f"out=2^{math.log2(max(o_n,1)):.0f} "
+            f"{flops/1e9:6.2f} GF  {rows[-1][7]}",
+            flush=True,
+        )
+
+    rows.sort(reverse=True)
+    print(f"\nsum of measured steps: {total_ms:.1f} ms")
+    print("top 10:")
+    for ms, i, a_n, b_n, o_n, _, flops, note in rows[:10]:
+        print(f"  step {i:3d}: {ms:8.3f} ms  {note}")
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,7 @@ nothing.
 from __future__ import annotations
 
 import logging
+import os
 import warnings
 from collections import OrderedDict
 from typing import Any, Sequence
@@ -37,16 +38,59 @@ class Backend:
         raise NotImplementedError
 
     def execute_sliced(
-        self, sp, arrays: Sequence[Any], max_slices: int | None = None
-    ) -> np.ndarray:
+        self, sp, arrays: Sequence[Any], max_slices: int | None = None, host: bool = True
+    ):
         raise NotImplementedError
 
 
-def _prep_operand(xp, buf, view, perm, dot_shape):
+def _lanemix_jax(x, w, idx):
+    """Static permutation of the trailing ``w``-wide lane window:
+    ``out[..., j] = flat[..., idx[j]]``. Executed as an exact one-hot
+    matmul on the MXU (``precision=HIGHEST`` — every output element is a
+    single 1.0·x product, so the result is bit-exact) or, for wide
+    windows, a gather. ``TNC_TPU_LANEMIX=take`` forces the gather."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    x2 = x.reshape((-1, w))
+    mode, cap = lanemix_env()
+    if mode == "take" or w > int(cap):
+        return jnp.take(x2, jnp.asarray(idx, dtype="int32"), axis=1)
+    p = np.zeros((w, w), dtype=np.float32)
+    p[np.asarray(idx), np.arange(w)] = 1
+    pc = jnp.asarray(p, dtype=x2.dtype)
+    # per-operand precision: the data side needs the full 3-term bf16
+    # split to pass through exactly; the one-hot side is exact in one
+    # term (every output is a single 1.0·x product) — 3 MXU passes, not 6
+    return lax.dot_general(
+        x2,
+        pc,
+        (((1,), (0,)), ((), ())),
+        precision=(lax.Precision.HIGHEST, lax.Precision.DEFAULT),
+    )
+
+
+def _prep_operand(xp, buf, view, perm, dot_shape, ops=None):
     """Stored buffer → ``(k, free-run dims…)`` dot operand: reshape to the
     fused view, one macro transpose to (contract…, free…), and a
     leading-axes merge of the contract runs (layout-free on TPU — tiling
-    only constrains trailing dims). See :mod:`tnc_tpu.ops.program`."""
+    only constrains trailing dims). See :mod:`tnc_tpu.ops.program`.
+
+    When the compiler attached a staged plan (``ops``), the device path
+    executes it instead — a sequence of minor-dim-safe reshapes,
+    leading-dim transposes, and lane permutations that never materializes
+    a tile-padded buffer (the naive path's failure mode on high-rank
+    shuffles). The host oracle keeps the naive pair (same semantics)."""
+    if ops is not None and xp is not np:
+        x = buf
+        for op in ops:
+            if op[0] == "reshape":
+                x = x.reshape(op[1])
+            elif op[0] == "transpose":
+                x = xp.transpose(x, op[1])
+            else:  # ("lanemix", W, idx)
+                x = _lanemix_jax(x, op[1], op[2])
+        return x.reshape(dot_shape)
     v = buf.reshape(view)
     if perm is not None:
         v = xp.transpose(v, perm)
@@ -62,8 +106,8 @@ def apply_step(xp, a: Any, b: Any, step) -> Any:
     ``k`` dim of both operands — XLA performs no internal relayout and
     every materialized buffer keeps a large minor dim (see
     :mod:`tnc_tpu.ops.program`). Host path: the equivalent 2-D matmul."""
-    av = _prep_operand(xp, a, step.a_view, step.a_perm, step.a_dot)
-    bv = _prep_operand(xp, b, step.b_view, step.b_perm, step.b_dot)
+    av = _prep_operand(xp, a, step.a_view, step.a_perm, step.a_dot, step.a_ops)
+    bv = _prep_operand(xp, b, step.b_view, step.b_perm, step.b_dot, step.b_ops)
     if xp is np:
         a2 = (
             av.reshape(step.a_mat)
@@ -102,6 +146,16 @@ _PROGRAM_JIT_CACHE: "OrderedDict[tuple, Any]" = OrderedDict()
 _PROGRAM_JIT_CACHE_MAX = 256
 
 
+def lanemix_env() -> tuple:
+    """The lanemix env knobs are read at *trace* time, so every compiled
+    executable must be keyed by them — otherwise flipping
+    ``TNC_TPU_LANEMIX`` mid-process silently returns stale programs."""
+    return (
+        os.environ.get("TNC_TPU_LANEMIX", "matmul"),
+        os.environ.get("TNC_TPU_LANEMIX_MATMUL_MAX", "2048"),
+    )
+
+
 def jit_program(
     program: ContractionProgram,
     split_complex: bool,
@@ -117,7 +171,7 @@ def jit_program(
 
     if not split_complex:
         precision = None  # only the split path consumes it: one cache key
-    key = (program.signature(), split_complex, precision, donate)
+    key = (program.signature(), split_complex, precision, donate, lanemix_env())
     fn = _PROGRAM_JIT_CACHE.get(key)
     if fn is not None:
         _PROGRAM_JIT_CACHE.move_to_end(key)
@@ -200,7 +254,7 @@ class NumpyBackend(Backend):
         return np.asarray(out).reshape(program.result_shape)
 
     def execute_sliced(
-        self, sp, arrays: Sequence[Any], max_slices: int | None = None
+        self, sp, arrays: Sequence[Any], max_slices: int | None = None, host: bool = True
     ) -> np.ndarray:
         from tnc_tpu.ops.sliced import execute_sliced_numpy
 
@@ -228,15 +282,19 @@ class JaxBackend(Backend):
         device=None,
         split_complex: bool | None = None,
         precision: str | None = "float32",
-        sliced_strategy: str = "loop",
+        sliced_strategy: str = "chunked",
         slice_batch: int = 8,
         chunk_steps: int = 64,
     ):
-        """``sliced_strategy``: 'loop' compiles the whole slice loop into
-        one on-device ``fori_loop`` program (lowest overhead, one big
-        compile); 'chunked' splits the program into slice-batched chunks
-        (K small compiles, batched matmuls — see
-        :mod:`tnc_tpu.ops.chunked`)."""
+        """``sliced_strategy``: 'chunked' (default) splits the program
+        into slice-batched chunks (K small compiles, batched matmuls,
+        HBM-budget-clamped batch — see :mod:`tnc_tpu.ops.chunked`);
+        'loop' compiles the whole slice loop into one on-device
+        ``fori_loop`` program. Measured on the v5e (north-star program):
+        the straight-line chunked code runs the same steps ~150× faster
+        than the while-loop body — XLA pessimizes loop bodies — so
+        'loop' is only worth it when dispatch latency dominates (very
+        small per-slice programs)."""
         import jax
 
         self._jax = jax
@@ -275,14 +333,20 @@ class JaxBackend(Backend):
         return self._compiled(program)(buffers)
 
     def execute_sliced(
-        self, sp, arrays: Sequence[Any], max_slices: int | None = None
-    ) -> np.ndarray:
+        self, sp, arrays: Sequence[Any], max_slices: int | None = None, host: bool = True
+    ):
         """Run a sliced program; the slice loop executes on device.
-        ``max_slices`` caps the loop (partial sum — benchmark subsets)."""
+        ``max_slices`` caps the loop (partial sum — benchmark subsets).
+        ``host=False`` keeps the result on device in stored shape (a
+        (real, imag) pair in split mode) — no device→host transfer, the
+        benchmark-timing contract (tunneled backends degrade dispatch
+        permanently after the first D2H; see TPU_EVIDENCE_r03.md)."""
 
         from tnc_tpu.ops.sliced import make_jax_sliced_fn
 
         if sp.slicing.num_slices == 1:
+            if not host:  # device-resident, stored shape — no D2H
+                return self.execute_on_device(sp.program, arrays)
             return self.execute(sp.program, arrays)
 
         if self.sliced_strategy == "chunked":
@@ -298,6 +362,7 @@ class JaxBackend(Backend):
                 dtype=self.dtype,
                 device=self.device,
                 max_slices=max_slices,
+                host=host,
             )
 
         key = (
@@ -306,6 +371,7 @@ class JaxBackend(Backend):
             str(self.dtype),
             self.split_complex,
             max_slices,
+            lanemix_env(),
         )
         fn = self._cache.get(key)
         if fn is None:
@@ -318,6 +384,8 @@ class JaxBackend(Backend):
             self._cache[key] = fn
         buffers = self._device_buffers(arrays)
         result = fn(buffers)
+        if not host:
+            return result
         if self.split_complex:
             from tnc_tpu.ops.split_complex import combine_array
 

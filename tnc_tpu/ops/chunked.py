@@ -117,12 +117,15 @@ def _compiled_plan(
     import jax
     import jax.numpy as jnp
 
+    from tnc_tpu.ops.backends import lanemix_env
+
     key = (
         sp.signature(),
         batch,
         chunk_steps,
         split_complex,
         precision,
+        lanemix_env(),
     )
     hit = _PLAN_CACHE.get(key)
     if hit is not None:
@@ -246,6 +249,7 @@ def execute_sliced_batched_jax(
     device=None,
     enforce_budget: bool = True,
     max_slices: int | None = None,
+    host: bool = True,
 ):
     """Run a sliced program as chunked, slice-batched jitted calls.
 
@@ -255,6 +259,12 @@ def execute_sliced_batched_jax(
     with ``enforce_budget=False``) and then to the largest divisor of
     the slice count <= the request. ``max_slices`` caps the loop (a
     partial sum over the first slices — benchmark subset mode).
+
+    ``host=False`` returns the device-resident accumulator (a
+    (real, imag) pair in split mode) in **stored** shape without any
+    device→host transfer — benchmark timing must stay transfer-free:
+    on tunneled backends the first D2H permanently degrades dispatch
+    (measured 430× on the v5e axon tunnel, TPU_EVIDENCE_r03.md).
     """
     import jax.numpy as jnp
 
@@ -304,19 +314,40 @@ def execute_sliced_batched_jax(
     else:
         acc = jnp.zeros(stored_shape, dtype=dtype)
 
+    import os as _os
+    import time as _time
+
+    _dbg = _os.environ.get("TNC_TPU_DEBUG_TIMING") == "1"
     for start in range(0, num, batch):
         idx = jnp.asarray(all_indices[start : start + batch])
+        _t0 = _time.monotonic()
         sliced = gather(device_full, idx)
+        if _dbg:
+            import jax as _jax
+
+            _jax.block_until_ready(sliced)
+            print(f"[chunked] gather {(_time.monotonic()-_t0)*1e3:.1f}ms", flush=True)
         state = dict(enumerate(sliced))
-        for chunk, fn in zip(chunks, chunk_fns):
+        for ci, (chunk, fn) in enumerate(zip(chunks, chunk_fns)):
             ins = tuple(state[s] for s in chunk.in_slots)
+            _t0 = _time.monotonic()
             outs = fn(ins)
+            if _dbg:
+                import jax as _jax
+
+                _jax.block_until_ready(outs)
+                print(
+                    f"[chunked] chunk{ci} {(_time.monotonic()-_t0)*1e3:.1f}ms",
+                    flush=True,
+                )
             for slot, buf in zip(chunk.out_slots, outs):
                 state[slot] = buf
             for step in chunk.steps:
                 state.pop(step.rhs, None)
         acc = reduce_batch(acc, state[sp.program.result_slot])
 
+    if not host:
+        return acc
     if split_complex:
         from tnc_tpu.ops.split_complex import combine_array
 

@@ -45,6 +45,156 @@ from tnc_tpu.contractionpath.contraction_path import ContractionPath
 from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor, Tensor
 
 _MIN_MINOR = 128  # f32 lane tile: trailing dims below this pad up to it
+_STAGED_MIN_SIZE = 1 << 18  # staged prep only pays off for big operands
+_STAGED_PAD_FACTOR = 4.0  # naive materialization tolerated up to this
+# widest lane window the staged planner accepts (bounds the host-side
+# index table; execution uses a gather above the matmul cap)
+_LANEMIX_MAX_W = 65536
+
+
+def _padded_elems(shape) -> float:
+    """Tile-padded element count; single source of truth in
+    :func:`tnc_tpu.ops.budget.padded_elems` (minor dim pads to 128; XLA
+    shrinks sublane tiles for small second-minor dims, so those don't)."""
+    from tnc_tpu.ops.budget import padded_elems
+
+    return float(padded_elems(tuple(shape)))
+
+
+def _naive_prep_bad(view, perm) -> bool:
+    """True when executing ``reshape(view); transpose(perm)`` would
+    materialize a buffer padded more than ``_STAGED_PAD_FACTOR``× its
+    logical size (the BENCH_r02/r03 OOM mode: high-rank views with tiny
+    trailing dims tile-pad 16-128×)."""
+    if perm is None:
+        return False
+    size = math.prod(view)
+    if size < _STAGED_MIN_SIZE:
+        return False
+    out_view = [view[p] for p in perm]
+    worst = max(_padded_elems(view), _padded_elems(out_view))
+    return worst > _STAGED_PAD_FACTOR * size
+
+
+def _fused_transpose(src, dst, dims, tail):
+    """Run-fused (view, axes) for a transpose of row legs ``src`` →
+    ``dst`` above an intact fused ``tail`` dim. Legs adjacent in both
+    orders collapse into one axis, keeping the materialized rank low
+    (sublane padding shrinks with fewer, larger dims)."""
+    pos = {l: i for i, l in enumerate(dst)}
+    runs: list[list[int]] = []
+    for l in src:
+        if runs and pos[l] == pos[runs[-1][-1]] + 1:
+            runs[-1].append(l)
+        else:
+            runs.append([l])
+    view = tuple(int(math.prod(dims[l] for l in r)) for r in runs) + (tail,)
+    order = sorted(range(len(runs)), key=lambda i: pos[runs[i][0]])
+    axes = tuple(order) + (len(runs),)
+    return view, axes
+
+
+def _staged_ops(
+    dims: list[int], perm: list[int], min_minor: int = _MIN_MINOR
+) -> tuple | None:
+    """Decompose an axis permutation into materialization-safe device ops.
+
+    ``dims``: stored axis dims (leg granularity); ``perm``: target order.
+    Returns a tuple of primitive ops — ``("reshape", shape)``,
+    ``("transpose", axes)``, ``("lanemix", W, idx)`` — whose execution
+    turns a flat buffer in ``dims`` order into ``perm`` order while every
+    materialized intermediate keeps a minor dim ≥ ``min_minor`` (so XLA's
+    (8, 128) tiling never lane-pads it). ``None`` ⇒ not plannable (use
+    the naive reshape/transpose).
+
+    Construction: legs that stay out of the trailing ≥128-element window
+    move with cheap leading-dim transposes (the fused tail rides along
+    untouched); legs crossing into or out of that window are repositioned
+    by ONE static permutation of the lane window (``lanemix``) — executed
+    as an exact one-hot matmul on the MXU or a gather, never as a padded
+    high-rank relayout.
+    """
+    n = len(dims)
+    total = int(math.prod(dims))
+    if tuple(perm) == tuple(range(n)):
+        return ()
+    if total < min_minor * 2:
+        return None
+
+    # minimal target suffix with prod >= min_minor: the final fused tail
+    tprod, t_i = 1, n
+    while t_i > 0 and tprod < min_minor:
+        t_i -= 1
+        tprod *= dims[perm[t_i]]
+    tset = set(perm[t_i:])
+    rows_final = list(perm[:t_i])
+
+    # minimal stored suffix with prod >= min_minor: the base lane window
+    bprod, b_i = 1, n
+    while b_i > 0 and bprod < min_minor:
+        b_i -= 1
+        bprod *= dims[b_i]
+    bset = set(range(b_i, n))
+
+    rows_stored = list(range(b_i))
+    cross_in = [l for l in rows_stored if l in tset]  # must enter the tail
+    cross_out = [l for l in rows_final if l in bset]  # must leave the tail
+    W = int(math.prod(dims[l] for l in cross_in)) * bprod
+
+    ops: list[tuple] = []
+    nonwin_rows = [l for l in rows_final if l not in bset and l not in tset]
+
+    # phase A: leading transpose bringing tail-bound legs next to the
+    # window; the fused base tail (>=128) rides along as the minor dim
+    rows_a = nonwin_rows + cross_in
+    if rows_a != rows_stored:
+        view, axes = _fused_transpose(rows_stored, rows_a, dims, bprod)
+        ops.append(("reshape", view))
+        if axes != tuple(range(len(view))):
+            ops.append(("transpose", axes))
+
+    # phase B: one static lane permutation over the window
+    window_cur = cross_in + list(range(b_i, n))
+    window_new = cross_out + list(perm[t_i:])
+    if window_new != window_cur:
+
+        def lane_table(cur, new):
+            """Index table mapping new mixed-radix positions to old."""
+            pos_cur = {l: i for i, l in enumerate(cur)}
+            strides = [1] * len(cur)
+            for i in range(len(cur) - 2, -1, -1):
+                strides[i] = strides[i + 1] * dims[cur[i + 1]]
+            new_strides = [1] * len(new)
+            for i in range(len(new) - 2, -1, -1):
+                new_strides[i] = new_strides[i + 1] * dims[new[i + 1]]
+            width = int(math.prod(dims[l] for l in new))
+            table = []
+            for j in range(width):
+                old = 0
+                for l, s in zip(new, new_strides):
+                    old += ((j // s) % dims[l]) * strides[pos_cur[l]]
+                table.append(old)
+            return table
+
+        # NOTE a fixed ≥128 trailing block can't be factored out here:
+        # both windows are *minimal* ≥128 suffixes, so a shared trailing
+        # block that large would make them identical and phase B would
+        # have been skipped (review r3) — the full-width table is the
+        # only shape the permutation takes. Wide windows execute as a
+        # gather (see ``_lanemix_jax``), so only the host-side table
+        # size bounds W.
+        if W > _LANEMIX_MAX_W:
+            return None
+        ops.append(("reshape", (total // W, W)))
+        ops.append(("lanemix", W, tuple(lane_table(window_cur, window_new))))
+
+    # phase C: split the window's outbound legs and finish the row order
+    rows_b = nonwin_rows + cross_out
+    view, axes = _fused_transpose(rows_b, rows_final, dims, tprod)
+    ops.append(("reshape", view))
+    if axes != tuple(range(len(view))):
+        ops.append(("transpose", axes))
+    return tuple(ops)
 
 
 @dataclass(frozen=True)
@@ -73,6 +223,12 @@ class PairStep:
     b_cfirst: bool
     swap: bool  # issue dot as (b, a): output legs = b_free ++ a_free
     out_store: tuple[int, ...]  # storage shape of the result buffer
+    # staged device prep (see `_staged_ops`): when set, device executors
+    # run these ops instead of the naive reshape/transpose, keeping every
+    # materialized buffer's minor dim >= 128 (no lane tile padding). The
+    # host oracle still uses the equivalent (view, perm) pair.
+    a_ops: tuple | None = None
+    b_ops: tuple | None = None
 
     @property
     def a_mat(self) -> tuple[int, int]:
@@ -214,6 +370,37 @@ def _fused_view(
     return view, perm, dot_shape, contract_first, free
 
 
+def _staged_pack(edges, contract_order, shared):
+    """Leg-granularity replacement pack for an operand whose naive prep
+    would tile-pad catastrophically. Target flat order: the agreed
+    k-order, then free legs in stored order. Returns
+    ``(view, perm, dot, cfirst, free, ops)`` — the (view, perm) pair is
+    the host oracle's equivalent naive prep — or ``None`` when the
+    permutation isn't stageable (fall back to naive)."""
+    stored = [leg for leg, _ in edges]
+    dims = [d for _, d in edges]
+    spos = {l: i for i, l in enumerate(stored)}
+    free_legs = [l for l in stored if l not in shared]
+    k = int(math.prod(dims[spos[l]] for l in contract_order))
+    f = int(math.prod(dims[spos[l]] for l in free_legs))
+    # orientation by materialized minor: a (k, tiny-f) operand would
+    # lane-pad every add/dot buffer 32x (catastrophic under vmap, where
+    # XLA can't always fuse it away) — put the bigger side trailing
+    cfirst = f >= _MIN_MINOR or f >= k
+    if cfirst:
+        target = list(contract_order) + free_legs
+        dot = (k, max(f, 1))
+    else:
+        target = free_legs + list(contract_order)
+        dot = (max(f, 1), k)
+    perm = [spos[l] for l in target]
+    ops = _staged_ops(dims, perm)
+    if ops is None:
+        return None
+    free = [(free_legs, f)] if free_legs else []
+    return (tuple(dims), tuple(perm), dot, cfirst, free, ops)
+
+
 _INF_DEATH = 1 << 60
 
 
@@ -278,12 +465,25 @@ def _pair_step(
     order_b = [leg for leg, _ in b_edges if leg in shared]
     cand_a = build(order_a)
     if order_a == order_b:
-        best = cand_a
+        best, korder = cand_a, order_a
     else:
         cand_b = build(order_b)
-        best = cand_a if cand_a[2] <= cand_b[2] else cand_b
+        best, korder = (
+            (cand_a, order_a) if cand_a[2] <= cand_b[2] else (cand_b, order_b)
+        )
     (a_view, a_perm, a_dot, a_cfirst, a_free) = best[0]
     (b_view, b_perm, b_dot, b_cfirst, b_free) = best[1]
+    # operands whose naive prep would tile-pad catastrophically switch to
+    # the staged plan (leg granularity, minor >= 128 at every step)
+    a_ops = b_ops = None
+    if _naive_prep_bad(a_view, a_perm):
+        staged = _staged_pack(a_edges, korder, shared)
+        if staged is not None:
+            (a_view, a_perm, a_dot, a_cfirst, a_free, a_ops) = staged
+    if _naive_prep_bad(b_view, b_perm):
+        staged = _staged_pack(b_edges, korder, shared)
+        if staged is not None:
+            (b_view, b_perm, b_dot, b_cfirst, b_free, b_ops) = staged
     a_k = a_dot[0] if a_cfirst else a_dot[-1]
     b_k = b_dot[0] if b_cfirst else b_dot[-1]
     assert a_k == b_k, "contract dims must agree"
@@ -331,6 +531,8 @@ def _pair_step(
         b_cfirst=b_cfirst,
         swap=swap,
         out_store=out_store,
+        a_ops=a_ops,
+        b_ops=b_ops,
     )
     return step, LeafTensor(out_legs, out_dims)
 

@@ -62,10 +62,18 @@ def apply_step_split(xp, apair, bpair, step, precision=None):
     single source of truth shared by every split-mode executor."""
     from tnc_tpu.ops.backends import _prep_operand
 
-    ar = _prep_operand(xp, apair[0], step.a_view, step.a_perm, step.a_dot)
-    ai = _prep_operand(xp, apair[1], step.a_view, step.a_perm, step.a_dot)
-    br = _prep_operand(xp, bpair[0], step.b_view, step.b_perm, step.b_dot)
-    bi = _prep_operand(xp, bpair[1], step.b_view, step.b_perm, step.b_dot)
+    ar = _prep_operand(
+        xp, apair[0], step.a_view, step.a_perm, step.a_dot, step.a_ops
+    )
+    ai = _prep_operand(
+        xp, apair[1], step.a_view, step.a_perm, step.a_dot, step.a_ops
+    )
+    br = _prep_operand(
+        xp, bpair[0], step.b_view, step.b_perm, step.b_dot, step.b_ops
+    )
+    bi = _prep_operand(
+        xp, bpair[1], step.b_view, step.b_perm, step.b_dot, step.b_ops
+    )
     if xp is np:
 
         def as_km(part, mat, cfirst):
