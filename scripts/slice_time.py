@@ -68,11 +68,7 @@ def main():
         for ch in chunks:
             def one(ins, _ch=ch):
                 state = dict(zip(_ch.in_slots, ins))
-                for st in _ch.steps:
-                    state[st.lhs] = apply_step_split(
-                        jnp, state[st.lhs], state[st.rhs], st, precision
-                    )
-                    del state[st.rhs]
+                chunked._run_chunk_split(jnp, _ch, state, precision)
                 return tuple(state[s] for s in _ch.out_slots)
             fns.append(jax.jit(one))
         state = dict(enumerate(buffers))

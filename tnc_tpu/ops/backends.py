@@ -256,11 +256,18 @@ class NumpyBackend(Backend):
     def execute_sliced(
         self, sp, arrays: Sequence[Any], max_slices: int | None = None, host: bool = True
     ) -> np.ndarray:
+        """``host=False`` mirrors the device backends' contract as far
+        as it applies here (data is already host-resident): the result
+        comes back in **stored** (merged) shape instead of
+        ``result_shape``."""
         from tnc_tpu.ops.sliced import execute_sliced_numpy
 
-        return execute_sliced_numpy(
+        out = execute_sliced_numpy(
             sp, arrays, dtype=self.dtype, max_slices=max_slices
         )
+        if not host:
+            return out.reshape(sp.program.stored_result_shape)
+        return out
 
 
 class JaxBackend(Backend):

@@ -314,32 +314,13 @@ def execute_sliced_batched_jax(
     else:
         acc = jnp.zeros(stored_shape, dtype=dtype)
 
-    import os as _os
-    import time as _time
-
-    _dbg = _os.environ.get("TNC_TPU_DEBUG_TIMING") == "1"
     for start in range(0, num, batch):
         idx = jnp.asarray(all_indices[start : start + batch])
-        _t0 = _time.monotonic()
         sliced = gather(device_full, idx)
-        if _dbg:
-            import jax as _jax
-
-            _jax.block_until_ready(sliced)
-            print(f"[chunked] gather {(_time.monotonic()-_t0)*1e3:.1f}ms", flush=True)
         state = dict(enumerate(sliced))
-        for ci, (chunk, fn) in enumerate(zip(chunks, chunk_fns)):
+        for chunk, fn in zip(chunks, chunk_fns):
             ins = tuple(state[s] for s in chunk.in_slots)
-            _t0 = _time.monotonic()
             outs = fn(ins)
-            if _dbg:
-                import jax as _jax
-
-                _jax.block_until_ready(outs)
-                print(
-                    f"[chunked] chunk{ci} {(_time.monotonic()-_t0)*1e3:.1f}ms",
-                    flush=True,
-                )
             for slot, buf in zip(chunk.out_slots, outs):
                 state[slot] = buf
             for step in chunk.steps:
