@@ -77,6 +77,7 @@ def main() -> int:
         print(f"coverage gate: tests failed (rc={rc})", file=sys.stderr)
         return int(rc)
 
+    missing_filter = os.environ.get("COVERAGE_MISSING")
     per_file: list[tuple[str, int, int]] = []
     total_exec = 0
     total_hit = 0
@@ -93,6 +94,11 @@ def main() -> int:
             per_file.append((os.path.relpath(path, REPO), covered, len(lines)))
             total_exec += len(lines)
             total_hit += covered
+            if missing_filter and missing_filter in path:
+                print(
+                    f"\nmissing in {os.path.relpath(path, REPO)}: "
+                    f"{sorted(lines - hit)}"
+                )
 
     pct = 100.0 * total_hit / total_exec if total_exec else 100.0
     print(f"\ncoverage: {total_hit}/{total_exec} lines = {pct:.1f}% "

@@ -338,3 +338,51 @@ def test_jax_backend_chunked_strategy():
     a = complex(np.asarray(loop.execute_sliced(sp, arrays)).reshape(-1)[0])
     b = complex(np.asarray(chunked.execute_sliced(sp, arrays)).reshape(-1)[0])
     assert a == pytest.approx(b, rel=1e-4, abs=1e-7)
+
+
+def test_execute_sliced_host_false_device_resident():
+    """host=False (the benchmark-timing contract: no device→host
+    transfer inside timed regions) returns the device accumulator in
+    stored shape for every backend/strategy, equal to the host result."""
+    import jax
+
+    from tnc_tpu.ops.backends import JaxBackend, NumpyBackend
+    from tnc_tpu.contractionpath.slicing import find_slicing
+    from tnc_tpu.ops.program import flat_leaf_tensors
+    from tnc_tpu.ops.sliced import build_sliced_program
+
+    tn = _sycamore_network(qubits=12, depth=6, seed=2)
+    res = Greedy(OptMethod.GREEDY).find_path(tn)
+    rp = res.replace_path()
+    slicing = find_slicing(list(tn.tensors), rp.toplevel, max(64.0, res.size / 8))
+    sp = build_sliced_program(tn, rp, slicing)
+    arrays = [leaf.data.into_data() for leaf in flat_leaf_tensors(tn)]
+
+    stored = sp.program.stored_result_shape
+    want = complex(
+        np.asarray(NumpyBackend().execute_sliced(sp, arrays)).reshape(-1)[0]
+    )
+
+    out_np = NumpyBackend().execute_sliced(sp, arrays, host=False)
+    assert out_np.shape == tuple(stored)
+
+    for strategy in ("chunked", "loop"):
+        for split in (False, True):
+            backend = JaxBackend(
+                dtype="complex64",
+                split_complex=split,
+                sliced_strategy=strategy,
+                slice_batch=1,
+                chunk_steps=8,
+            )
+            dev = backend.execute_sliced(sp, arrays, host=False)
+            if split:
+                assert isinstance(dev, tuple) and len(dev) == 2
+                got = np.asarray(dev[0]) + 1j * np.asarray(dev[1])
+            else:
+                assert isinstance(dev, jax.Array)
+                got = np.asarray(dev)
+            assert got.shape == tuple(stored), (strategy, split)
+            assert complex(got.reshape(-1)[0]) == pytest.approx(
+                want, rel=1e-4, abs=1e-7
+            ), (strategy, split)
