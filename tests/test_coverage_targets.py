@@ -100,11 +100,21 @@ def test_genetic_pool_fitness_path(monkeypatch):
     # the point of this test is the POOL path: fail loudly if it silently
     # degrades to inline evaluation (pool creation returning None)
     made = []
+    mapped = []
     orig_make = genetic_mod._make_fitness_pool
 
     def spying_make(*args, **kwargs):
         pool = orig_make(*args, **kwargs)
         made.append(pool)
+        if pool is not None:
+            orig_map = pool.map_async
+
+            def spying_map(*a, **k):
+                res = orig_map(*a, **k)
+                mapped.append(res)
+                return res
+
+            pool.map_async = spying_map
         return pool
 
     monkeypatch.setattr(genetic_mod, "_make_fitness_pool", spying_make)
@@ -120,3 +130,8 @@ def test_genetic_pool_fitness_path(monkeypatch):
     assert len(best) == len(tn)
     assert best_score <= score0
     assert made and made[0] is not None, "spawn pool was not created"
+    # every generation scored through the pool: map_async was used and
+    # each call delivered (an exception would have nulled the pool and
+    # silently fallen back to inline evaluation)
+    assert mapped, "pool.map_async never ran"
+    assert all(r.successful() for r in mapped)

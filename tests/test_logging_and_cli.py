@@ -1,0 +1,90 @@
+"""Logging configuration and benchmark CLI entry coverage.
+
+The reference wires structured logging through every pipeline stage and
+drives benchmarks via a CLI binary (``benchmark/src/main.rs``); these
+tests pin the analogous knobs: ``TNC_TPU_LOG`` handler attachment (once,
+idempotent), ``TNC_TPU_PLATFORM`` pinning, and the ``python -m
+tnc_tpu.benchmark`` entry resolving to ``cli.main`` in-process.
+"""
+
+import logging
+
+import pytest
+
+
+def test_configure_from_env_attaches_once(monkeypatch):
+    from tnc_tpu.utils import logging_config
+
+    root = logging.getLogger("tnc_tpu")
+    before = [h for h in root.handlers if getattr(h, "_tnc_tpu_env", False)]
+    for h in before:
+        root.removeHandler(h)
+    try:
+        monkeypatch.setenv("TNC_TPU_LOG", "debug")
+        logging_config.configure_from_env()
+        logging_config.configure_from_env()  # idempotent: no duplicates
+        envh = [h for h in root.handlers if getattr(h, "_tnc_tpu_env", False)]
+        assert len(envh) == 1
+        assert root.level == logging.DEBUG
+    finally:
+        for h in root.handlers[:]:
+            if getattr(h, "_tnc_tpu_env", False):
+                root.removeHandler(h)
+        for h in before:
+            root.addHandler(h)
+
+
+def test_configure_from_env_rejects_bad_level(monkeypatch):
+    from tnc_tpu.utils import logging_config
+
+    root = logging.getLogger("tnc_tpu")
+    monkeypatch.setenv("TNC_TPU_LOG", "not-a-level")
+    logging_config.configure_from_env()
+    assert not [h for h in root.handlers if getattr(h, "_tnc_tpu_env", False)]
+
+
+def test_pin_platform_noop_without_env(monkeypatch):
+    from tnc_tpu.utils import logging_config
+
+    monkeypatch.delenv("TNC_TPU_PLATFORM", raising=False)
+    logging_config.pin_platform_from_env()  # must not raise or touch jax
+
+
+def test_pin_platform_warns_when_backend_up(monkeypatch, caplog):
+    """With a backend already initialized, jax.config.update raises and
+    the pin degrades to a warning (documented behavior)."""
+    from tnc_tpu.utils import logging_config
+
+    monkeypatch.setenv("TNC_TPU_PLATFORM", "cpu")
+    import jax
+
+    jax.devices()  # ensure a backend exists (conftest pinned cpu)
+
+    def boom(*a, **k):
+        raise RuntimeError("backend already initialized")
+
+    monkeypatch.setattr(jax.config, "update", boom)
+    with caplog.at_level(logging.WARNING, logger="tnc_tpu"):
+        logging_config.pin_platform_from_env()
+    assert any("could not pin platform" in r.message for r in caplog.records)
+
+
+def test_benchmark_module_entry_is_cli_main():
+    """``python -m tnc_tpu.benchmark`` dispatches to ``cli.main`` — run
+    the module body in-process (runpy) with --help so the subprocess-only
+    0%-coverage file actually executes."""
+    import runpy
+    import sys
+    from unittest import mock
+
+    with mock.patch.object(sys, "argv", ["tnc_tpu.benchmark", "--help"]):
+        with pytest.raises(SystemExit) as exc:
+            runpy.run_module("tnc_tpu.benchmark", run_name="__main__")
+    assert exc.value.code in (0, None)
+
+
+def test_cli_main_rejects_unknown_command(capsys):
+    from tnc_tpu.benchmark.cli import main
+
+    with pytest.raises(SystemExit):
+        main(["definitely-not-a-command"])
