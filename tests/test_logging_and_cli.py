@@ -38,9 +38,18 @@ def test_configure_from_env_rejects_bad_level(monkeypatch):
     from tnc_tpu.utils import logging_config
 
     root = logging.getLogger("tnc_tpu")
-    monkeypatch.setenv("TNC_TPU_LOG", "not-a-level")
-    logging_config.configure_from_env()
-    assert not [h for h in root.handlers if getattr(h, "_tnc_tpu_env", False)]
+    before = [h for h in root.handlers if getattr(h, "_tnc_tpu_env", False)]
+    for h in before:  # a TNC_TPU_LOG set at package import would linger
+        root.removeHandler(h)
+    try:
+        monkeypatch.setenv("TNC_TPU_LOG", "not-a-level")
+        logging_config.configure_from_env()
+        assert not [
+            h for h in root.handlers if getattr(h, "_tnc_tpu_env", False)
+        ]
+    finally:
+        for h in before:
+            root.addHandler(h)
 
 
 def test_pin_platform_noop_without_env(monkeypatch):

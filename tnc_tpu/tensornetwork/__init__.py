@@ -4,3 +4,4 @@ from tnc_tpu.tensornetwork.tensor import (  # noqa: F401
     Tensor,
 )
 from tnc_tpu.tensornetwork.tensordata import TensorData  # noqa: F401
+from tnc_tpu.tensornetwork.sweep import amplitude_sweep  # noqa: F401
