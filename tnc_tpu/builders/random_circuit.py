@@ -39,21 +39,17 @@ def _filtered_connectivity(
     return [(u, v) for (u, v) in graph.connectivity if u < qubits and v < qubits]
 
 
-def random_circuit(
+def random_open_circuit(
     qubits: int,
     rounds: int,
     single_qubit_probability: float,
     two_qubit_probability: float,
     rng: np.random.Generator,
     connectivity: ConnectivityLayout,
-    bitstring: str | None = None,
-) -> CompositeTensor:
-    """Random circuit closed as an amplitude network.
-
-    ``bitstring`` defaults to |0…0⟩ (the reference's behavior,
-    ``random_circuit.rs:29-80``); pass ``"*" * qubits`` for an open
-    statevector network.
-    """
+) -> Circuit:
+    """The unfinalized random circuit (gates only, no bras) — feed it to
+    any finalizer, or to :func:`tnc_tpu.tensornetwork.amplitude_sweep`
+    for batched bitstring evaluation."""
     connectivity_pairs = _filtered_connectivity(connectivity, qubits)
 
     circuit = Circuit()
@@ -69,7 +65,32 @@ def random_circuit(
                 circuit.append_gate(
                     TensorData.gate("fsim", _FSIM_ANGLES), [qr.qubit(i), qr.qubit(j)]
                 )
+    return circuit
 
+
+def random_circuit(
+    qubits: int,
+    rounds: int,
+    single_qubit_probability: float,
+    two_qubit_probability: float,
+    rng: np.random.Generator,
+    connectivity: ConnectivityLayout,
+    bitstring: str | None = None,
+) -> CompositeTensor:
+    """Random circuit closed as an amplitude network.
+
+    ``bitstring`` defaults to |0…0⟩ (the reference's behavior,
+    ``random_circuit.rs:29-80``); pass ``"*" * qubits`` for an open
+    statevector network.
+    """
+    circuit = random_open_circuit(
+        qubits,
+        rounds,
+        single_qubit_probability,
+        two_qubit_probability,
+        rng,
+        connectivity,
+    )
     if bitstring is None:
         bitstring = "0" * qubits
     return circuit.into_amplitude_network(bitstring)[0]

@@ -161,17 +161,29 @@ def jit_program(
     split_complex: bool,
     precision: str | None = None,
     donate: bool = True,
+    batched: frozenset[int] | None = None,
 ):
     """Program → jitted ``fn(buffers)`` with donated inputs; one traced
     function per (program, mode), one XLA executable per input placement.
     Shared by :class:`JaxBackend` and the distributed executors.
     LRU-bounded so long sweeps over many distinct networks don't pin
-    every executable for the process lifetime."""
+    every executable for the process lifetime.
+
+    ``batched``: slots whose buffers carry a leading batch axis — the
+    whole path is ``jax.vmap``-ed over them (amplitude sweeps,
+    :meth:`JaxBackend.execute_batched`)."""
     import jax
 
     if not split_complex:
         precision = None  # only the split path consumes it: one cache key
-    key = (program.signature(), split_complex, precision, donate, lanemix_env())
+    key = (
+        program.signature(),
+        split_complex,
+        precision,
+        donate,
+        lanemix_env(),
+        batched,
+    )
     fn = _PROGRAM_JIT_CACHE.get(key)
     if fn is not None:
         _PROGRAM_JIT_CACHE.move_to_end(key)
@@ -194,6 +206,13 @@ def jit_program(
             def run(buffers):
                 return _run_steps(jnp, program, list(buffers))
 
+        if batched is not None:
+            in_axis = (0, 0) if split_complex else 0
+            axes = [
+                in_axis if slot in batched else None
+                for slot in range(program.num_inputs)
+            ]
+            run = jax.vmap(run, in_axes=(axes,))
         jitted = jax.jit(run, donate_argnums=(0,) if donate else ())
 
         def fn(buffers, _jitted=jitted):
@@ -412,39 +431,14 @@ class JaxBackend(Backend):
         dispatch, the TPU-native shape for amplitude sweeps
         (:mod:`tnc_tpu.tensornetwork.sweep`). Returns ``(B,) +
         result_shape``."""
-        import jax
-        import jax.numpy as jnp
-
-        batched_set = frozenset(batched)
         precision = self.precision if self.split_complex else None
-        key = (
-            "batched",
-            program.signature(),
-            batched_set,
+        fn = jit_program(
+            program,
             self.split_complex,
             precision,
-            lanemix_env(),
+            self.donate,
+            batched=frozenset(batched),
         )
-        fn = self._cache.get(key)
-        if fn is None:
-            if self.split_complex:
-                from tnc_tpu.ops.split_complex import run_steps_split
-
-                def run(buffers):
-                    return run_steps_split(jnp, program, list(buffers), precision)
-
-            else:
-
-                def run(buffers):
-                    return _run_steps(jnp, program, list(buffers))
-
-            in_axis = (0, 0) if self.split_complex else 0
-            axes = [
-                in_axis if slot in batched_set else None
-                for slot in range(program.num_inputs)
-            ]
-            fn = jax.jit(jax.vmap(run, in_axes=(axes,)))
-            self._cache[key] = fn
         buffers = self._device_buffers(arrays)
         result = fn(buffers)
         if self.split_complex:
