@@ -36,9 +36,8 @@ def device():
     return dev
 
 
-def _ghz_network(n=16):
+def _ghz_circuit(n):
     from tnc_tpu.builders.circuit_builder import Circuit
-    from tnc_tpu.contractionpath.paths import Greedy, OptMethod
     from tnc_tpu.tensornetwork.tensordata import TensorData
 
     c = Circuit()
@@ -46,7 +45,13 @@ def _ghz_network(n=16):
     c.append_gate(TensorData.gate("h"), [reg.qubit(0)])
     for i in range(n - 1):
         c.append_gate(TensorData.gate("cx"), [reg.qubit(i), reg.qubit(i + 1)])
-    tn, _ = c.into_amplitude_network("1" * n)
+    return c
+
+
+def _ghz_network(n=16):
+    from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+
+    tn, _ = _ghz_circuit(n).into_amplitude_network("1" * n)
     result = Greedy(OptMethod.GREEDY).find_path(tn)
     return tn, result
 
@@ -231,18 +236,11 @@ def test_amplitude_sweep_on_device(device):
     analytic values."""
     import math
 
-    from tnc_tpu.builders.circuit_builder import Circuit
     from tnc_tpu.tensornetwork.sweep import amplitude_sweep
-    from tnc_tpu.tensornetwork.tensordata import TensorData
 
     n = 12
-    circ = Circuit()
-    reg = circ.allocate_register(n)
-    circ.append_gate(TensorData.gate("h"), [reg.qubit(0)])
-    for i in range(n - 1):
-        circ.append_gate(TensorData.gate("cx"), [reg.qubit(i), reg.qubit(i + 1)])
     bits = ["0" * n, "1" * n, "01" * (n // 2)]
-    amps = amplitude_sweep(circ, bits)
+    amps = amplitude_sweep(_ghz_circuit(n), bits)
     r = 1 / math.sqrt(2)
     assert abs(amps[0] - r) <= 1e-5 and abs(amps[1] - r) <= 1e-5
     assert abs(amps[2]) <= 1e-6
