@@ -32,6 +32,7 @@ Env knobs:
   BENCH_TARGET_LOG2_PEAK (29), BENCH_NTRIALS (128),
   BENCH_CPU_SLICES (2), BENCH_REPS (3), BENCH_PEAK_FLOPS (per device),
   BENCH_EXEC chunked|loop, BENCH_BATCH (8), BENCH_PROBE_SLICES (64),
+  BENCH_LOOP_UNROLL (1; loop strategy only — unrolled-scan slice loop),
   BENCH_FULL_SECONDS (900; run all slices if projected under this),
   BENCH_TRACE 0|1 (profiler trace; default on-accelerator only),
   BENCH_PRECISION float32 (full-f32 dots) | default (bf16 3-pass, faster),
@@ -220,6 +221,7 @@ def bench_sycamore_amplitude():
         slice_batch=_env_int("BENCH_BATCH", 8),
         chunk_steps=_env_int("BENCH_CHUNK_STEPS", 48),
         precision=os.environ.get("BENCH_PRECISION", "float32"),
+        loop_unroll=_env_int("BENCH_LOOP_UNROLL", 1),
     )
     log(f"[bench] executor: {strategy}")
     extra = {}

@@ -340,6 +340,45 @@ def test_jax_backend_chunked_strategy():
     assert a == pytest.approx(b, rel=1e-4, abs=1e-7)
 
 
+def test_loop_unroll_scan_matches_oracle():
+    """The unrolled-scan slice loop (loop_unroll > 1) must match the
+    oracle for unroll factors that divide the slice count and ones that
+    leave a masked remainder group."""
+    from tnc_tpu.contractionpath.slicing import find_slicing
+    from tnc_tpu.ops.backends import JaxBackend, NumpyBackend
+    from tnc_tpu.ops.program import flat_leaf_tensors
+    from tnc_tpu.ops.sliced import build_sliced_program
+
+    tn = _sycamore_network(qubits=12, depth=6, seed=3)
+    res = Greedy(OptMethod.GREEDY).find_path(tn)
+    rp = res.replace_path()
+    slicing = find_slicing(
+        list(tn.tensors), rp.toplevel, max(64.0, res.size / 32)
+    )
+    # 4+ slices: unroll=3 leaves a masked remainder group, unroll=4 divides
+    assert slicing.num_slices >= 4
+    sp = build_sliced_program(tn, rp, slicing)
+    arrays = [leaf.data.into_data() for leaf in flat_leaf_tensors(tn)]
+    want = complex(
+        np.asarray(NumpyBackend().execute_sliced(sp, arrays)).reshape(-1)[0]
+    )
+    for unroll in (3, 4):  # 3 leaves a remainder group for pow-2 counts
+        for split in (False, True):
+            b = JaxBackend(
+                dtype="complex64",
+                split_complex=split,
+                sliced_strategy="loop",
+                loop_unroll=unroll,
+            )
+            got = complex(
+                np.asarray(b.execute_sliced(sp, arrays)).reshape(-1)[0]
+            )
+            assert got == pytest.approx(want, rel=1e-4, abs=1e-7), (
+                unroll,
+                split,
+            )
+
+
 def test_execute_sliced_host_false_device_resident():
     """host=False (the benchmark-timing contract: no device→host
     transfer inside timed regions) returns the device accumulator in

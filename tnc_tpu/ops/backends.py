@@ -311,6 +311,7 @@ class JaxBackend(Backend):
         sliced_strategy: str = "chunked",
         slice_batch: int = 8,
         chunk_steps: int = 64,
+        loop_unroll: int = 1,
     ):
         """``sliced_strategy``: 'chunked' (default) splits the program
         into slice-batched chunks (K small compiles, batched matmuls,
@@ -337,6 +338,7 @@ class JaxBackend(Backend):
         self.sliced_strategy = sliced_strategy
         self.slice_batch = slice_batch
         self.chunk_steps = chunk_steps
+        self.loop_unroll = loop_unroll
         self._cache: dict[tuple, Any] = {}
 
     def _compiled(self, program: ContractionProgram):
@@ -397,6 +399,7 @@ class JaxBackend(Backend):
             str(self.dtype),
             self.split_complex,
             max_slices,
+            self.loop_unroll,
             lanemix_env(),
         )
         fn = self._cache.get(key)
@@ -406,6 +409,7 @@ class JaxBackend(Backend):
                 split_complex=self.split_complex,
                 precision=self.precision,
                 num_slices=max_slices,
+                unroll=self.loop_unroll,
             )
             self._cache[key] = fn
         buffers = self._device_buffers(arrays)

@@ -143,11 +143,20 @@ def make_jax_sliced_fn(
     split_complex: bool = False,
     precision: str | None = None,
     num_slices: int | None = None,
+    unroll: int = 1,
 ):
     """Build a jittable ``fn(full_buffers) -> result`` running the whole
     slice loop on device. In split mode, buffers and result are
     (real, imag) pairs of float arrays. ``num_slices`` caps the loop
-    (partial sum over the first slices — benchmark subset mode)."""
+    (partial sum over the first slices — benchmark subset mode).
+
+    ``unroll > 1`` switches ``fori_loop`` for ``lax.scan(..., unroll=)``:
+    XLA pessimizes while-loop bodies (~150× on the v5e north-star,
+    TPU_EVIDENCE_r03.md), and an unrolled scan presents straight-line
+    step groups instead — zero host dispatches per slice, chunked-class
+    code inside the loop (scan handles any ``num % unroll`` remainder
+    natively). Compile time grows with the unroll factor.
+    """
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -156,6 +165,7 @@ def make_jax_sliced_fn(
     num = sp.slicing.num_slices
     if num_slices is not None:
         num = max(1, min(num, num_slices))
+    unroll = max(1, min(unroll, num))
 
     def decompose(s):
         idx = []
@@ -168,40 +178,61 @@ def make_jax_sliced_fn(
     if split_complex:
         from tnc_tpu.ops.split_complex import run_steps_split
 
-        def fn(full_buffers):
-            def body(s, acc):
-                indices = decompose(s)
-                buffers = [
-                    (
-                        index_buffer(jnp, re, info, indices),
-                        index_buffer(jnp, im, info, indices),
-                    )
-                    for (re, im), info in zip(full_buffers, sp.slot_slices)
-                ]
-                re, im = run_steps_split(jnp, sp.program, buffers, precision)
-                return acc[0] + re, acc[1] + im
+        def one_slice(full_buffers, s):
+            indices = decompose(s)
+            buffers = [
+                (
+                    index_buffer(jnp, re, info, indices),
+                    index_buffer(jnp, im, info, indices),
+                )
+                for (re, im), info in zip(full_buffers, sp.slot_slices)
+            ]
+            return run_steps_split(jnp, sp.program, buffers, precision)
 
+        def add(acc, contrib):
+            return (acc[0] + contrib[0], acc[1] + contrib[1])
+
+        def zeros(full_buffers):
             dtype = full_buffers[0][0].dtype
-            acc0 = (
+            return (
                 jnp.zeros(sp.program.stored_result_shape, dtype=dtype),
                 jnp.zeros(sp.program.stored_result_shape, dtype=dtype),
             )
-            return lax.fori_loop(0, num, body, acc0)
+
+    else:
+
+        def one_slice(full_buffers, s):
+            buffers = [
+                index_buffer(jnp, arr, info, decompose(s))
+                for arr, info in zip(full_buffers, sp.slot_slices)
+            ]
+            return _run_steps(jnp, sp.program, list(buffers))
+
+        def add(acc, contrib):
+            return acc + contrib
+
+        def zeros(full_buffers):
+            return jnp.zeros(
+                sp.program.stored_result_shape, dtype=full_buffers[0].dtype
+            )
+
+    if unroll <= 1:
+
+        def fn(full_buffers):
+            def body(s, acc):
+                return add(acc, one_slice(full_buffers, s))
+
+            return lax.fori_loop(0, num, body, zeros(full_buffers))
 
     else:
 
         def fn(full_buffers):
-            def body(s, acc):
-                indices = decompose(s)
-                buffers = [
-                    index_buffer(jnp, arr, info, indices)
-                    for arr, info in zip(full_buffers, sp.slot_slices)
-                ]
-                return acc + _run_steps(jnp, sp.program, list(buffers))
+            def body(acc, s):
+                return add(acc, one_slice(full_buffers, s)), None
 
-            acc0 = jnp.zeros(
-                sp.program.stored_result_shape, dtype=full_buffers[0].dtype
+            acc, _ = lax.scan(
+                body, zeros(full_buffers), jnp.arange(num), unroll=unroll
             )
-            return lax.fori_loop(0, num, body, acc0)
+            return acc
 
     return jax.jit(fn)
