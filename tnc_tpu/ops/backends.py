@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 import warnings
 from collections import OrderedDict
 from typing import Any, Sequence
@@ -142,8 +143,11 @@ def _run_steps(xp, program: ContractionProgram, buffers: list[Any]) -> Any:
     return buffers[program.result_slot]
 
 
+# Locked: the distributed local phase compiles/executes per-partition
+# programs from a thread pool (parallel/partitioned.py).
 _PROGRAM_JIT_CACHE: "OrderedDict[tuple, Any]" = OrderedDict()
 _PROGRAM_JIT_CACHE_MAX = 256
+_PROGRAM_JIT_CACHE_LOCK = threading.Lock()
 
 
 def lanemix_env() -> tuple:
@@ -184,9 +188,10 @@ def jit_program(
         lanemix_env(),
         batched,
     )
-    fn = _PROGRAM_JIT_CACHE.get(key)
-    if fn is not None:
-        _PROGRAM_JIT_CACHE.move_to_end(key)
+    with _PROGRAM_JIT_CACHE_LOCK:
+        fn = _PROGRAM_JIT_CACHE.get(key)
+        if fn is not None:
+            _PROGRAM_JIT_CACHE.move_to_end(key)
     if fn is None:
         logger.debug(
             "jit: tracing program (%d steps, split_complex=%s)",
@@ -224,9 +229,10 @@ def jit_program(
                 )
                 return _jitted(buffers)
 
-        _PROGRAM_JIT_CACHE[key] = fn
-        while len(_PROGRAM_JIT_CACHE) > _PROGRAM_JIT_CACHE_MAX:
-            _PROGRAM_JIT_CACHE.popitem(last=False)
+        with _PROGRAM_JIT_CACHE_LOCK:
+            _PROGRAM_JIT_CACHE[key] = fn
+            while len(_PROGRAM_JIT_CACHE) > _PROGRAM_JIT_CACHE_MAX:
+                _PROGRAM_JIT_CACHE.popitem(last=False)
     return fn
 
 

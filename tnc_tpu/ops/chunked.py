@@ -27,6 +27,7 @@ chunk functions are cached by program signature so repeated executions
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Any, Sequence
@@ -102,9 +103,12 @@ def _run_chunk_split(
         del state[step.rhs]
 
 
-# compiled plan cache: key -> (chunks, chunk_fns, gather, reduce_batch)
+# compiled plan cache: key -> (chunks, chunk_fns, gather, reduce_batch).
+# Locked: the distributed local phase runs one chunked runner per
+# partition from a thread pool, so lookups/evictions race otherwise.
 _PLAN_CACHE: "OrderedDict[tuple, tuple]" = OrderedDict()
 _PLAN_CACHE_MAX = 64
+_PLAN_CACHE_LOCK = threading.Lock()
 
 
 def _compiled_plan(
@@ -127,10 +131,11 @@ def _compiled_plan(
         precision,
         lanemix_env(),
     )
-    hit = _PLAN_CACHE.get(key)
-    if hit is not None:
-        _PLAN_CACHE.move_to_end(key)
-        return hit
+    with _PLAN_CACHE_LOCK:
+        hit = _PLAN_CACHE.get(key)
+        if hit is not None:
+            _PLAN_CACHE.move_to_end(key)
+            return hit
 
     chunks = split_program(sp.program, chunk_steps)
 
@@ -232,9 +237,10 @@ def _compiled_plan(
     )
 
     plan = (chunks, chunk_fns, gather, reduce_batch)
-    _PLAN_CACHE[key] = plan
-    while len(_PLAN_CACHE) > _PLAN_CACHE_MAX:
-        _PLAN_CACHE.popitem(last=False)
+    with _PLAN_CACHE_LOCK:
+        _PLAN_CACHE[key] = plan
+        while len(_PLAN_CACHE) > _PLAN_CACHE_MAX:
+            _PLAN_CACHE.popitem(last=False)
     return plan
 
 
@@ -266,14 +272,62 @@ def execute_sliced_batched_jax(
     on tunneled backends the first D2H permanently degrades dispatch
     (measured 430× on the v5e axon tunnel, TPU_EVIDENCE_r03.md).
     """
-    import jax.numpy as jnp
-
-    num = sp.slicing.num_slices
-    if num <= 1:
+    if sp.slicing.num_slices <= 1:
         raise ValueError(
             "execute_sliced_batched_jax expects a sliced program; "
             "use JaxBackend.execute for unsliced networks"
         )
+    device_full = place_buffers(arrays, dtype, split_complex, device)
+    acc = run_sliced_chunked_placed(
+        sp,
+        device_full,
+        batch=batch,
+        chunk_steps=chunk_steps,
+        split_complex=split_complex,
+        precision=precision,
+        dtype=dtype,
+        device=device,
+        enforce_budget=enforce_budget,
+        max_slices=max_slices,
+    )
+    if not host:
+        return acc
+    if split_complex:
+        from tnc_tpu.ops.split_complex import combine_array
+
+        return combine_array(acc[0], acc[1]).reshape(sp.program.result_shape)
+    return np.asarray(acc).reshape(sp.program.result_shape)
+
+
+def run_sliced_chunked_placed(
+    sp: SlicedProgram,
+    device_full: Sequence[Any],
+    batch: int = 8,
+    chunk_steps: int = 64,
+    split_complex: bool = True,
+    precision: str | None = "float32",
+    dtype: str = "complex64",
+    device=None,
+    enforce_budget: bool = True,
+    max_slices: int | None = None,
+):
+    """Chunked slice-batched execution over already-placed device
+    buffers; returns the device-resident accumulator in stored shape
+    (a (real, imag) pair in split mode). The distributed local phase
+    uses this directly — each partition's buffers are committed to its
+    own device, so every dispatch follows the data (one chunked runner
+    per device, running concurrently under async dispatch)."""
+    import jax.numpy as jnp
+
+    num = sp.slicing.num_slices
+    if num <= 1:
+        # a partition untouched by global slicing arrives as a 1-slice
+        # program: run it straight (no batch axis exists to reduce over).
+        # donate=False — the caller owns and may reuse these buffers.
+        from tnc_tpu.ops.backends import jit_program
+
+        fn = jit_program(sp.program, split_complex, precision, donate=False)
+        return fn(list(device_full))
     if enforce_budget:
         from tnc_tpu.ops.budget import clamp_slice_batch
 
@@ -302,8 +356,6 @@ def execute_sliced_batched_jax(
         all_indices[:, pos] = s % dims[pos]
         s //= dims[pos]
 
-    device_full = place_buffers(arrays, dtype, split_complex, device)
-
     part_dtype = "float64" if "128" in str(dtype) else "float32"
     stored_shape = sp.program.stored_result_shape
     if split_complex:
@@ -326,11 +378,4 @@ def execute_sliced_batched_jax(
             for step in chunk.steps:
                 state.pop(step.rhs, None)
         acc = reduce_batch(acc, state[sp.program.result_slot])
-
-    if not host:
-        return acc
-    if split_complex:
-        from tnc_tpu.ops.split_complex import combine_array
-
-        return combine_array(acc[0], acc[1]).reshape(sp.program.result_shape)
-    return np.asarray(acc).reshape(sp.program.result_shape)
+    return acc
