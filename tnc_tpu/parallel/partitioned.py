@@ -266,6 +266,11 @@ def local_contract_partitions(
     thread (XLA compilation releases the GIL), serializing exactly the
     phase that should overlap. Warm runs take the sequential fast path.
     """
+    if sliced_strategy not in ("chunked", "loop"):
+        raise ValueError(
+            f"unknown sliced_strategy {sliced_strategy!r}; "
+            "expected 'chunked' or 'loop'"
+        )
     logger.debug("local phase: %d partition programs", len(comm.programs))
     from tnc_tpu.ops.chunked import run_sliced_chunked_placed
     from tnc_tpu.ops.sliced import SlicedProgram, make_jax_sliced_fn

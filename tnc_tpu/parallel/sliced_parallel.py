@@ -59,8 +59,9 @@ def _make_spmd_fn(
     import jax
     import jax.numpy as jnp
     from jax import lax
-    from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from jax import shard_map  # top-level since jax 0.8 (check_vma kwarg)
 
     n_devices = mesh.shape[axis]
     num = sp.slicing.num_slices
@@ -134,7 +135,7 @@ def _make_spmd_fn(
 
     in_specs = tuple(P() for _ in range(sp.program.num_inputs))  # replicated
     fn = shard_map(
-        device_fn, mesh=mesh, in_specs=in_specs, out_specs=P(), check_rep=False
+        device_fn, mesh=mesh, in_specs=in_specs, out_specs=P(), check_vma=False
     )
     return jax.jit(fn)
 
