@@ -8,7 +8,7 @@ on this 1-CPU sandbox without the worker override).
 import random
 
 import numpy as np
-import pytest
+
 
 from tnc_tpu.partitioning.bisect import Hypergraph, bisect, partition_kway
 

@@ -49,12 +49,24 @@ class Hyperoptimizer(Pathfinder):
         reconfigure_budget: float | None = 60.0,
         reconfigure_top: int = 4,
         target_size: float | None = None,
+        polish_rounds: int = 6,
+        polish_steps: int = 4000,
+        polish_temps: tuple[float, float] = (0.3, 0.01),
     ) -> None:
         """``target_size``: when set, the final candidate selection is
         slicing-aware — candidates are scored by their *total sliced
         flops* after greedy slicing to ``target_size`` peak elements,
         not by raw flops (a slightly worse raw path that slices well is
-        the better plan on HBM-bound networks)."""
+        the better plan on HBM-bound networks).
+
+        ``polish_rounds``: the winner gets an annealing polish — rounds
+        of subtree rotations at a cooling temperature interleaved with
+        exact-DP reconfiguration (the TreeAnnealing/TreeReconfigure
+        combination applied to the best bisection tree instead of a
+        fresh one). On Sycamore-53 m=14 this cuts the final path ~4.6×
+        beyond the refined bisection optimum (r3 measurement: 3.19e14 →
+        6.97e13 flops, sliced total 3.88e14 → 8.74e13 at 2^29) for a few
+        seconds of extra planning. ``polish_rounds=0`` disables."""
         if minimize not in ("flops", "size"):
             raise ValueError("minimize must be 'flops' or 'size'")
         self.ntrials = ntrials
@@ -67,6 +79,9 @@ class Hyperoptimizer(Pathfinder):
         self.reconfigure_budget = reconfigure_budget
         self.reconfigure_top = reconfigure_top
         self.target_size = target_size
+        self.polish_rounds = polish_rounds
+        self.polish_steps = polish_steps
+        self.polish_temps = polish_temps
 
     def _solve_toplevel(self, inputs: list[LeafTensor]) -> list[tuple[int, int]]:
         n = len(inputs)
@@ -173,15 +188,70 @@ class Hyperoptimizer(Pathfinder):
 
         if self.target_size is not None:
             scored = [(sliced_score(c), c) for c in unique]
-            best_score = min(s for s, _ in scored)
-            if math.isinf(best_score):
+            winner_score, winner = min(scored, key=lambda p: p[0])
+            if math.isinf(winner_score):
                 # No finalist could be sliced to the target: fall back to
                 # the raw-flops ranking explicitly (an arbitrary
                 # inf-scored pick would defer the failure to the caller's
                 # own slicing attempt, far from this decision).
-                return min(unique, key=evaluate)
-            return next(c for s, c in scored if s == best_score)
-        return min(unique, key=evaluate)
+                winner = min(unique, key=evaluate)
+                winner_score = sliced_score(winner)
+            final_score = sliced_score
+        else:
+            winner = min(unique, key=evaluate)
+            winner_score = evaluate(winner)
+            final_score = evaluate
+
+        # Annealing polish: every round's snapshot competes under the
+        # SAME objective as the final selection (in slicing-aware mode a
+        # raw-flops-worse tree can be the sliced-flops winner).
+        best_path, best_score = winner, winner_score
+        for snapshot in self._polish(inputs, winner):
+            s = final_score(snapshot)
+            if s < best_score:
+                best_path, best_score = snapshot, s
+        return best_path
+
+    def _polish(
+        self, inputs: list[LeafTensor], candidate: list[tuple[int, int]]
+    ) -> list[list[tuple[int, int]]]:
+        """Annealing polish of the winning tree: rounds of Metropolis
+        subtree rotations at a cooling temperature, each followed by
+        exact-DP reconfiguration. Returns the deduplicated per-round
+        snapshots that improved the raw objective at least once
+        (annealing legitimately regresses between rounds); the caller
+        scores them under the final-selection objective."""
+        if self.polish_rounds <= 0 or len(inputs) <= 2:
+            return []
+        from tnc_tpu.contractionpath.contraction_tree import ContractionTree
+        from tnc_tpu.contractionpath.paths.tree_refine import (
+            _anneal,
+            _tree_objective,
+        )
+
+        rng = random.Random(self.seed ^ 0x9E3779B9)
+        tree = ContractionTree.from_ssa_path(inputs, list(candidate))
+        t_hi, t_lo = self.polish_temps
+        snapshots: list[list[tuple[int, int]]] = []
+        seen: set[tuple] = {tuple(candidate)}
+        best_obj = _tree_objective(tree, self.minimize)
+        for _ in range(self.polish_rounds):
+            _anneal(tree, rng, self.polish_steps, t_hi, t_lo, self.minimize)
+            tree.reconfigure(
+                self.reconfigure_size,
+                2,
+                minimize=self.minimize,
+                time_budget=self.reconfigure_budget,
+            )
+            obj = _tree_objective(tree, self.minimize)
+            if obj < best_obj * 1.5:  # skip clearly-regressed rounds
+                best_obj = min(best_obj, obj)
+                path = tree.to_ssa_path()
+                key = tuple(path)
+                if key not in seen:
+                    seen.add(key)
+                    snapshots.append(path)
+        return snapshots
 
     def _bisection_path(
         self,

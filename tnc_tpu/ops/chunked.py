@@ -367,13 +367,16 @@ def run_sliced_chunked_placed(
 
     part_dtype = "float64" if "128" in str(dtype) else "float32"
     stored_shape = sp.program.stored_result_shape
+
+    def zeros(dt):  # allocated directly on the target, no device-0 hop
+        if device is not None:
+            return jnp.zeros(stored_shape, dtype=dt, device=device)
+        return jnp.zeros(stored_shape, dtype=dt)
+
     if split_complex:
-        acc = (
-            place(jnp.zeros(stored_shape, dtype=part_dtype)),
-            place(jnp.zeros(stored_shape, dtype=part_dtype)),
-        )
+        acc = (zeros(part_dtype), zeros(part_dtype))
     else:
-        acc = place(jnp.zeros(stored_shape, dtype=dtype))
+        acc = zeros(dtype)
 
     for start in range(0, num, batch):
         idx = place(all_indices[start : start + batch])
