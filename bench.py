@@ -30,7 +30,7 @@ Env knobs:
                 sycamore_m20_partitioned (runs on the virtual 8-CPU mesh)
   BENCH_QUBITS / BENCH_DEPTH / BENCH_SEED
   BENCH_TARGET_LOG2_PEAK (29), BENCH_NTRIALS (128),
-  BENCH_CPU_SLICES (2), BENCH_REPS (3), BENCH_PEAK_FLOPS (per device),
+  BENCH_CPU_SLICES (1), BENCH_REPS (3), BENCH_PEAK_FLOPS (per device),
   BENCH_EXEC chunked|loop, BENCH_BATCH (8), BENCH_PROBE_SLICES (64),
   BENCH_LOOP_UNROLL (1; loop strategy only — unrolled-scan slice loop),
   BENCH_FULL_SECONDS (900; run all slices if projected under this),
@@ -173,7 +173,11 @@ def bench_sycamore_amplitude():
     # dispatch count, modeled peak 5.5 GiB/slice -> batch clamp 2
     target_log2 = float(os.environ.get("BENCH_TARGET_LOG2_PEAK", "29"))
     ntrials = _env_int("BENCH_NTRIALS", 128)
-    cpu_slices = _env_int("BENCH_CPU_SLICES", 2)
+    # one oracle slice by default: with the polished planner each slice
+    # is ~4x bigger, and one 2^29-peak slice already takes minutes on a
+    # single CPU core (the parity statistic is per-element max over the
+    # whole stored tensor either way)
+    cpu_slices = _env_int("BENCH_CPU_SLICES", 1)
     reps = _env_int("BENCH_REPS", 3)
 
     rng = np.random.default_rng(seed)

@@ -1,0 +1,54 @@
+#!/usr/bin/env bash
+# Hardware measurement campaign: run the moment the accelerator tunnel
+# is reachable. Produces logs under .cache/hw_campaign/ and the bench
+# JSON lines; each stage is independent, failures don't stop the rest.
+#
+# Usage: bash scripts/hw_campaign.sh
+set -uo pipefail
+cd "$(dirname "$0")/.."
+out=.cache/hw_campaign
+mkdir -p "$out"
+
+probe() {
+  timeout 90 python -c "
+import jax, time
+import jax.numpy as jnp
+t0 = time.time()
+x = jnp.ones((256, 256), jnp.bfloat16)
+print('probe ok:', float((x @ x).sum()), f'{time.time()-t0:.1f}s')" \
+    > "$out/probe.log" 2>&1
+}
+
+if ! probe; then
+  echo "tunnel unreachable; aborting campaign" | tee "$out/STATUS"
+  exit 1
+fi
+echo "tunnel alive, campaign starting $(date -u +%H:%M:%SZ)" | tee "$out/STATUS"
+
+echo "== 1. north-star bench (full measured run) =="
+timeout 3600 python bench.py > "$out/bench_main.json" 2> "$out/bench_main.log"
+echo "rc=$? $(cat "$out/bench_main.json" 2>/dev/null | tail -1)"
+
+echo "== 2. hardware test tier =="
+TNC_TPU_TEST_PLATFORM=tpu timeout 1800 python -m pytest -m tpu tests/ -q \
+  > "$out/hw_tier.log" 2>&1
+echo "rc=$? $(tail -1 "$out/hw_tier.log")"
+
+echo "== 3. loop-unroll A/B (256-slice subset) =="
+for unroll in 1 8; do
+  BENCH_EXEC=loop BENCH_LOOP_UNROLL=$unroll BENCH_MAX_SLICES=256 \
+    BENCH_REPS=1 BENCH_TRACE=0 BENCH_NO_RETRY=1 \
+    timeout 1800 python bench.py \
+    > "$out/bench_loop_u$unroll.json" 2> "$out/bench_loop_u$unroll.log"
+  echo "unroll=$unroll rc=$? $(cat "$out/bench_loop_u$unroll.json" 2>/dev/null | tail -1)"
+done
+
+echo "== 4. lanemix take-vs-matmul A/B (chunked, 256-slice subset) =="
+for mode in matmul take; do
+  TNC_TPU_LANEMIX=$mode BENCH_MAX_SLICES=256 BENCH_REPS=1 BENCH_TRACE=0 \
+    BENCH_NO_RETRY=1 timeout 1800 python bench.py \
+    > "$out/bench_lanemix_$mode.json" 2> "$out/bench_lanemix_$mode.log"
+  echo "lanemix=$mode rc=$? $(cat "$out/bench_lanemix_$mode.json" 2>/dev/null | tail -1)"
+done
+
+echo "campaign done $(date -u +%H:%M:%SZ)" | tee -a "$out/STATUS"
