@@ -101,6 +101,21 @@ def test_staged_step_parity_complex(lanemix, monkeypatch):
     assert np.max(np.abs(got - want)) / scale < 1e-5
 
 
+def test_split_step_numpy_host_path_matches_complex():
+    """The numpy host path of apply_step_split (Gauss 3-matmul on split
+    parts, swap and no-swap orientations) equals the complex step."""
+    step, a, b = _interleaved_step()
+    want = np.asarray(
+        apply_step(np, a.astype(np.complex128), b.astype(np.complex128), step)
+    )
+    ar, ai = split_array(a, "float64")
+    br, bi = split_array(b, "float64")
+    re, im = apply_step_split(np, (ar, ai), (br, bi), step)
+    got = re + 1j * im
+    scale = np.max(np.abs(want))
+    assert np.max(np.abs(got - want)) / scale < 1e-12
+
+
 def test_staged_step_parity_split_complex():
     step, a, b = _interleaved_step()
     want = np.asarray(
