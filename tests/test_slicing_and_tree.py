@@ -340,6 +340,35 @@ def test_jax_backend_chunked_strategy():
     assert a == pytest.approx(b, rel=1e-4, abs=1e-7)
 
 
+def test_chunked_zero_step_sliced_program():
+    """A single-leaf network with a sliced leg compiles to a zero-step
+    program; the chunked executor must sum the leaf's slices, not return
+    the zero accumulator."""
+    from tnc_tpu.contractionpath.contraction_path import ContractionPath
+    from tnc_tpu.contractionpath.slicing import Slicing
+    from tnc_tpu.ops.chunked import execute_sliced_batched_jax
+    from tnc_tpu.ops.sliced import build_sliced_program, execute_sliced_numpy
+    from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+    from tnc_tpu.tensornetwork.tensordata import TensorData
+
+    rng = np.random.default_rng(2)
+    data = rng.standard_normal((4, 2)) + 1j * rng.standard_normal((4, 2))
+    leaf = LeafTensor([0, 1], [4, 2], TensorData.matrix(data))
+    tn = CompositeTensor()
+    tn.push_tensor(leaf)
+    slicing = Slicing(legs=(1,), dims=(2,))
+    sp = build_sliced_program(tn, ContractionPath.simple([]), slicing)
+    assert len(sp.program.steps) == 0
+    want = execute_sliced_numpy(sp, [data], dtype=np.complex128)
+    for split in (False, True):
+        got = execute_sliced_batched_jax(
+            sp, [data], batch=1, chunk_steps=8, split_complex=split
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), want, rtol=0, atol=1e-6
+        )
+
+
 def test_loop_unroll_scan_matches_oracle():
     """The unrolled-scan slice loop (loop_unroll > 1) must match the
     oracle for unroll factors that divide the slice count and ones that

@@ -103,7 +103,7 @@ def _run_chunk_split(
         del state[step.rhs]
 
 
-# compiled plan cache: key -> (chunks, chunk_fns, gather, reduce_batch).
+# compiled plan cache: key -> (chunks, chunk_fns).
 # Locked: the distributed local phase runs one chunked runner per
 # partition from a thread pool, so lookups/evictions race otherwise.
 _PLAN_CACHE: "OrderedDict[tuple, tuple]" = OrderedDict()
@@ -138,6 +138,7 @@ def _compiled_plan(
             return hit
 
     chunks = split_program(sp.program, chunk_steps)
+    num_inputs = sp.program.num_inputs
 
     # which slots carry a batch axis (sliced leaves + anything computed
     # from a batched slot)
@@ -152,24 +153,36 @@ def _compiled_plan(
                 current.add(step.lhs)
         batched_after_chunk.append(set(current))
 
-    def gather_slot(arr, info, idx_batch):
-        """arr: full buffer; idx_batch: [B, n_sliced_legs] -> [B, ...]."""
-        return jax.vmap(lambda idx: index_buffer(jnp, arr, info, idx))(
-            idx_batch
-        )
-
-    def gather_pair(pair, info, idx_batch):
-        return (
-            gather_slot(pair[0], info, idx_batch),
-            gather_slot(pair[1], info, idx_batch),
-        )
-
+    # Dispatch-count discipline (host calls dominate the steady state on
+    # fast backends, TPU_EVIDENCE_r03.md): each sliced leaf is gathered
+    # INSIDE its consuming chunk's jit (full buffer unbatched + the idx
+    # rows vmapped), and the last chunk folds the batch-sum/accumulate.
+    # One dispatch per chunk per batch — no separate gather or reduce.
+    result_shape = sp.program.stored_result_shape
+    result_slot = sp.program.result_slot
+    last_ci = len(chunks) - 1
     chunk_fns = []
+    written_before: set[int] = set()
     for ci, chunk in enumerate(chunks):
         pre_batched = batched if ci == 0 else batched_after_chunk[ci - 1]
+        # a sliced-leaf slot read here for the first time enters as the
+        # FULL buffer and is sliced per-batch-row inside the vmap; a slot
+        # id below num_inputs that an earlier chunk already wrote holds
+        # an intermediate (slots are reused as result holders)
+        leaf_in = {
+            slot
+            for slot in chunk.in_slots
+            if slot < num_inputs
+            and sp.slot_slices[slot]
+            and slot not in written_before
+        }
+        written_before.update(step.lhs for step in chunk.steps)
         in_axes_spec = []
         for slot in chunk.in_slots:
-            ax = 0 if slot in pre_batched else None
+            if slot in leaf_in:
+                ax = None
+            else:
+                ax = 0 if slot in pre_batched else None
             in_axes_spec.append((ax, ax) if split_complex else ax)
         post_batched = batched_after_chunk[ci]
         out_axes_spec = []
@@ -177,8 +190,20 @@ def _compiled_plan(
             ax = 0 if slot in post_batched else None
             out_axes_spec.append((ax, ax) if split_complex else ax)
 
-        def single(ins, _chunk=chunk):
-            state = dict(zip(_chunk.in_slots, ins))
+        def single(ins, idx1, _chunk=chunk, _leaf_in=leaf_in):
+            state = {}
+            for slot, val in zip(_chunk.in_slots, ins):
+                if slot in _leaf_in:
+                    info = sp.slot_slices[slot]
+                    if split_complex:
+                        state[slot] = (
+                            index_buffer(jnp, val[0], info, idx1),
+                            index_buffer(jnp, val[1], info, idx1),
+                        )
+                    else:
+                        state[slot] = index_buffer(jnp, val, info, idx1)
+                else:
+                    state[slot] = val
             if split_complex:
                 _run_chunk_split(jnp, _chunk, state, precision)
             else:
@@ -193,50 +218,51 @@ def _compiled_plan(
                 for s in spec
             )
 
-        if _has_axis(in_axes_spec):
-            fn = jax.jit(
-                jax.vmap(
-                    single,
-                    in_axes=(tuple(in_axes_spec),),
-                    out_axes=tuple(out_axes_spec),
-                )
+        is_batched_chunk = bool(leaf_in) or _has_axis(in_axes_spec)
+        if is_batched_chunk:
+            vmapped = jax.vmap(
+                single,
+                in_axes=(tuple(in_axes_spec), 0),
+                out_axes=tuple(out_axes_spec),
             )
         else:
             # chunk touches no sliced data: identical for every slice,
             # run it unbatched (its outputs are unbatched too)
-            fn = jax.jit(single)
+            def vmapped(ins, idx, _single=single):
+                return _single(ins, None)
+
+        if ci == last_ci:
+            # the only slot alive after the final chunk is the result:
+            # fold the batch-sum + accumulate into the same dispatch
+            out_pos = chunk.out_slots.index(result_slot)
+            res_batched = (
+                result_slot in batched_after_chunk[ci] and is_batched_chunk
+            )
+
+            def last_fn(
+                ins, idx, acc, _vmapped=vmapped, _pos=out_pos, _rb=res_batched
+            ):
+                out = _vmapped(ins, idx)[_pos]
+                b = idx.shape[0]
+                if split_complex:
+                    if _rb:
+                        re = jnp.sum(out[0], axis=0)
+                        im = jnp.sum(out[1], axis=0)
+                    else:  # slice-independent result: b identical terms
+                        re, im = out[0] * b, out[1] * b
+                    return (
+                        acc[0] + re.reshape(result_shape),
+                        acc[1] + im.reshape(result_shape),
+                    )
+                s = jnp.sum(out, axis=0) if _rb else out * b
+                return acc + s.reshape(result_shape)
+
+            fn = jax.jit(last_fn)
+        else:
+            fn = jax.jit(lambda ins, idx, _v=vmapped: _v(ins, idx))
         chunk_fns.append(fn)
 
-    result_shape = sp.program.stored_result_shape
-
-    if split_complex:
-
-        @jax.jit
-        def reduce_batch(acc, out_pair):
-            re = jnp.sum(out_pair[0], axis=0).reshape(result_shape)
-            im = jnp.sum(out_pair[1], axis=0).reshape(result_shape)
-            return acc[0] + re, acc[1] + im
-
-    else:
-
-        @jax.jit
-        def reduce_batch(acc, out):
-            return acc + jnp.sum(out, axis=0).reshape(result_shape)
-
-    gather = jax.jit(
-        lambda full, idx: [
-            (
-                gather_pair(full[slot], info, idx)
-                if split_complex
-                else gather_slot(full[slot], info, idx)
-            )
-            if info
-            else full[slot]
-            for slot, info in enumerate(sp.slot_slices)
-        ]
-    )
-
-    plan = (chunks, chunk_fns, gather, reduce_batch)
+    plan = (chunks, chunk_fns)
     with _PLAN_CACHE_LOCK:
         _PLAN_CACHE[key] = plan
         while len(_PLAN_CACHE) > _PLAN_CACHE_MAX:
@@ -344,7 +370,7 @@ def run_sliced_chunked_placed(
     while num % batch:  # largest divisor <= requested (dims are tiny)
         batch -= 1
 
-    chunks, chunk_fns, gather, reduce_batch = _compiled_plan(
+    chunks, chunk_fns = _compiled_plan(
         sp, batch, chunk_steps, split_complex, precision
     )
 
@@ -378,16 +404,40 @@ def run_sliced_chunked_placed(
     else:
         acc = zeros(dtype)
 
+    if not chunks:
+        # zero-step program: the result is the (sliced) leaf itself —
+        # sum its first `num` slices in one dispatch
+        info = sp.slot_slices[sp.program.result_slot]
+        idx_all = place(all_indices)
+
+        def leaf_sum(buf, idx):
+            rows = jax.vmap(lambda i: index_buffer(jnp, buf, info, i))(idx)
+            return jnp.sum(rows, axis=0).reshape(stored_shape)
+
+        fn = jax.jit(leaf_sum)
+        leaf = device_full[sp.program.result_slot]
+        if split_complex:
+            return (
+                acc[0] + fn(leaf[0], idx_all),
+                acc[1] + fn(leaf[1], idx_all),
+            )
+        return acc + fn(leaf, idx_all)
+
+    last_ci = len(chunks) - 1
     for start in range(0, num, batch):
         idx = place(all_indices[start : start + batch])
-        sliced = gather(device_full, idx)
-        state = dict(enumerate(sliced))
-        for chunk, fn in zip(chunks, chunk_fns):
+        # leaf in_slots receive the FULL buffers; each chunk's jit does
+        # its own per-row gather and the last one folds the reduction —
+        # exactly one dispatch per chunk per batch
+        state = dict(enumerate(device_full))
+        for ci, (chunk, fn) in enumerate(zip(chunks, chunk_fns)):
             ins = tuple(state[s] for s in chunk.in_slots)
-            outs = fn(ins)
-            for slot, buf in zip(chunk.out_slots, outs):
-                state[slot] = buf
-            for step in chunk.steps:
-                state.pop(step.rhs, None)
-        acc = reduce_batch(acc, state[sp.program.result_slot])
+            if ci == last_ci:
+                acc = fn(ins, idx, acc)
+            else:
+                outs = fn(ins, idx)
+                for slot, buf in zip(chunk.out_slots, outs):
+                    state[slot] = buf
+                for step in chunk.steps:
+                    state.pop(step.rhs, None)
     return acc
