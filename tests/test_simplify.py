@@ -71,9 +71,10 @@ def test_distributed_sliced_matches_oracle(network):
     assert slicing.num_slices >= 8
 
     mesh = make_mesh(8)
-    out = distributed_sliced_contraction(
-        flat, replace, slicing, mesh=mesh, dtype="complex64"
-    )
-    got = complex(np.asarray(out.data.into_data()).reshape(-1)[0])
     want = _value(flat)
-    assert abs(got - want) <= 1e-4 * max(1.0, abs(want))
+    for unroll in (1, 4):  # fori_loop and unrolled-scan per-device loops
+        out = distributed_sliced_contraction(
+            flat, replace, slicing, mesh=mesh, dtype="complex64", unroll=unroll
+        )
+        got = complex(np.asarray(out.data.into_data()).reshape(-1)[0])
+        assert abs(got - want) <= 1e-4 * max(1.0, abs(want)), unroll
