@@ -1,0 +1,50 @@
+"""TensorData edge branches: adjoint-of-file materialization, equality
+across kinds, reprs, and the odd-rank adjoint guard (tensordata.rs
+semantics the main suites don't reach)."""
+
+import numpy as np
+import pytest
+
+from tnc_tpu.tensornetwork.tensordata import TensorData, matrix_adjoint
+
+
+def test_matrix_adjoint_rejects_odd_rank():
+    with pytest.raises(ValueError):
+        matrix_adjoint(np.zeros((2, 2, 2)))
+
+
+def test_from_values_roundtrip():
+    td = TensorData.from_values((2, 2), [1, 2j, 3, 4])
+    got = td.into_data()
+    assert got.shape == (2, 2) and got[0, 1] == 2j
+
+
+def test_file_adjoint_materializes_conjugate_transpose(tmp_path):
+    from tnc_tpu.io.hdf5 import store_data
+    from tnc_tpu.tensornetwork.tensor import LeafTensor
+
+    rng = np.random.default_rng(0)
+    data = rng.standard_normal((2, 2)) + 1j * rng.standard_normal((2, 2))
+    path = str(tmp_path / "t.h5")
+    store_data(path, 0, LeafTensor([0, 1], [2, 2], TensorData.matrix(data)))
+
+    td = TensorData.file(path, 0)
+    adj = td.adjoint()
+    want = matrix_adjoint(data)
+    np.testing.assert_allclose(adj.into_data(), want)
+    # double adjoint flips the flag back
+    np.testing.assert_allclose(adj.adjoint().into_data(), data)
+
+
+def test_equality_and_repr_across_kinds():
+    m = TensorData.matrix(np.eye(2, dtype=np.complex128))
+    assert m == TensorData.matrix(np.eye(2, dtype=np.complex128))
+    assert m != TensorData.matrix(np.zeros((2, 2), dtype=np.complex128))
+    g = TensorData.gate("h")
+    assert g == TensorData.gate("h")
+    assert g != TensorData.gate("x")
+    assert m != g
+    assert (m == object()) is False  # NotImplemented -> False via fallback
+    assert "matrix(shape=(2, 2))" in repr(m)
+    assert "gate" in repr(g)
+    assert TensorData.none().is_none()
