@@ -1,0 +1,52 @@
+"""Native-library build path: force a from-source rebuild of the C++
+partitioner (the cached .so normally makes `_build_library` dark) and
+check the TNC_TPU_NO_NATIVE escape hatch."""
+
+import random
+import shutil
+
+import pytest
+
+import tnc_tpu.partitioning.native_binding as nb
+from tnc_tpu.partitioning.bisect import Hypergraph
+
+
+def _small_hg():
+    rng = random.Random(0)
+    pins = [[i, i + 1] for i in range(19)]
+    return Hypergraph(20, [1.0] * 20, pins, [1.0 + rng.random() for _ in pins])
+
+
+def test_build_library_from_source(tmp_path):
+    """Deleting the cached .so must trigger a clean g++ rebuild and a
+    loadable, working library."""
+    if not shutil.which("g++"):
+        pytest.skip("no compiler")
+    backup = tmp_path / "_partitioner.so.bak"
+    had_lib = nb._LIB_PATH.exists()
+    if had_lib:
+        shutil.copy2(nb._LIB_PATH, backup)
+    old_lib, old_failed = nb._lib, nb._load_failed
+    try:
+        if had_lib:
+            nb._LIB_PATH.unlink()
+        nb._lib, nb._load_failed = None, False
+        lib = nb.load_native()
+        assert lib is not None, "rebuild from source failed"
+        part = nb.native_partition_kway(_small_hg(), 2, 0.1, seed=7)
+        assert part is not None and set(part) == {0, 1}
+    finally:
+        if had_lib and backup.exists() and not nb._LIB_PATH.exists():
+            shutil.copy2(backup, nb._LIB_PATH)
+        nb._lib, nb._load_failed = old_lib, old_failed
+
+
+def test_no_native_env_disables(monkeypatch):
+    monkeypatch.setenv("TNC_TPU_NO_NATIVE", "1")
+    old_lib, old_failed = nb._lib, nb._load_failed
+    try:
+        nb._lib, nb._load_failed = None, False
+        assert nb.load_native() is None
+        assert nb.native_partition_kway(_small_hg(), 2, 0.1, seed=1) is None
+    finally:
+        nb._lib, nb._load_failed = old_lib, old_failed
