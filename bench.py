@@ -192,14 +192,14 @@ def bench_sycamore_amplitude():
 
     # -- plan (excluded from timing, like the reference's Sweep phase) ------
     target = 2.0**target_log2
-    t0 = time.monotonic()
+    plan_t0 = time.monotonic()
     result = Hyperoptimizer(
         ntrials=ntrials, seed=seed, target_size=target
     ).find_path(tn)
     log(
         f"[bench] path: flops={result.flops:.3e} "
         f"peak=2^{np.log2(max(result.size, 1)):.1f} "
-        f"(planned in {time.monotonic() - t0:.1f}s)"
+        f"(planned in {time.monotonic() - plan_t0:.1f}s)"
     )
 
     inputs = list(tn.tensors)
@@ -209,6 +209,7 @@ def bench_sycamore_amplitude():
     )
     replace = ContractionPath.simple(replace_pairs)
     total_flops = sliced_flops(inputs, replace.toplevel, slicing)
+    planning_s = time.monotonic() - plan_t0
     log(
         f"[bench] slicing: {len(slicing.legs)} legs, {slicing.num_slices} "
         f"slices, total flops {total_flops:.3e} "
@@ -228,7 +229,12 @@ def bench_sycamore_amplitude():
         loop_unroll=_env_int("BENCH_LOOP_UNROLL", 1),
     )
     log(f"[bench] executor: {strategy}")
-    extra = {}
+    extra = {
+        "planning_s": round(planning_s, 1),
+        "path_flops": float(f"{result.flops:.4e}"),
+        "sliced_total_flops": float(f"{total_flops:.4e}"),
+        "num_slices": slicing.num_slices,
+    }
     num = slicing.num_slices
 
     # -- probe: time a slice subset through the real executor --------------
