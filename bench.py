@@ -709,7 +709,9 @@ def main() -> None:
     if platform in ("cpu", "cpu-fallback") and config == "sycamore_amplitude":
         # The full 2^16-slice north-star is accelerator-scale work; on a
         # CPU host, time a slice subset and extrapolate (marked in JSON).
-        os.environ.setdefault("BENCH_MAX_SLICES", "4")
+        # 2 slices: each 2^29-target slice is minutes of single-core
+        # work; the extrapolation is marked in the JSON either way
+        os.environ.setdefault("BENCH_MAX_SLICES", "2")
         os.environ.setdefault("BENCH_REPS", "1")
 
     try:
