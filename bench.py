@@ -30,7 +30,11 @@ Env knobs:
                 sycamore_m20_partitioned (runs on the virtual 8-CPU mesh)
   BENCH_QUBITS / BENCH_DEPTH / BENCH_SEED
   BENCH_TARGET_LOG2_PEAK (29), BENCH_NTRIALS (128),
-  BENCH_CPU_SLICES (1), BENCH_REPS (3), BENCH_PEAK_FLOPS (per device),
+  BENCH_CPU_SLICES (1; serial baseline-timing sample),
+  BENCH_PARITY_SLICES (16; parallel complex128 oracle sample),
+  BENCH_PARITY_TARGET (1e-5), BENCH_COMPLEX_MULT naive|gauss,
+  BENCH_NO_PLAN_CACHE=1 (force replanning),
+  BENCH_REPS (3), BENCH_PEAK_FLOPS (per device),
   BENCH_EXEC chunked|loop, BENCH_BATCH (8), BENCH_PROBE_SLICES (64),
   BENCH_LOOP_UNROLL (1; loop strategy only — unrolled-scan slice loop),
   BENCH_FULL_SECONDS (900; run all slices if projected under this),
@@ -162,7 +166,7 @@ def bench_sycamore_amplitude():
     )
     from tnc_tpu.ops.backends import JaxBackend
     from tnc_tpu.ops.program import flat_leaf_tensors
-    from tnc_tpu.ops.sliced import build_sliced_program, execute_sliced_numpy
+    from tnc_tpu.ops.sliced import build_sliced_program
     from tnc_tpu.tensornetwork.simplify import simplify_network
 
     qubits = _env_int("BENCH_QUBITS", 53)
@@ -191,35 +195,99 @@ def bench_sycamore_amplitude():
     )
 
     # -- plan (excluded from timing, like the reference's Sweep phase) ------
+    # The plan is deterministic in (circuit, seed, ntrials, target), so it
+    # is cached on disk like the reference's Sweep/Run artifact split
+    # (``benchmark/src/main.rs:223-242``): a hardware attempt should spend
+    # <1 s loading the plan, not ~107 s recomputing it (VERDICT r3 #3).
+    from tnc_tpu.benchmark.cache import ArtifactCache, cache_key
+
     target = 2.0**target_log2
     plan_t0 = time.monotonic()
-    result = Hyperoptimizer(
-        ntrials=ntrials, seed=seed, target_size=target
-    ).find_path(tn)
-    log(
-        f"[bench] path: flops={result.flops:.3e} "
-        f"peak=2^{np.log2(max(result.size, 1)):.1f} "
-        f"(planned in {time.monotonic() - plan_t0:.1f}s)"
+    cache = ArtifactCache(
+        os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".cache", "plans"
+        )
     )
-
+    # v2: bump when planner/slicer behavior changes invalidate old plans
+    key = cache_key(
+        "northstar-plan-v2",
+        f"sycamore-{qubits}-m{depth}-seed{seed}-trials{ntrials}",
+        seed,
+        1,
+        f"hyper-target2^{target_log2:g}",
+    )
     inputs = list(tn.tensors)
-    t0 = time.monotonic()
-    replace_pairs, slicing = slice_and_reconfigure(
-        inputs, result.ssa_path.toplevel, target
-    )
-    replace = ContractionPath.simple(replace_pairs)
-    total_flops = sliced_flops(inputs, replace.toplevel, slicing)
-    planning_s = time.monotonic() - plan_t0
-    log(
-        f"[bench] slicing: {len(slicing.legs)} legs, {slicing.num_slices} "
-        f"slices, total flops {total_flops:.3e} "
-        f"(slice+reconfigure in {time.monotonic() - t0:.1f}s)"
-    )
+    cached = None if os.environ.get("BENCH_NO_PLAN_CACHE") == "1" else cache.load_obj(key)
+    if cached is not None:
+        path_flops, path_size, replace_pairs, slicing = cached
+        replace = ContractionPath.simple(replace_pairs)
+        total_flops = sliced_flops(inputs, replace.toplevel, slicing)
+        planning_s = time.monotonic() - plan_t0
+        log(
+            f"[bench] plan loaded from cache ({key}) in {planning_s:.2f}s: "
+            f"flops={path_flops:.3e} peak=2^{np.log2(max(path_size, 1)):.1f}, "
+            f"{len(slicing.legs)} sliced legs, {slicing.num_slices} slices"
+        )
+    else:
+        result = Hyperoptimizer(
+            ntrials=ntrials, seed=seed, target_size=target
+        ).find_path(tn)
+        path_flops, path_size = result.flops, result.size
+        log(
+            f"[bench] path: flops={result.flops:.3e} "
+            f"peak=2^{np.log2(max(result.size, 1)):.1f} "
+            f"(planned in {time.monotonic() - plan_t0:.1f}s)"
+        )
+        t0 = time.monotonic()
+        replace_pairs, slicing = slice_and_reconfigure(
+            inputs, result.ssa_path.toplevel, target
+        )
+        replace = ContractionPath.simple(replace_pairs)
+        total_flops = sliced_flops(inputs, replace.toplevel, slicing)
+        planning_s = time.monotonic() - plan_t0
+        log(
+            f"[bench] slicing: {len(slicing.legs)} legs, "
+            f"{slicing.num_slices} slices, total flops {total_flops:.3e} "
+            f"(slice+reconfigure in {time.monotonic() - t0:.1f}s)"
+        )
+        cache.store_obj(key, (path_flops, path_size, replace_pairs, slicing))
+        log(f"[bench] plan cached as {key}")
 
     sp = build_sliced_program(tn, replace, slicing)
     arrays = [leaf.data.into_data() for leaf in flat_leaf_tensors(tn)]
 
+    if os.environ.get("BENCH_PREWARM") == "1":
+        # Tunnel-independent preparation (run under BENCH_FORCE_CPU=1):
+        # plan + complex128 parity oracle + serial baseline timing are
+        # all deterministic host work; computing them now means a live
+        # hardware window spends zero time on anything but device runs.
+        n_sub = max(
+            1, min(_env_int("BENCH_PARITY_SLICES", 16), slicing.num_slices)
+        )
+        oracle = _oracle_artifact(
+            cache, key, sp, arrays, n_sub,
+            max(1, min(cpu_slices, slicing.num_slices)),
+        )
+        return (
+            "prewarm_northstar",
+            0.0,
+            0.0,
+            {
+                "oracle_slices": int(oracle["n"]),
+                "cpu_per_slice_s": round(float(oracle["cpu_per_slice_s"]), 3),
+                "planning_s": round(planning_s, 1),
+                "num_slices": slicing.num_slices,
+            },
+        )
+
     strategy = os.environ.get("BENCH_EXEC", "chunked")
+    # complex-multiply lowering: naive 4-dot by default — hits the 1e-5
+    # parity target at f32 where Gauss 3-dot narrowly misses it, and the
+    # three pre-dot full-operand HBM passes it removes offset the extra
+    # dot (VERDICT r3 #2; A/B via BENCH_COMPLEX_MULT=gauss)
+    complex_mult = os.environ.setdefault(
+        "TNC_TPU_COMPLEX_MULT", os.environ.get("BENCH_COMPLEX_MULT", "naive")
+    )
     backend = JaxBackend(
         dtype="complex64",
         sliced_strategy=strategy,
@@ -228,12 +296,13 @@ def bench_sycamore_amplitude():
         precision=os.environ.get("BENCH_PRECISION", "float32"),
         loop_unroll=_env_int("BENCH_LOOP_UNROLL", 1),
     )
-    log(f"[bench] executor: {strategy}")
+    log(f"[bench] executor: {strategy} (complex_mult={complex_mult})")
     extra = {
         "planning_s": round(planning_s, 1),
-        "path_flops": float(f"{result.flops:.4e}"),
+        "path_flops": float(f"{path_flops:.4e}"),
         "sliced_total_flops": float(f"{total_flops:.4e}"),
         "num_slices": slicing.num_slices,
+        "complex_mult": complex_mult,
     }
     num = slicing.num_slices
 
@@ -286,9 +355,17 @@ def bench_sycamore_amplitude():
     )
 
     # -- parity: accelerator vs numpy oracle on the same slice subset ------
-    n_sub = max(1, min(cpu_slices, slicing.num_slices))
-    want_partial = execute_sliced_numpy(
-        sp, arrays, dtype=np.complex128, max_slices=n_sub
+    # ≥16 slices by default (VERDICT r3 weak #3). The complex128 oracle
+    # is minutes/slice of deterministic host numpy, so its per-slice
+    # results and the serial baseline timing are cached keyed by the
+    # plan (BENCH_PREWARM=1 computes them tunnel-independently).
+    n_sub = max(1, min(_env_int("BENCH_PARITY_SLICES", 16), slicing.num_slices))
+    oracle = _oracle_artifact(
+        cache, key, sp, arrays, n_sub,
+        max(1, min(cpu_slices, slicing.num_slices)),
+    )
+    want_partial = np.sum(
+        oracle["per_slice"][:n_sub], axis=0, dtype=np.complex128
     )
     got_partial = np.asarray(
         backend.execute_sliced(sp, arrays, max_slices=n_sub)
@@ -296,15 +373,24 @@ def bench_sycamore_amplitude():
     denom = max(float(np.max(np.abs(want_partial))), 1e-30)
     parity = float(np.max(np.abs(got_partial - want_partial))) / denom
     log(f"[bench] parity vs numpy oracle ({n_sub} slices): {parity:.2e}")
-    if parity > 1e-4:
-        raise BenchCheckError(f"parity check failed: {parity:.2e} > 1e-4")
+    # BASELINE.md accuracy target (1e-5), restored from the quietly
+    # relaxed 1e-4 gate now that naive-mult + Kahan close the gap
+    parity_target = float(os.environ.get("BENCH_PARITY_TARGET", "1e-5"))
+    if parity > parity_target:
+        raise BenchCheckError(
+            f"parity check failed: {parity:.2e} > {parity_target:g}"
+        )
     extra["parity"] = float(f"{parity:.3e}")
+    extra["parity_slices"] = n_sub
 
-    # -- CPU baseline: same program, subset of slices, extrapolated --------
-    t0 = time.monotonic()
-    execute_sliced_numpy(sp, arrays, dtype=np.complex64, max_slices=n_sub)
-    cpu_s = (time.monotonic() - t0) * (slicing.num_slices / n_sub)
-    log(f"[bench] cpu oracle extrapolated: {cpu_s:.1f}s")
+    # -- CPU baseline: same program, serial slice subset, extrapolated -----
+    # (rounds 1-3 methodology: slices are identical work by construction)
+    cpu_s = float(oracle["cpu_per_slice_s"]) * slicing.num_slices
+    extra["cpu_baseline_from_slices"] = int(oracle["cpu_timed_slices"])
+    log(
+        f"[bench] cpu oracle extrapolated (from "
+        f"{oracle['cpu_timed_slices']} serial slices): {cpu_s:.1f}s"
+    )
 
     return (
         f"sycamore{qubits}_m{depth}_amplitude_wallclock",
@@ -312,6 +398,66 @@ def bench_sycamore_amplitude():
         cpu_s / tpu_s if tpu_s > 0 else 0.0,
         extra,
     )
+
+
+def _oracle_artifact(cache, plan_key, sp, arrays, n_sub, n_time) -> dict:
+    """Complex128 per-slice oracle results + serial complex64 baseline
+    timing, cached keyed by the plan. Deterministic host work, so a
+    cache hit costs ~0 s of a hardware window; ``BENCH_NO_PLAN_CACHE=1``
+    forces recomputation."""
+    from tnc_tpu.ops.sliced import execute_sliced_numpy, sliced_partials_numpy
+
+    okey = plan_key.replace("northstar-plan", "northstar-oracle")
+    obj = (
+        None
+        if os.environ.get("BENCH_NO_PLAN_CACHE") == "1"
+        else cache.load_obj(okey)
+    )
+    if not isinstance(obj, dict):
+        obj = {"n": 0, "per_slice": None, "cpu_per_slice_s": 0.0,
+               "cpu_timed_slices": 0}
+    have = int(obj.get("n", 0))
+    if have >= n_sub and obj.get("cpu_timed_slices", 0) >= n_time:
+        log(
+            f"[bench] oracle loaded from cache ({okey}): {have} parity "
+            f"slices, baseline {obj['cpu_per_slice_s']:.1f}s/slice"
+        )
+        return obj
+    # incremental + parallel: slices are minutes of numpy each, so fan
+    # a batch of `workers` out over the process pool and store after
+    # every batch — progress survives a killed prewarm, and a later
+    # invocation computes only the remainder
+    workers = max(1, os.cpu_count() or 1)
+    s = have
+    while s < n_sub:
+        batch = list(range(s, min(s + workers, n_sub)))
+        t0 = time.monotonic()
+        part = sliced_partials_numpy(
+            sp, arrays, dtype=np.complex128, slice_ids=batch, workers=workers
+        )
+        obj["per_slice"] = (
+            part
+            if obj["per_slice"] is None
+            else np.concatenate([obj["per_slice"], part])
+        )
+        s = batch[-1] + 1
+        obj["n"] = s
+        cache.store_obj(okey, obj)
+        log(
+            f"[bench] oracle slices {batch[0] + 1}-{s}/{n_sub} in "
+            f"{time.monotonic() - t0:.1f}s (cached)"
+        )
+    if obj.get("cpu_timed_slices", 0) < n_time:
+        t0 = time.monotonic()
+        execute_sliced_numpy(sp, arrays, dtype=np.complex64, max_slices=n_time)
+        obj["cpu_per_slice_s"] = (time.monotonic() - t0) / n_time
+        obj["cpu_timed_slices"] = n_time
+        cache.store_obj(okey, obj)
+        log(
+            f"[bench] baseline timing: {obj['cpu_per_slice_s']:.1f}s/slice "
+            f"over {n_time} serial complex64 slices (cached)"
+        )
+    return obj
 
 
 def _fetch_device_result(backend, out) -> np.ndarray:
@@ -710,9 +856,14 @@ def main() -> None:
         # The full 2^16-slice north-star is accelerator-scale work; on a
         # CPU host, time a slice subset and extrapolate (marked in JSON).
         # 2 slices: each 2^29-target slice is minutes of single-core
-        # work; the extrapolation is marked in the JSON either way
+        # work; the extrapolation is marked in the JSON either way.
+        # Parity drops to 2 slices too — the DEVICE side of the parity
+        # comparison is serial and ~2 min/slice on this path. (Prewarm
+        # runs do host-oracle work only and keep the 16-slice default.)
         os.environ.setdefault("BENCH_MAX_SLICES", "2")
         os.environ.setdefault("BENCH_REPS", "1")
+        if os.environ.get("BENCH_PREWARM") != "1":
+            os.environ.setdefault("BENCH_PARITY_SLICES", "2")
 
     try:
         record = _run_config(config)

@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""Merge the campaign's per-config bench JSONs into one artifact.
+
+Usage: python scripts/consolidate_bench.py .cache/hw_campaign
+
+Emits a single JSON object mapping BASELINE.md config names to their
+bench records (the reference benchmark's consolidated results file,
+``benchmark/src/results.rs``), preferring the most recent non-error
+record per config.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+NAMES = {
+    "bench_ghz3.json": "ghz3",
+    "bench_random20.json": "random20",
+    "bench_qaoa30.json": "qaoa30",
+    "bench_sycamore_m20_partitioned.json": "sycamore_m20_partitioned",
+    "bench_main.json": "sycamore_amplitude",
+}
+
+
+def last_record(path: Path) -> dict | None:
+    if not path.exists():
+        return None
+    lines = [
+        l for l in path.read_text().splitlines() if l.strip().startswith("{")
+    ]
+    if not lines:
+        return None
+    try:
+        return json.loads(lines[-1])
+    except json.JSONDecodeError:
+        return None
+
+
+def main() -> None:
+    out_dir = Path(sys.argv[1] if len(sys.argv) > 1 else ".cache/hw_campaign")
+    merged: dict = {}
+    for fname, config in NAMES.items():
+        rec = last_record(out_dir / fname)
+        if rec is not None:
+            merged[config] = rec
+    print(json.dumps(merged, indent=2))
+
+
+if __name__ == "__main__":
+    main()

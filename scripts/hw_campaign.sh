@@ -25,6 +25,17 @@ if ! probe; then
 fi
 echo "tunnel alive, campaign starting $(date -u +%H:%M:%SZ)" | tee "$out/STATUS"
 
+# clamp parity sampling to what the prewarm already cached: the
+# complex128 oracle is minutes/slice of 1-core host work, and a live
+# window must spend its time on device runs, not numpy
+ostat=$(python scripts/oracle_status.py 2>/dev/null || echo '{}')
+echo "oracle status: $ostat" | tee -a "$out/STATUS"
+cached=$(printf '%s' "$ostat" | sed -n 's/.*"oracle_slices": \([0-9]*\).*/\1/p')
+cached=${cached:-0}
+parity=$(( cached >= 2 ? (cached > 16 ? 16 : cached) : 2 ))
+export BENCH_PARITY_SLICES=$parity
+echo "BENCH_PARITY_SLICES=$parity"
+
 echo "== 1. north-star bench (full measured run) =="
 timeout 3600 python bench.py > "$out/bench_main.json" 2> "$out/bench_main.log"
 echo "rc=$? $(cat "$out/bench_main.json" 2>/dev/null | tail -1)"
@@ -50,5 +61,34 @@ for mode in matmul take; do
     > "$out/bench_lanemix_$mode.json" 2> "$out/bench_lanemix_$mode.log"
   echo "lanemix=$mode rc=$? $(cat "$out/bench_lanemix_$mode.json" 2>/dev/null | tail -1)"
 done
+
+echo "== 5. complex-mult naive-vs-gauss A/B (256-slice subset) =="
+for cm in naive gauss; do
+  BENCH_COMPLEX_MULT=$cm BENCH_MAX_SLICES=256 BENCH_REPS=1 BENCH_TRACE=0 \
+    BENCH_NO_RETRY=1 BENCH_PARITY_TARGET=1e-4 \
+    timeout 1800 python bench.py \
+    > "$out/bench_cmult_$cm.json" 2> "$out/bench_cmult_$cm.log"
+  echo "cmult=$cm rc=$? $(cat "$out/bench_cmult_$cm.json" 2>/dev/null | tail -1)"
+done
+
+echo "== 6. chunk-size sweep (256-slice subset) =="
+for cs in 24 96; do
+  BENCH_CHUNK_STEPS=$cs BENCH_MAX_SLICES=256 BENCH_REPS=1 BENCH_TRACE=0 \
+    BENCH_NO_RETRY=1 timeout 1800 python bench.py \
+    > "$out/bench_chunk_$cs.json" 2> "$out/bench_chunk_$cs.log"
+  echo "chunk=$cs rc=$? $(cat "$out/bench_chunk_$cs.json" 2>/dev/null | tail -1)"
+done
+
+echo "== 7. remaining BASELINE configs (ghz3, random20, qaoa30, config5) =="
+for cfg in ghz3 random20 qaoa30 sycamore_m20_partitioned; do
+  BENCH_CONFIG=$cfg BENCH_TRACE=0 BENCH_NO_RETRY=1 \
+    timeout 1200 python bench.py \
+    > "$out/bench_$cfg.json" 2> "$out/bench_$cfg.log"
+  echo "$cfg rc=$? $(cat "$out/bench_$cfg.json" 2>/dev/null | tail -1)"
+done
+
+echo "== 8. consolidated artifact =="
+python scripts/consolidate_bench.py "$out" > BENCH_ALL_r04.json 2>> "$out/watch.log" \
+  && echo "BENCH_ALL_r04.json written"
 
 echo "campaign done $(date -u +%H:%M:%SZ)" | tee -a "$out/STATUS"

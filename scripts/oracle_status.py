@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""Print the cached north-star oracle/plan status as one JSON line.
+
+Used by scripts/hw_campaign.sh to clamp BENCH_PARITY_SLICES to what the
+prewarm (scripts/prewarm_northstar.sh) has already computed, so a live
+hardware window never stalls on minutes-per-slice host oracle work.
+Key construction mirrors bench.bench_sycamore_amplitude exactly.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tnc_tpu.benchmark.cache import ArtifactCache, cache_key  # noqa: E402
+
+
+def main() -> None:
+    qubits = int(os.environ.get("BENCH_QUBITS", "53"))
+    depth = int(os.environ.get("BENCH_DEPTH", "14"))
+    seed = int(os.environ.get("BENCH_SEED", "42"))
+    ntrials = int(os.environ.get("BENCH_NTRIALS", "128"))
+    target_log2 = float(os.environ.get("BENCH_TARGET_LOG2_PEAK", "29"))
+    cache = ArtifactCache(
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".cache",
+            "plans",
+        )
+    )
+    key = cache_key(
+        "northstar-plan-v2",
+        f"sycamore-{qubits}-m{depth}-seed{seed}-trials{ntrials}",
+        seed,
+        1,
+        f"hyper-target2^{target_log2:g}",
+    )
+    okey = key.replace("northstar-plan", "northstar-oracle")
+    obj = cache.load_obj(okey)
+    status = {
+        "plan_cached": cache.has(key),
+        "oracle_slices": int(obj["n"]) if isinstance(obj, dict) else 0,
+        "baseline_timed": bool(
+            isinstance(obj, dict) and obj.get("cpu_timed_slices", 0) >= 1
+        ),
+    }
+    print(json.dumps(status))
+
+
+if __name__ == "__main__":
+    main()

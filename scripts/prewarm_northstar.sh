@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Tunnel-independent north-star preparation: plan + complex128 parity
+# oracle (16 slices) + serial baseline timing, all cached under
+# .cache/plans/. Each oracle slice is stored as it completes, so this
+# can be killed and resumed at any point. Run in the background; a live
+# hardware window then spends zero time on host oracle work.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+mkdir -p .cache
+BENCH_PREWARM=1 BENCH_FORCE_CPU=1 BENCH_PARITY_SLICES="${BENCH_PARITY_SLICES:-16}" \
+  python bench.py > .cache/prewarm.json 2> .cache/prewarm.log
+echo "prewarm rc=$? $(tail -1 .cache/prewarm.json 2>/dev/null)"

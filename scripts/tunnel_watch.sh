@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Tunnel watcher: probe the accelerator every ~2 min; the moment it
+# answers, run the full hardware campaign (scripts/hw_campaign.sh).
+# Exits after the campaign completes, or after MAX_WAIT_S of probing.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+out=.cache/hw_campaign
+mkdir -p "$out"
+MAX_WAIT_S=${MAX_WAIT_S:-43200}
+start=$(date +%s)
+
+probe() {
+  timeout 75 python -c "
+import jax
+import jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.bfloat16)
+assert jax.devices()[0].platform == 'tpu', jax.devices()
+print('probe ok', float((x @ x).sum()))" >> "$out/watch.log" 2>&1
+}
+
+while true; do
+  if probe; then
+    echo "$(date -u +%FT%TZ) tunnel ALIVE -> campaign" | tee -a "$out/watch.log"
+    bash scripts/hw_campaign.sh 2>&1 | tee -a "$out/watch.log"
+    echo "CAMPAIGN_DONE $(date -u +%FT%TZ)" | tee -a "$out/watch.log"
+    exit 0
+  fi
+  now=$(date +%s)
+  if [ $((now - start)) -gt "$MAX_WAIT_S" ]; then
+    echo "WATCH_TIMEOUT $(date -u +%FT%TZ)" | tee -a "$out/watch.log"
+    exit 1
+  fi
+  echo "$(date -u +%FT%TZ) tunnel down, sleeping" >> "$out/watch.log"
+  sleep 120
+done

@@ -1,0 +1,54 @@
+"""Cluster-chain fixture: k dense clusters joined by thin cut bonds.
+
+The honest workload for per-partition (local) slicing: each cluster's
+contraction peak is dominated by its *internal* (closed) legs, so an HBM
+budget can actually be met by slicing them. Auto-partitioned circuit
+networks are the opposite — their per-partition peak is the open cut
+boundary itself, which local slicing cannot reduce by construction
+(only GLOBAL slicing, which slices cut legs, helps there) — so they
+cannot exercise this path at any scale.
+
+Each cluster is a complete graph K_m over bond-``bond`` legs (peak
+~``bond^((m/2)^2)`` elements while contracting); neighbouring clusters
+share one bond. Data is seeded complex Gaussians scaled for O(1)
+amplitudes.
+"""
+
+import itertools
+
+import numpy as np
+
+from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+from tnc_tpu.tensornetwork.tensordata import TensorData
+
+
+def cluster_chain(
+    k: int = 4, m: int = 7, bond: int = 2, seed: int = 0
+) -> CompositeTensor:
+    rng = np.random.default_rng(seed)
+    next_leg = itertools.count()
+    cluster_members: list[list[list[int]]] = []
+    for _ in range(k):
+        legs_per: list[list[int]] = [[] for _ in range(m)]
+        for i in range(m):
+            for j in range(i + 1, m):
+                leg = next(next_leg)
+                legs_per[i].append(leg)
+                legs_per[j].append(leg)
+        cluster_members.append(legs_per)
+    for c in range(k - 1):
+        leg = next(next_leg)
+        cluster_members[c][-1].append(leg)
+        cluster_members[c + 1][0].append(leg)
+    tensors = []
+    for c in range(k):
+        for legs in cluster_members[c]:
+            dims = [bond] * len(legs)
+            shape = tuple(dims)
+            data = (
+                rng.standard_normal(shape) + 1j * rng.standard_normal(shape)
+            ) / np.sqrt(float(np.prod(shape)))
+            tensors.append(
+                LeafTensor(legs, dims, TensorData.matrix(data.astype(np.complex128)))
+            )
+    return CompositeTensor(tensors)

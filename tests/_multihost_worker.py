@@ -1,13 +1,17 @@
-"""Worker for the 2-process multi-host test (the ``#[mpi_test(2)]``
-analogue, reference ``tnc/tests/integration_tests.rs:88-119``).
+"""Worker for the multi-process distributed tests (the ``#[mpi_test(2)]``
+/ ``#[mpi_test(4)]`` analogues, reference
+``tnc/tests/integration_tests.rs:88-167``).
 
 Run as: python _multihost_worker.py <pid> <nprocs> <port>
 
-Process 0 plans (partitioning + paths); the path reaches process 1 only
-through ``broadcast_path``'s multi-host branch
+Process 0 plans (partitioning + paths); the path reaches the other
+processes only through ``broadcast_path``'s multi-host branch
 (``tnc_tpu/parallel/partitioned.py``). Each process contracts its own
-partition, partition 1's result is broadcast to process 0, and process 0
-contracts the fan-in pair and checks the full-network oracle.
+partition, every non-root partition result travels to process 0 over
+``broadcast_object`` (the serialized-MPI-broadcast analogue), and
+process 0 contracts the toplevel fan-in across all ``nprocs`` partition
+results and checks the full-network oracle — scatter / local contract /
+reduce across real OS process boundaries.
 """
 
 import os
@@ -28,72 +32,76 @@ jax.distributed.initialize(f"127.0.0.1:{port}", num_processes=nprocs, process_id
 assert jax.process_count() == nprocs, jax.process_count()
 
 import numpy as np
-from jax.experimental import multihost_utils
 
 from tnc_tpu.builders.connectivity import ConnectivityLayout
 from tnc_tpu.builders.random_circuit import random_circuit
 from tnc_tpu.contractionpath.contraction_path import ContractionPath
 from tnc_tpu.contractionpath.paths import Greedy, OptMethod
-from tnc_tpu.parallel.partitioned import broadcast_path
+from tnc_tpu.parallel.partitioned import broadcast_object, broadcast_path
 from tnc_tpu.tensornetwork.contraction import contract_tensor_network
 from tnc_tpu.tensornetwork.partitioning import (
     find_partitioning,
     partition_tensor_network,
 )
 from tnc_tpu.tensornetwork.simplify import simplify_network
+from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+from tnc_tpu.tensornetwork.tensordata import TensorData
 
 # every process builds the same network (deterministic seed) — mirrors
 # the reference, where the circuit is constructed on every rank and only
 # the path is broadcast (distributed_contraction.rs:20-42)
 rng = np.random.default_rng(9)
 tn = simplify_network(
-    random_circuit(10, 6, 0.5, 0.5, rng, ConnectivityLayout.LINE, bitstring="0" * 10)
+    random_circuit(12, 6, 0.5, 0.5, rng, ConnectivityLayout.LINE, bitstring="0" * 12)
 )
 parts = find_partitioning(tn, nprocs)
 grouped = partition_tensor_network(tn, parts)
+k = len(grouped)  # actual block count (empty blocks are dropped)
 
 if pid == 0:
     path = Greedy(OptMethod.GREEDY).find_path(grouped).replace_path()
 else:
     path = ContractionPath.simple([])  # placeholder; real path arrives by bcast
-
 path = broadcast_path(path, root=0)
-assert path.toplevel and len(path.nested) == nprocs, "broadcast path incomplete"
+assert path.toplevel and len(path.nested) == k, "broadcast path incomplete"
 print(f"proc {pid}: broadcast_path ok ({len(path.nested)} nested)", flush=True)
 
-# local phase: this process contracts ITS partition only
-mine = contract_tensor_network(
-    grouped[pid] if hasattr(grouped, "__getitem__") else list(grouped.tensors)[pid],
-    path.nested[pid],
-    backend="numpy",
-)
-local = np.ascontiguousarray(np.asarray(mine.data.into_data(), dtype=np.complex128))
-
-# fan-in across processes: partition 1's tensor travels to process 0
-# (broadcast_one_to_all is the single-controller-free transport here)
-re_im = np.stack([local.real, local.imag])
-other = multihost_utils.broadcast_one_to_all(re_im, is_source=pid == 1)
-if pid == 0:
-    other = np.asarray(other)
-    theirs_data = other[0] + 1j * other[1]
-    # rebuild the remote partition's metadata from the broadcast path
-    from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
-    from tnc_tpu.tensornetwork.tensordata import TensorData
-
-    remote_meta = contract_tensor_network(
-        list(grouped.tensors)[1], path.nested[1], backend="numpy"
-    )  # deterministic: same legs/shape as process 1 computed
-    pair = CompositeTensor(
-        [
-            LeafTensor(list(mine.legs), list(mine.bond_dims), TensorData.matrix(local)),
-            LeafTensor(
-                list(remote_meta.legs),
-                list(remote_meta.bond_dims),
-                TensorData.matrix(theirs_data.reshape(remote_meta.bond_dims)),
-            ),
-        ]
+# local phase: this process contracts ITS partition only (processes
+# beyond the block count idle through the collectives, like
+# oversubscribed MPI ranks)
+blocks = list(grouped.tensors)
+if pid < k:
+    mine = contract_tensor_network(blocks[pid], path.nested[pid], backend="numpy")
+    local = np.ascontiguousarray(
+        np.asarray(mine.data.into_data(), dtype=np.complex128)
     )
-    out = contract_tensor_network(pair, ContractionPath.simple([(0, 1)]), backend="numpy")
+    local_meta = (list(mine.legs), list(mine.bond_dims))
+else:
+    local, local_meta = None, None
+
+# gather: every non-root partition's (legs, dims, data) travels to
+# process 0, one broadcast round per source — the reduce direction of
+# the reference's scatter/contract/reduce pipeline
+collected = {0: (local_meta, local)} if pid == 0 else {}
+for src in range(1, k):
+    obj = broadcast_object(
+        (local_meta, local) if pid == src else None, root=src
+    )
+    if pid == 0:
+        collected[src] = obj
+print(f"proc {pid}: fan-in collectives done", flush=True)
+
+if pid == 0:
+    leaves = []
+    for i in range(k):
+        (legs, dims), data = collected[i]
+        leaves.append(
+            LeafTensor(legs, dims, TensorData.matrix(np.asarray(data).reshape(dims)))
+        )
+    toplevel = CompositeTensor(leaves)
+    out = contract_tensor_network(
+        toplevel, ContractionPath.simple(path.toplevel), backend="numpy"
+    )
     got = complex(np.asarray(out.data.into_data()).reshape(-1)[0])
 
     flat = Greedy(OptMethod.GREEDY).find_path(tn)

@@ -1,13 +1,17 @@
-"""Real 2-process distributed test — the ``#[mpi_test(2)]`` analogue
-(reference ``tnc/tests/integration_tests.rs:88-119``): two OS processes
-under ``jax.distributed.initialize`` exercise ``broadcast_path``'s
-multi-host branch and a cross-process partitioned fan-in."""
+"""Real multi-process distributed tests — the ``#[mpi_test(2)]`` and
+``#[mpi_test(4)]`` analogues (reference
+``tnc/tests/integration_tests.rs:88-167``): OS processes under
+``jax.distributed.initialize`` exercise ``broadcast_path``'s multi-host
+branch and the full scatter / local-contract / reduce pipeline across
+process boundaries (4 oversubscribed processes on one host, like the
+reference's oversubscribed MPI ranks)."""
 
 import os
 import socket
 import subprocess
 import sys
 
+import pytest
 
 
 def _free_port() -> int:
@@ -16,7 +20,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_broadcast_and_fanin():
+def _run_workers(nprocs: int, timeout: float) -> list[str]:
     port = _free_port()
     here = os.path.dirname(os.path.abspath(__file__))
     worker = os.path.join(here, "_multihost_worker.py")
@@ -28,19 +32,19 @@ def test_two_process_broadcast_and_fanin():
     env["JAX_PLATFORMS"] = "cpu"
     procs = [
         subprocess.Popen(
-            [sys.executable, worker, str(pid), "2", str(port)],
+            [sys.executable, worker, str(pid), str(nprocs), str(port)],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
             env=env,
             cwd=os.path.dirname(here),
         )
-        for pid in range(2)
+        for pid in range(nprocs)
     ]
     outs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
@@ -50,3 +54,18 @@ def test_two_process_broadcast_and_fanin():
         assert p.returncode == 0, f"proc {pid} failed:\n{out}"
         assert "broadcast_path ok" in out, out
         assert "MULTIHOST OK" in out, out
+    return outs
+
+
+def test_two_process_broadcast_and_fanin():
+    _run_workers(2, timeout=240)
+
+
+@pytest.mark.slow
+def test_four_process_scatter_contract_reduce():
+    """4 processes on one host (oversubscribed, reference
+    ``integration_tests.rs:121-167``): plan on rank 0, broadcast, local
+    contractions everywhere, partition results gathered across process
+    boundaries, toplevel fan-in + oracle check on rank 0."""
+    outs = _run_workers(4, timeout=360)
+    assert "fan-in collectives done" in outs[0]

@@ -38,16 +38,47 @@ def partitioned_case():
     return tn, ptn, ppath, oracle
 
 
-def test_budget_forces_partition_slicing(partitioned_case):
+def test_budget_forces_partition_slicing():
+    """Clusters with internal structure slice for real under a budget
+    (multi-slice programs, not 1-slice wraps)."""
+    import jax
+
+    from tests._cluster_fixture import cluster_chain
+
+    tn = cluster_chain(k=4, m=7, bond=2, seed=0)
+    parts = find_partitioning(tn, 4)
+    ptn, ppath, _, _ = compute_solution(tn, parts, rng=random.Random(7))
+    comm, _ = scatter_partitions(
+        ptn, ppath, jax.devices()[:4], "complex64", False, hbm_bytes=1 << 18
+    )
+    sliced = [p for p in comm.programs if isinstance(p, SlicedProgram)]
+    assert sliced
+    assert all(p.slicing.num_slices > 1 for p in sliced)
+
+
+def test_budget_on_boundary_bound_partition_runs_unsliced(partitioned_case, caplog):
+    """A circuit partition whose peak is its own cut boundary has no
+    sliceable closed legs: the scatter must NOT wrap a fake 1-slice
+    program, it runs unsliced and says why (the global-slicing
+    composition is the right tool there)."""
+    import logging
+
     import jax
 
     _, ptn, ppath, _ = partitioned_case
-    devices = jax.devices()[:4]
-    # a deliberately tiny budget: every nontrivial partition must slice
-    comm, _ = scatter_partitions(
-        ptn, ppath, devices, "complex64", False, hbm_bytes=2 << 20
-    )
-    assert any(isinstance(p, SlicedProgram) for p in comm.programs)
+    with caplog.at_level(logging.WARNING, logger="tnc_tpu.parallel.partitioned"):
+        comm, _ = scatter_partitions(
+            ptn, ppath, jax.devices()[:4], "complex64", False, hbm_bytes=1 << 12
+        )
+    for p in comm.programs:
+        assert not (isinstance(p, SlicedProgram) and p.slicing.num_slices == 1)
+    # the honest path actually fired: at least one partition exceeded the
+    # budget and was declared unsliceable, with the pointer to global
+    # slicing in the message
+    assert any(
+        "running unsliced" in rec.message and "global" in rec.message
+        for rec in caplog.records
+    ), [rec.message for rec in caplog.records]
 
 
 def test_partitioned_sliced_matches_oracle(partitioned_case):

@@ -159,3 +159,29 @@ def test_validate_path():
     assert validate_path(good, 3)
     bad = path((0, 1), (1, 2))  # uses consumed tensor 1
     assert not validate_path(bad, 3)
+
+
+def test_hyper_trials_parallel_matches_serial(monkeypatch):
+    """The spawn-pool trial runner (VERDICT r3 #8) must reproduce the
+    serial winner exactly — trial t always draws from Random(seed+t) and
+    results merge by trial index, so worker count cannot change the
+    outcome."""
+    import numpy as np
+
+    from tnc_tpu.builders.connectivity import ConnectivityLayout
+    from tnc_tpu.builders.random_circuit import random_circuit
+    from tnc_tpu.contractionpath.paths.hyper import Hyperoptimizer
+
+    rng = np.random.default_rng(21)
+    tn = random_circuit(
+        16, 8, 0.4, 0.4, rng, ConnectivityLayout.SYCAMORE, bitstring="0" * 16
+    )
+    opt = dict(ntrials=6, seed=3, polish_rounds=0, reconfigure_rounds=1)
+
+    monkeypatch.setenv("TNC_TPU_HYPER_WORKERS", "1")
+    serial = Hyperoptimizer(**opt).find_path(tn)
+    monkeypatch.setenv("TNC_TPU_HYPER_WORKERS", "2")
+    parallel = Hyperoptimizer(**opt).find_path(tn)
+
+    assert serial.ssa_path.toplevel == parallel.ssa_path.toplevel
+    assert serial.flops == parallel.flops

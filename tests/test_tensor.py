@@ -106,3 +106,35 @@ def test_nested_tensor_and_count():
     tn = CompositeTensor([inner, LeafTensor.from_map([2], bd)])
     assert tn.nested_tensor([0, 1]).legs == [1]
     assert tn.total_num_tensors() == 3
+
+
+def test_allclose_absdiffeq_surface():
+    """AbsDiffEq equivalent (tensor.rs:417-435,779-820): structure AND
+    materialized data within tolerance."""
+    import numpy as np
+
+    from tnc_tpu.tensornetwork.tensordata import TensorData
+
+    data = np.arange(4, dtype=np.complex128).reshape(2, 2)
+    a = LeafTensor([0, 1], [2, 2], TensorData.matrix(data))
+    b = LeafTensor([0, 1], [2, 2], TensorData.matrix(data + 1e-14))
+    c = LeafTensor([0, 1], [2, 2], TensorData.matrix(data + 1e-3))
+    assert a.allclose(b)
+    assert not a.allclose(c)
+    assert a.allclose(c, rtol=1.0)  # tolerances are caller-controlled
+
+    # structural mismatch loses regardless of data
+    d = LeafTensor([0, 2], [2, 2], TensorData.matrix(data))
+    assert not a.allclose(d)
+
+    # metadata-only tensors compare by structure alone
+    m1 = LeafTensor.from_const([0, 1], 2)
+    m2 = LeafTensor.from_const([0, 1], 2)
+    assert m1.allclose(m2)
+    assert not m1.allclose(a)  # one symbolic, one materialized
+    assert not a.allclose("not a tensor")  # type: ignore[arg-type]
+
+    # gate-backed data materializes through the registry
+    g1 = LeafTensor([0, 1], [2, 2], TensorData.gate("h"))
+    g2 = LeafTensor([0, 1], [2, 2], TensorData.gate("h"))
+    assert g1.allclose(g2)

@@ -56,3 +56,21 @@ class ArtifactCache:
 
     def has(self, key: str) -> bool:
         return self._path(key).exists()
+
+    def store_obj(self, key: str, obj: object) -> None:
+        """Generic artifact (e.g. a sliced plan): same zlib+pickle wire
+        format and atomic-replace discipline as :meth:`store`."""
+        blob = zlib.compress(pickle.dumps(obj), level=6)
+        target = self._path(key)
+        tmp = target.with_suffix(".tmp")
+        tmp.write_bytes(blob)
+        tmp.replace(target)
+
+    def load_obj(self, key: str) -> object | None:
+        target = self._path(key)
+        if not target.exists():
+            return None
+        try:
+            return pickle.loads(zlib.decompress(target.read_bytes()))
+        except Exception:
+            return None  # corrupt/partial artifact: replan rather than die

@@ -178,6 +178,8 @@ def jit_program(
     :meth:`JaxBackend.execute_batched`)."""
     import jax
 
+    from tnc_tpu.ops.split_complex import complex_mult_env
+
     if not split_complex:
         precision = None  # only the split path consumes it: one cache key
     key = (
@@ -186,6 +188,7 @@ def jit_program(
         precision,
         donate,
         lanemix_env(),
+        complex_mult_env() if split_complex else None,
         batched,
     )
     with _PROGRAM_JIT_CACHE_LOCK:
@@ -399,6 +402,8 @@ class JaxBackend(Backend):
                 host=host,
             )
 
+        from tnc_tpu.ops.split_complex import complex_mult_env
+
         key = (
             "sliced",
             sp.signature(),
@@ -407,6 +412,7 @@ class JaxBackend(Backend):
             max_slices,
             self.loop_unroll,
             lanemix_env(),
+            complex_mult_env() if self.split_complex else None,
         )
         fn = self._cache.get(key)
         if fn is None:

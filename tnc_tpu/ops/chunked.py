@@ -36,7 +36,7 @@ import numpy as np
 
 from tnc_tpu.ops.backends import apply_step, place_buffers
 from tnc_tpu.ops.program import ContractionProgram, PairStep
-from tnc_tpu.ops.sliced import SlicedProgram, index_buffer
+from tnc_tpu.ops.sliced import SlicedProgram, index_buffer, kahan_add
 
 
 @dataclass(frozen=True)
@@ -122,6 +122,7 @@ def _compiled_plan(
     import jax.numpy as jnp
 
     from tnc_tpu.ops.backends import lanemix_env
+    from tnc_tpu.ops.split_complex import complex_mult_env
 
     key = (
         sp.signature(),
@@ -130,6 +131,7 @@ def _compiled_plan(
         split_complex,
         precision,
         lanemix_env(),
+        complex_mult_env() if split_complex else None,
     )
     with _PLAN_CACHE_LOCK:
         hit = _PLAN_CACHE.get(key)
@@ -233,7 +235,11 @@ def _compiled_plan(
 
         if ci == last_ci:
             # the only slot alive after the final chunk is the result:
-            # fold the batch-sum + accumulate into the same dispatch
+            # fold the batch-sum + compensated accumulate into the same
+            # dispatch. The accumulator is a Kahan (sum, comp) pair per
+            # part: thousands of batch contributions cancel to far below
+            # the individual terms, where plain f32 accumulation loses
+            # the 1e-5 parity target (VERDICT r3 #2).
             out_pos = chunk.out_slots.index(result_slot)
             res_batched = (
                 result_slot in batched_after_chunk[ci] and is_batched_chunk
@@ -250,12 +256,12 @@ def _compiled_plan(
                         im = jnp.sum(out[1], axis=0)
                     else:  # slice-independent result: b identical terms
                         re, im = out[0] * b, out[1] * b
-                    return (
-                        acc[0] + re.reshape(result_shape),
-                        acc[1] + im.reshape(result_shape),
-                    )
+                    (sr, cr), (si, ci_) = acc
+                    sr, cr = kahan_add(sr, cr, re.reshape(result_shape))
+                    si, ci_ = kahan_add(si, ci_, im.reshape(result_shape))
+                    return ((sr, cr), (si, ci_))
                 s = jnp.sum(out, axis=0) if _rb else out * b
-                return acc + s.reshape(result_shape)
+                return kahan_add(acc[0], acc[1], s.reshape(result_shape))
 
             fn = jax.jit(last_fn)
         else:
@@ -399,11 +405,6 @@ def run_sliced_chunked_placed(
             return jnp.zeros(stored_shape, dtype=dt, device=device)
         return jnp.zeros(stored_shape, dtype=dt)
 
-    if split_complex:
-        acc = (zeros(part_dtype), zeros(part_dtype))
-    else:
-        acc = zeros(dtype)
-
     if not chunks:
         # zero-step program: the result is the (sliced) leaf itself —
         # sum its first `num` slices in one dispatch
@@ -417,11 +418,17 @@ def run_sliced_chunked_placed(
         fn = jax.jit(leaf_sum)
         leaf = device_full[sp.program.result_slot]
         if split_complex:
-            return (
-                acc[0] + fn(leaf[0], idx_all),
-                acc[1] + fn(leaf[1], idx_all),
-            )
-        return acc + fn(leaf, idx_all)
+            return (fn(leaf[0], idx_all), fn(leaf[1], idx_all))
+        return fn(leaf, idx_all)
+
+    # Kahan (sum, comp) accumulator per part; finalized to sum+comp below
+    if split_complex:
+        acc = (
+            (zeros(part_dtype), zeros(part_dtype)),
+            (zeros(part_dtype), zeros(part_dtype)),
+        )
+    else:
+        acc = (zeros(dtype), zeros(dtype))
 
     last_ci = len(chunks) - 1
     for start in range(0, num, batch):
@@ -440,4 +447,8 @@ def run_sliced_chunked_placed(
                     state[slot] = buf
                 for step in chunk.steps:
                     state.pop(step.rhs, None)
-    return acc
+    # fold the compensation in (two tiny dispatches, untimed-scale cost)
+    if split_complex:
+        (sr, cr), (si, ci) = acc
+        return (sr + cr, si + ci)
+    return acc[0] + acc[1]

@@ -129,8 +129,19 @@ def _leaf_arrays(child: CompositeTensor) -> list[np.ndarray]:
 def _slice_partition(child: CompositeTensor, nested: ContractionPath, hbm_bytes: int):
     """Slice one partition's local path until its program fits the HBM
     budget. Returns a SlicedProgram (or None if the unsliced program
-    already fits)."""
-    from tnc_tpu.contractionpath.slicing import find_slicing
+    already fits, or nothing local slicing can do).
+
+    Uses slice-and-reconfigure (slicing interleaved with subtree
+    re-planning in the sliced size model) rather than plain greedy leg
+    picking: a fixed path's peak is often pinned by a single badly-
+    ordered step that reconfiguration dissolves once the sliced legs
+    have dim 1. The returned ``SlicedProgram``'s program may therefore
+    follow a DIFFERENT (better) local path than ``nested`` — downstream
+    fan-in metadata must come from ``sp.program.result_legs`` (it does:
+    ``scatter_partitions`` builds metas from the program).
+    """
+    from tnc_tpu.contractionpath.contraction_path import replace_ssa_ordering
+    from tnc_tpu.contractionpath.slicing import slice_and_reconfigure
     from tnc_tpu.ops.budget import fits_hbm, program_peak_bytes
     from tnc_tpu.ops.sliced import build_sliced_program
 
@@ -144,26 +155,50 @@ def _slice_partition(child: CompositeTensor, nested: ContractionPath, hbm_bytes:
         )
     inputs = [t for t in child.tensors if isinstance(t, LeafTensor)]
     est = program_peak_bytes(program)
-    # element targets, descending from the current peak: first slicing
-    # that fits the budget wins; keep the deepest achievable as best
-    # effort if even it does not fit (find_slicing raises when a target
-    # needs more slices than its cap).
-    target = 2.0 ** np.floor(np.log2(max(est.peak_bytes / 8.0, 2.0)))
+    ssa = replace_ssa_ordering(nested.toplevel, len(inputs))
+    # element targets, descending from a quarter of the current peak
+    # (~8 bytes per complex element; starting AT the peak would be a
+    # no-op): first slicing that fits the budget wins; keep the deepest
+    # achievable as best effort. A partition whose peak is its own
+    # open-leg output cannot be sliced locally at all — only GLOBAL
+    # slicing (cut legs sliceable) helps there.
+    target = 2.0 ** np.floor(np.log2(max(est.peak_bytes / 8.0 / 4.0, 2.0)))
     best = None
     while target >= 4:
         try:
-            slicing = find_slicing(inputs, nested.toplevel, target)
+            pairs, slicing = slice_and_reconfigure(
+                inputs, ssa, target,
+                reconf_rounds=1, step_budget=None,
+                final_rounds=2, final_budget=None,
+            )
         except ValueError:
             break
-        sp = build_sliced_program(child, nested, slicing)
+        if not slicing.legs:  # target above the current peak: no-op
+            target /= 4.0
+            continue
+        sp = build_sliced_program(child, ContractionPath.simple(pairs), slicing)
         best = sp
         if fits_hbm(sp.program, hbm_bytes=hbm_bytes):
             break
         target /= 4.0
     if best is None:
-        raise ValueError(
-            "partition cannot be sliced to the HBM budget "
-            f"({hbm_bytes} bytes)"
+        # nothing sliceable (open-leg-bound peak): run unsliced rather
+        # than wrap a fake 1-slice program as success
+        logger.warning(
+            "partition peak %.3g bytes exceeds the %d-byte budget but has "
+            "no sliceable (closed) legs; running unsliced — use global "
+            "slicing (partitioned_sliced_executor) to slice cut legs",
+            est.peak_bytes,
+            hbm_bytes,
+        )
+        return None
+    if not fits_hbm(best.program, hbm_bytes=hbm_bytes):
+        logger.warning(
+            "partition sliced best-effort (%d legs, %d slices) but still "
+            "exceeds the %d-byte budget",
+            len(best.slicing.legs),
+            best.slicing.num_slices,
+            hbm_bytes,
         )
     logger.debug(
         "partition sliced: %d legs, %d slices",
@@ -648,6 +683,37 @@ def partitioned_sliced_executor(
     return run, slicing, final_meta
 
 
+def broadcast_object(obj, root: int = 0):
+    """Broadcast any picklable object from host process ``root`` to all
+    processes — the generic transport under :func:`broadcast_path` and
+    the cross-process fan-in (the reference's serialized MPI broadcast,
+    ``mpi/communication.rs:14-28``: length-prefix phase, then payload).
+
+    Identity when running single-process; non-root processes pass any
+    value (it is ignored) and receive root's object.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return obj
+
+    import pickle
+
+    from jax.experimental import multihost_utils
+
+    is_root = jax.process_index() == root
+    payload = pickle.dumps(obj) if is_root else b""
+    # length-prefix phase (the reference broadcasts the length first)
+    length = int(
+        multihost_utils.broadcast_one_to_all(
+            np.int64(len(payload)), is_source=is_root
+        )
+    )
+    buf = np.frombuffer(payload.ljust(length, b"\0"), dtype=np.uint8)
+    data = multihost_utils.broadcast_one_to_all(buf, is_source=is_root)
+    return pickle.loads(np.asarray(data).tobytes())
+
+
 def broadcast_path(path_: ContractionPath, root: int = 0) -> ContractionPath:
     """Share the planner's path with every host process
     (``broadcast_path``, ``communication.rs:32-49``).
@@ -659,27 +725,7 @@ def broadcast_path(path_: ContractionPath, root: int = 0) -> ContractionPath:
     global mesh, the analogue of the reference's two-phase MPI vec
     broadcast (``communication.rs:14-28``).
     """
-    import jax
-
-    if jax.process_count() == 1:
-        return path_
-
-    import pickle
-
-    from jax.experimental import multihost_utils
-
-    payload = pickle.dumps(path_) if jax.process_index() == root else b""
-    # length-prefix phase (the reference broadcasts the length first)
-    length = int(
-        multihost_utils.broadcast_one_to_all(
-            np.int64(len(payload)), is_source=jax.process_index() == root
-        )
-    )
-    buf = np.frombuffer(payload.ljust(length, b"\0"), dtype=np.uint8)
-    data = multihost_utils.broadcast_one_to_all(
-        buf, is_source=jax.process_index() == root
-    )
-    return pickle.loads(np.asarray(data).tobytes())
+    return broadcast_object(path_, root=root)
 
 
 # Reference-named aliases (``mpi/communication.rs:125,199``): the TPU

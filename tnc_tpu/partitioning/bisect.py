@@ -251,25 +251,112 @@ def bisect(
     return part
 
 
+def kway_refine_km1(
+    hg: Hypergraph,
+    part: list[int],
+    k: int,
+    imbalance: float = 0.03,
+    max_passes: int = 8,
+) -> None:
+    """Direct k-way move-based refinement under the connectivity (km1)
+    objective ``sum_e w_e * (lambda_e - 1)``, in place.
+
+    This is where the km1 preset genuinely diverges from cut-based
+    recursive bisection: in any 2-way split ``lambda - 1`` equals the
+    cut indicator, so only a k-way pass can tell the objectives apart —
+    the same reason KaHyPar ships cut and km1 as distinct configs
+    (``tnc/src/tensornetwork/partition_config.rs:12-36``). Python
+    oracle of the native ``kway_refine_km1`` (``native/partitioner.cpp``).
+    """
+    n = hg.num_vertices
+    if k <= 1 or n <= 1:
+        return
+    maxb = hg.total_vertex_weight() / k * (1.0 + imbalance)
+    pins_in = [[0] * k for _ in hg.edge_pins]
+    for e, pins in enumerate(hg.edge_pins):
+        for v in pins:
+            pins_in[e][part[v]] += 1
+    block_w = [0.0] * k
+    for v in range(n):
+        block_w[part[v]] += hg.vertex_weights[v]
+
+    for _pass in range(max_passes):
+        moved = False
+        for v in range(n):
+            a = part[v]
+            remove_gain = sum(
+                hg.edge_weights[e]
+                for e in hg.vertex_edges[v]
+                if pins_in[e][a] == 1
+            )
+            best_b = -1
+            best_gain = 1e-12
+            tried = {a}
+            for e in hg.vertex_edges[v]:
+                for u in hg.edge_pins[e]:
+                    b = part[u]
+                    if b in tried:
+                        continue
+                    tried.add(b)
+                    gain = remove_gain - sum(
+                        hg.edge_weights[e2]
+                        for e2 in hg.vertex_edges[v]
+                        if pins_in[e2][b] == 0
+                    )
+                    if (
+                        gain > best_gain
+                        and block_w[b] + hg.vertex_weights[v] <= maxb
+                    ):
+                        best_gain = gain
+                        best_b = b
+            if best_b < 0:
+                continue
+            for e in hg.vertex_edges[v]:
+                pins_in[e][a] -= 1
+                pins_in[e][best_b] += 1
+            block_w[a] -= hg.vertex_weights[v]
+            block_w[best_b] += hg.vertex_weights[v]
+            part[v] = best_b
+            moved = True
+        if not moved:
+            break
+
+
 def partition_kway(
     hg: Hypergraph,
     k: int,
     imbalance: float = 0.03,
     rng: random.Random | None = None,
+    objective: str = "cut",
+    refine_passes: int = 8,
 ) -> list[int]:
     """Recursive-bisection k-way partitioning (KaHyPar's RB mode).
 
     Dispatches to the native C++ partitioner when available (same
     algorithm family, much faster on large networks); this Python
-    implementation is the oracle and fallback.
+    implementation is the oracle and fallback. ``objective='km1'``
+    appends a direct k-way connectivity-refinement pass — the two
+    presets the reference embeds as distinct KaHyPar configs.
     """
+    if objective not in ("cut", "km1"):
+        raise ValueError(f"unknown partition objective {objective!r}")
     if rng is None:
         rng = random.Random(42)
 
-    from tnc_tpu.partitioning.native_binding import native_partition_kway
+    from tnc_tpu.partitioning.native_binding import (
+        native_kway_refine_km1,
+        native_partition_kway,
+    )
 
     native = native_partition_kway(hg, k, imbalance, rng.getrandbits(63))
     if native is not None:
+        if objective == "km1":
+            refined = native_kway_refine_km1(
+                hg, native, k, imbalance, max_passes=refine_passes
+            )
+            if refined is not None:
+                return refined
+            kway_refine_km1(hg, native, k, imbalance, max_passes=refine_passes)
         return native
 
     part = [0] * hg.num_vertices
@@ -308,4 +395,6 @@ def partition_kway(
         recurse(right, k_right, base + k_left)
 
     recurse(list(range(hg.num_vertices)), k, 0)
+    if objective == "km1":
+        kway_refine_km1(hg, part, k, imbalance, max_passes=refine_passes)
     return part

@@ -47,6 +47,22 @@ class Hypergraph:
                 cut += w
         return cut
 
+    def km1_weight(self, partition: Sequence[int]) -> float:
+        """Connectivity metric ``sum_e w_e * (lambda_e - 1)`` where
+        ``lambda_e`` counts the blocks edge ``e`` touches — KaHyPar's
+        km1 objective, the second preset the reference embeds
+        (``tnc/src/tensornetwork/partition_config.rs:12-36``). Equals
+        :meth:`cut_weight` for 2 blocks; diverges for k > 2, where it
+        additionally penalizes edges *scattered across many* blocks
+        (each extra block touched is one more fan-in transfer of that
+        bond in the distributed runtime)."""
+        total = 0.0
+        for pins, w in zip(self.edge_pins, self.edge_weights):
+            lam = len({partition[v] for v in pins})
+            if lam > 1:
+                total += w * (lam - 1)
+        return total
+
 
 def hypergraph_from_tensors(
     tensors: Sequence[LeafTensor | CompositeTensor],

@@ -326,6 +326,109 @@ void partition_recurse(const Hypergraph& hg, const std::vector<int>& vertices,
     partition_recurse(hg, right, k_right, base + k_left, imbalance, rng, part);
 }
 
+// Direct k-way move-based refinement under the connectivity (km1)
+// objective: sum_e w_e * (lambda_e - 1), lambda_e = #blocks edge e
+// touches. This is where the km1 preset genuinely diverges from
+// cut-based recursive bisection — in any 2-way split lambda-1 equals
+// the cut indicator, so only a k-way pass can tell them apart (the
+// same reason KaHyPar ships cut and km1 as distinct configs,
+// tnc/src/tensornetwork/partition_config.rs:12-36).
+void kway_refine_km1(const Hypergraph& hg, std::vector<int>& part, int k,
+                     double imbalance, int max_passes = 8) {
+    const int n = hg.n;
+    if (k <= 1 || n <= 1) return;
+    const double target = hg.total_vertex_weight() / (double)k;
+    const double maxb = target * (1.0 + imbalance);
+
+    std::vector<std::vector<int>> pins_in(hg.edge_pins.size(),
+                                          std::vector<int>(k, 0));
+    for (int e = 0; e < (int)hg.edge_pins.size(); ++e)
+        for (int v : hg.edge_pins[e]) pins_in[e][part[v]]++;
+    std::vector<double> block_w(k, 0.0);
+    for (int v = 0; v < n; ++v) block_w[part[v]] += hg.vertex_weights[v];
+
+    std::vector<char> tried(k, 0);
+    for (int pass = 0; pass < max_passes; ++pass) {
+        bool moved = false;
+        for (int v = 0; v < n; ++v) {
+            const int a = part[v];
+            // candidate target blocks: only blocks adjacent through v's
+            // edges can have positive gain
+            double remove_gain = 0.0;
+            for (int e : hg.vertex_edges[v])
+                if (pins_in[e][a] == 1) remove_gain += hg.edge_weights[e];
+            int best_b = -1;
+            double best_gain = 1e-12;
+            std::fill(tried.begin(), tried.end(), 0);
+            tried[a] = 1;
+            for (int e : hg.vertex_edges[v]) {
+                for (int u : hg.edge_pins[e]) {
+                    int b = part[u];
+                    if (tried[b]) continue;
+                    tried[b] = 1;
+                    double gain = remove_gain;
+                    for (int e2 : hg.vertex_edges[v])
+                        if (pins_in[e2][b] == 0) gain -= hg.edge_weights[e2];
+                    if (gain > best_gain &&
+                        block_w[b] + hg.vertex_weights[v] <= maxb) {
+                        best_gain = gain;
+                        best_b = b;
+                    }
+                }
+            }
+            if (best_b < 0) continue;
+            for (int e : hg.vertex_edges[v]) {
+                pins_in[e][a]--;
+                pins_in[e][best_b]++;
+            }
+            block_w[a] -= hg.vertex_weights[v];
+            block_w[best_b] += hg.vertex_weights[v];
+            part[v] = best_b;
+            moved = true;
+        }
+        if (!moved) break;
+    }
+}
+
+double km1_weight(const Hypergraph& hg, const std::vector<int>& part, int k) {
+    double total = 0.0;
+    std::vector<char> seen(k, 0);
+    for (int e = 0; e < (int)hg.edge_pins.size(); ++e) {
+        std::fill(seen.begin(), seen.end(), 0);
+        int lambda = 0;
+        for (int v : hg.edge_pins[e])
+            if (!seen[part[v]]) {
+                seen[part[v]] = 1;
+                ++lambda;
+            }
+        if (lambda > 1) total += hg.edge_weights[e] * (double)(lambda - 1);
+    }
+    return total;
+}
+
+Hypergraph hypergraph_from_csr(int num_vertices, const double* vertex_weights,
+                               int num_edges, const int* edge_offsets,
+                               const int* edge_pins,
+                               const double* edge_weights, bool* ok) {
+    Hypergraph hg;
+    *ok = false;
+    if (num_vertices < 0 || num_edges < 0) return hg;
+    hg.n = num_vertices;
+    hg.vertex_weights.assign(vertex_weights, vertex_weights + num_vertices);
+    hg.edge_pins.resize(num_edges);
+    hg.edge_weights.assign(edge_weights, edge_weights + num_edges);
+    for (int e = 0; e < num_edges; ++e) {
+        int beg = edge_offsets[e], end = edge_offsets[e + 1];
+        if (beg > end) return hg;
+        hg.edge_pins[e].assign(edge_pins + beg, edge_pins + end);
+        for (int v : hg.edge_pins[e])
+            if (v < 0 || v >= num_vertices) return hg;
+    }
+    hg.build_incidence();
+    *ok = true;
+    return hg;
+}
+
 }  // namespace
 
 extern "C" {
@@ -337,20 +440,12 @@ int tnc_partition_kway(int num_vertices, const double* vertex_weights,
                        const int* edge_pins, const double* edge_weights,
                        int k, double imbalance, uint64_t seed,
                        int* out_partition) {
-    if (num_vertices < 0 || num_edges < 0 || k <= 0) return 1;
-    Hypergraph hg;
-    hg.n = num_vertices;
-    hg.vertex_weights.assign(vertex_weights, vertex_weights + num_vertices);
-    hg.edge_pins.resize(num_edges);
-    hg.edge_weights.assign(edge_weights, edge_weights + num_edges);
-    for (int e = 0; e < num_edges; ++e) {
-        int beg = edge_offsets[e], end = edge_offsets[e + 1];
-        if (beg > end) return 1;
-        hg.edge_pins[e].assign(edge_pins + beg, edge_pins + end);
-        for (int v : hg.edge_pins[e])
-            if (v < 0 || v >= num_vertices) return 1;
-    }
-    hg.build_incidence();
+    if (k <= 0) return 1;
+    bool ok = false;
+    Hypergraph hg = hypergraph_from_csr(num_vertices, vertex_weights,
+                                        num_edges, edge_offsets, edge_pins,
+                                        edge_weights, &ok);
+    if (!ok) return 1;
 
     std::mt19937_64 rng(seed);
     std::vector<int> part(num_vertices, 0);
@@ -361,6 +456,44 @@ int tnc_partition_kway(int num_vertices, const double* vertex_weights,
     }
     std::memcpy(out_partition, part.data(), num_vertices * sizeof(int));
     return 0;
+}
+
+// Refine a k-way partition in place under the km1 (connectivity)
+// objective. `partition` is read and overwritten.
+int tnc_kway_refine_km1(int num_vertices, const double* vertex_weights,
+                        int num_edges, const int* edge_offsets,
+                        const int* edge_pins, const double* edge_weights,
+                        int k, double imbalance, int max_passes,
+                        int* partition) {
+    if (k <= 0) return 1;
+    bool ok = false;
+    Hypergraph hg = hypergraph_from_csr(num_vertices, vertex_weights,
+                                        num_edges, edge_offsets, edge_pins,
+                                        edge_weights, &ok);
+    if (!ok) return 1;
+    std::vector<int> part(partition, partition + num_vertices);
+    for (int v : part)
+        if (v < 0 || v >= k) return 1;
+    kway_refine_km1(hg, part, k, imbalance, max_passes);
+    std::memcpy(partition, part.data(), num_vertices * sizeof(int));
+    return 0;
+}
+
+// km1 (connectivity) metric of a partition: sum_e w_e * (lambda_e - 1).
+double tnc_km1_weight(int num_vertices, int num_edges,
+                      const int* edge_offsets, const int* edge_pins,
+                      const double* edge_weights, int k,
+                      const int* partition) {
+    bool ok = false;
+    std::vector<double> unit(num_vertices, 1.0);
+    Hypergraph hg = hypergraph_from_csr(num_vertices, unit.data(), num_edges,
+                                        edge_offsets, edge_pins, edge_weights,
+                                        &ok);
+    if (!ok || k <= 0) return -1.0;
+    std::vector<int> part(partition, partition + num_vertices);
+    for (int v : part)
+        if (v < 0 || v >= k) return -1.0;  // would index past seen[k]
+    return km1_weight(hg, part, k);
 }
 
 // Cut weight of a given partition (for tests/diagnostics).

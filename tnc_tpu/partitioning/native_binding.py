@@ -115,6 +115,30 @@ def load_native() -> ctypes.CDLL | None:
             ctypes.POINTER(ctypes.c_double),
             ctypes.POINTER(ctypes.c_int),
         ]
+        if hasattr(lib, "tnc_kway_refine_km1"):
+            lib.tnc_kway_refine_km1.restype = ctypes.c_int
+            lib.tnc_kway_refine_km1.argtypes = [
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.c_int,
+                ctypes.c_double,
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int),
+            ]
+            lib.tnc_km1_weight.restype = ctypes.c_double
+            lib.tnc_km1_weight.argtypes = [
+                ctypes.c_int,
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int),
+            ]
         if hasattr(lib, "tnc_optimal_order"):
             lib.tnc_optimal_order.restype = ctypes.c_int
             lib.tnc_optimal_order.argtypes = [
@@ -185,6 +209,76 @@ def native_partition_kway(
         out = np.empty(n, dtype=np.int32)
     assert best is not None
     return best.tolist()
+
+
+def _csr_arrays(hg: Hypergraph):
+    import numpy as np
+
+    m = len(hg.edge_pins)
+    offsets = np.zeros(m + 1, dtype=np.int32)
+    lengths = np.fromiter(
+        (len(e) for e in hg.edge_pins), dtype=np.int32, count=m
+    )
+    np.cumsum(lengths, out=offsets[1:])
+    pins = np.fromiter(
+        (v for e in hg.edge_pins for v in e),
+        dtype=np.int32,
+        count=int(offsets[-1]),
+    )
+    vw = np.asarray(hg.vertex_weights, dtype=np.float64)
+    ew = np.asarray(hg.edge_weights, dtype=np.float64)
+    return offsets, pins, vw, ew
+
+
+def native_kway_refine_km1(
+    hg: Hypergraph,
+    part: "list[int]",
+    k: int,
+    imbalance: float,
+    max_passes: int = 8,
+) -> list[int] | None:
+    """km1 (connectivity) k-way refinement via the C++ library; returns
+    the refined partition, or None when native is off/outdated."""
+    import numpy as np
+
+    lib = load_native()
+    if lib is None or not hasattr(lib, "tnc_kway_refine_km1"):
+        return None
+    offsets, pins, vw, ew = _csr_arrays(hg)
+    buf = np.asarray(part, dtype=np.int32).copy()
+    as_i32 = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_int))  # noqa: E731
+    as_f64 = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))  # noqa: E731
+    rc = lib.tnc_kway_refine_km1(
+        hg.num_vertices, as_f64(vw), len(hg.edge_pins), as_i32(offsets),
+        as_i32(pins), as_f64(ew), k, ctypes.c_double(imbalance),
+        int(max_passes), as_i32(buf),
+    )
+    if rc != 0:
+        return None
+    return buf.tolist()
+
+
+def native_km1_weight(
+    hg: Hypergraph, part: "list[int]", k: int
+) -> float | None:
+    """km1 (connectivity) metric via the C++ library; None when native
+    is off/outdated or the partition is invalid (values outside 0..k)."""
+    import numpy as np
+
+    lib = load_native()
+    if lib is None or not hasattr(lib, "tnc_km1_weight"):
+        return None
+    offsets, pins, _vw, ew = _csr_arrays(hg)
+    buf = np.asarray(part, dtype=np.int32)
+    as_i32 = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_int))  # noqa: E731
+    as_f64 = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))  # noqa: E731
+    out = float(
+        lib.tnc_km1_weight(
+            hg.num_vertices, len(hg.edge_pins), as_i32(offsets), as_i32(pins),
+            as_f64(ew), k, as_i32(buf),
+        )
+    )
+    return None if out < 0 else out
 
 
 def native_optimal_order(

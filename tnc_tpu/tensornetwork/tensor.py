@@ -169,6 +169,38 @@ class LeafTensor:
 
     # -- equality / repr ---------------------------------------------------
 
+    def allclose(
+        self,
+        other: "LeafTensor",
+        rtol: float = 1e-8,
+        atol: float = 1e-12,
+    ) -> bool:
+        """Approximate equality: same legs/bond dims AND elementwise-close
+        materialized data — the ``AbsDiffEq``/``RelativeEq`` surface the
+        reference implements for tensors
+        (``tnc/src/tensornetwork/tensor.rs:417-435,779-820``). Tensors
+        whose data is symbolic (:class:`~tnc_tpu.tensornetwork.tensordata.
+        TensorData` gate/file refs) are materialized for the comparison;
+        two data-less tensors compare by structure alone.
+        """
+        if not isinstance(other, LeafTensor):
+            return False
+        if self.legs != other.legs or self.bond_dims != other.bond_dims:
+            return False
+        import numpy as np
+
+        from tnc_tpu.tensornetwork.tensordata import DataKind
+
+        a_none = self.data.kind is DataKind.NONE
+        b_none = other.data.kind is DataKind.NONE
+        if a_none or b_none:
+            return a_none and b_none  # metadata-only: structure decides
+        a = np.asarray(self.data.into_data())
+        b = np.asarray(other.data.into_data())
+        return a.shape == b.shape and bool(
+            np.allclose(a, b, rtol=rtol, atol=atol)
+        )
+
     def __eq__(self, other: object) -> bool:
         if not isinstance(other, LeafTensor):
             return NotImplemented
