@@ -335,11 +335,15 @@ def bench_sycamore_amplitude():
             extra["extrapolated_from_slices"] = probe
             log(f"[bench] extrapolated full wall-clock: {tpu_s:.1f}s")
 
-    # first D2H of the process: everything after this line is untimed
+    # trace BEFORE the first D2H: the tunnel's first device->host fetch
+    # permanently degrades dispatch ~430x (TPU_EVIDENCE_r03.md), so a
+    # trace taken after it would profile the degraded regime. The
+    # trace's own final fetch is the process's first D2H instead.
+    _maybe_trace(backend, sp, arrays, probe, extra)
+
+    # everything after this line is untimed
     amplitude = complex(_fetch_device_result(backend, amp).reshape(-1)[0])
     log(f"[bench] amplitude (partial sum ok): {amplitude}")
-
-    _maybe_trace(backend, sp, arrays, probe, extra)
 
     # -- achieved throughput / MFU -----------------------------------------
     import jax
