@@ -27,6 +27,7 @@ SURFACE = {
         "communication_partitioning",
         "partition_tensor_network",
         "PartitioningStrategy",
+        "PartitionConfig",
     ],
     "tnc_tpu.contractionpath": [
         "ContractionPath",
@@ -85,6 +86,8 @@ SURFACE = {
     ],
     "tnc_tpu.parallel.partitioned": [
         "broadcast_path",
+        "broadcast_serializing",
+        "broadcast_object",
         "scatter_tensor_network",
         "intermediate_reduce_tensor_network",
         "Communication",

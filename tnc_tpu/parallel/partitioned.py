@@ -733,3 +733,6 @@ def broadcast_path(path_: ContractionPath, root: int = 0) -> ContractionPath:
 # device-mesh model.
 scatter_tensor_network = scatter_partitions
 intermediate_reduce_tensor_network = intermediate_reduce
+# the reference's generic serialized broadcast (``broadcast_serializing``,
+# ``mpi/communication.rs:14-28``) — any picklable object from root to all
+broadcast_serializing = broadcast_object
