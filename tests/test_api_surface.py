@@ -14,6 +14,8 @@ SURFACE = {
         "Tensor",
         "CompositeTensor",
         "LeafTensor",
+        "TensorType",
+        "TensorList",
         "EdgeIndex",
         "TensorIndex",
     ],
@@ -32,6 +34,7 @@ SURFACE = {
     "tnc_tpu.contractionpath": [
         "ContractionPath",
         "SimplePath",
+        "SimplePathRef",
         "path",
         "ssa_ordering",
         "ssa_replace_ordering",

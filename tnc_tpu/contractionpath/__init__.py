@@ -1,7 +1,9 @@
 from tnc_tpu.contractionpath.contraction_path import (  # noqa: F401
     ContractionPath,
     SimplePath,
+    SimplePathRef,
     path,
+    replace_ssa_ordering,
     ssa_ordering,
     ssa_replace_ordering,
 )

@@ -22,6 +22,9 @@ from dataclasses import dataclass, field
 from typing import Sequence
 
 SimplePath = list  # list[tuple[int, int]]
+# read-only view alias (``SimplePathRef``, ``contractionpath.rs:22``) —
+# Python callers accept any sequence of pairs where Rust takes a slice
+SimplePathRef = Sequence  # Sequence[tuple[int, int]]
 
 
 @dataclass

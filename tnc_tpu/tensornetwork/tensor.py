@@ -23,6 +23,7 @@ time (``tensordata.rs:37-56``).
 
 from __future__ import annotations
 
+import enum
 from typing import Iterable, Iterator, Mapping, Sequence, Union
 
 from tnc_tpu.tensornetwork.tensordata import TensorData
@@ -32,6 +33,15 @@ EdgeIndex = int
 TensorIndex = int
 
 Tensor = Union["LeafTensor", "CompositeTensor"]
+# any sequence of tensors (the ``TensorList`` trait, ``tensor.rs:134``)
+TensorList = Sequence["Tensor"]
+
+
+class TensorType(enum.Enum):
+    """The type of a tensor (``tensor.rs:37-41``)."""
+
+    COMPOSITE = "composite"
+    LEAF = "leaf"
 
 
 class LeafTensor:
@@ -99,6 +109,9 @@ class LeafTensor:
 
     def edges(self) -> Iterator[tuple[EdgeIndex, int]]:
         return zip(self.legs, self.bond_dims)
+
+    def kind(self) -> TensorType:
+        return TensorType.LEAF
 
     def is_leaf(self) -> bool:
         return True
@@ -241,6 +254,9 @@ class CompositeTensor:
 
     def push_tensors(self, tensors: Iterable[Tensor]) -> None:
         self.tensors.extend(tensors)
+
+    def kind(self) -> TensorType:
+        return TensorType.COMPOSITE
 
     def is_leaf(self) -> bool:
         return False
