@@ -95,3 +95,29 @@ def test_random_network_sliced_consistency(seed):
     ga = np.asarray(got.data.into_data())
     denom = max(float(np.max(np.abs(wa))), 1e-30)
     assert float(np.max(np.abs(ga - wa))) / denom < 1e-10, seed
+
+
+@pytest.mark.parametrize("mode", ["gauss", "naive"])
+@pytest.mark.parametrize("seed", range(4))
+def test_random_network_split_complex_mult_modes(seed, mode, monkeypatch):
+    """Fuzz both complex-multiply lowerings (split-complex f32) against
+    the complex128 oracle on random networks — the naive 4-dot mode is
+    the benchmark default (VERDICT r3 #2)."""
+    from tnc_tpu.ops.backends import JaxBackend
+    from tnc_tpu.ops.program import build_program, flat_leaf_tensors
+
+    monkeypatch.setenv("TNC_TPU_COMPLEX_MULT", mode)
+    rng = np.random.default_rng(300 + seed)
+    tn = _random_network(rng, int(rng.integers(4, 9)))
+    path = Greedy(OptMethod.GREEDY).find_path(tn).replace_path()
+    program = build_program(tn, path)
+    arrays = [leaf.data.into_data() for leaf in flat_leaf_tensors(tn)]
+
+    from tnc_tpu.ops.backends import NumpyBackend
+
+    want = NumpyBackend(dtype=np.complex128).execute(program, arrays)
+    got = JaxBackend(
+        dtype="complex64", split_complex=True, precision="float32"
+    ).execute(program, arrays)
+    denom = max(float(np.max(np.abs(want))), 1e-30)
+    assert float(np.max(np.abs(got - want))) / denom < 1e-5, (seed, mode)
