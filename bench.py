@@ -32,7 +32,7 @@ Env knobs:
   BENCH_TARGET_LOG2_PEAK (29), BENCH_NTRIALS (128),
   BENCH_CPU_SLICES (1; serial baseline-timing sample),
   BENCH_PARITY_SLICES (16; parallel complex128 oracle sample),
-  BENCH_PARITY_TARGET (1e-5), BENCH_COMPLEX_MULT naive|gauss,
+  BENCH_PARITY_TARGET (1e-5), BENCH_COMPLEX_MULT naive|gauss|fused,
   BENCH_NO_PLAN_CACHE=1 (force replanning),
   BENCH_REPS (3), BENCH_PEAK_FLOPS (per device),
   BENCH_EXEC chunked|loop, BENCH_BATCH (8), BENCH_PROBE_SLICES (64),

@@ -84,8 +84,8 @@ for mode in matmul take; do
   echo "lanemix=$mode rc=$? $(cat "$out/bench_lanemix_$mode.json" 2>/dev/null | tail -1)"
 done
 
-echo "== 5. complex-mult naive-vs-gauss A/B (256-slice subset) =="
-for cm in naive gauss; do
+echo "== 5. complex-mult naive-vs-gauss-vs-fused A/B (256-slice subset) =="
+for cm in naive gauss fused; do
   BENCH_COMPLEX_MULT=$cm BENCH_MAX_SLICES=256 BENCH_REPS=1 BENCH_TRACE=0 \
     BENCH_NO_RETRY=1 BENCH_PARITY_TARGET=1e-4 \
     timeout 1800 python bench.py \

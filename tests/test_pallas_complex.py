@@ -1,0 +1,145 @@
+"""Fused split-complex Pallas kernel (interpret mode on CPU).
+
+The ``fused`` complex-mult mode computes re/im in one kernel with each
+operand tile loaded once (docs/future_work.md item 2); the hardware A/B
+runs in scripts/hw_campaign.sh. These tests pin interpret-mode
+correctness against complex128 numpy, the vmap path the chunked
+executor uses, eligibility gating, and the per-step fallback inside
+``apply_step_split``.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from tnc_tpu.ops.pallas_complex import (
+    _tile,
+    eligible,
+    fused_complex_dot_kl,
+)
+
+
+def test_tile_selection():
+    assert _tile(256, 128, 8) == 128
+    assert _tile(64, 128, 8) == 64
+    assert _tile(96, 128, 8) == 96  # 96 divides itself
+    assert _tile(100, 128, 8) == 100 or _tile(100, 128, 8) is None
+    assert _tile(4, 128, 8) is None  # below the f32 sublane floor
+
+
+def test_eligibility_gate():
+    assert eligible(1024, 256, 256)
+    assert not eligible(8, 8, 128)  # too small to amortize the grid
+    assert not eligible(1024, 4, 256)  # M below sublane floor
+
+
+def _rand(shape, rng):
+    return rng.standard_normal(shape).astype(np.float32)
+
+
+def test_fused_matches_complex128_oracle():
+    rng = np.random.default_rng(0)
+    K, M, N = 1024, 256, 384
+    ar, ai = _rand((K, M), rng), _rand((K, M), rng)
+    br, bi = _rand((K, N), rng), _rand((K, N), rng)
+    re, im = jax.jit(
+        lambda a, b, c, d: fused_complex_dot_kl(a, b, c, d, interpret=True)
+    )(ar, ai, br, bi)
+    want = (ar + 1j * ai).astype(np.complex128).T @ (br + 1j * bi).astype(
+        np.complex128
+    )
+    got = np.asarray(re) + 1j * np.asarray(im)
+    assert got.shape == (M, N)
+    denom = float(np.max(np.abs(want)))
+    assert float(np.max(np.abs(got - want))) / denom < 1e-5
+
+
+def test_fused_vmap_matches():
+    """The chunked executor vmaps the step kernel over slice batches."""
+    rng = np.random.default_rng(1)
+    B, K, M, N = 2, 512, 128, 128
+    ar, ai = _rand((B, K, M), rng), _rand((B, K, M), rng)
+    br, bi = _rand((B, K, N), rng), _rand((B, K, N), rng)
+    re, im = jax.jit(
+        jax.vmap(
+            lambda a, b, c, d: fused_complex_dot_kl(a, b, c, d, interpret=True)
+        )
+    )(ar, ai, br, bi)
+    want = np.einsum(
+        "bkm,bkn->bmn",
+        (ar + 1j * ai).astype(np.complex128),
+        (br + 1j * bi).astype(np.complex128),
+    )
+    got = np.asarray(re) + 1j * np.asarray(im)
+    denom = float(np.max(np.abs(want)))
+    assert float(np.max(np.abs(got - want))) / denom < 1e-5
+
+
+def test_fused_mode_end_to_end_with_fallback(monkeypatch):
+    """TNC_TPU_COMPLEX_MULT=fused through a real program: eligible steps
+    take the kernel (interpret mode off-TPU), the rest fall back to
+    naive dots, and the whole-program result matches the oracle."""
+    from tnc_tpu.builders.connectivity import ConnectivityLayout
+    from tnc_tpu.builders.random_circuit import random_circuit
+    from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+    from tnc_tpu.ops.backends import JaxBackend, NumpyBackend
+    from tnc_tpu.ops.program import build_program, flat_leaf_tensors
+
+    monkeypatch.setenv("TNC_TPU_COMPLEX_MULT", "fused")
+    rng = np.random.default_rng(7)
+    tn = random_circuit(
+        12, 6, 0.4, 0.4, rng, ConnectivityLayout.LINE, bitstring="*" * 12
+    )
+    result = Greedy(OptMethod.GREEDY).find_path(tn)
+    program = build_program(tn, result.replace_path())
+    arrays = [leaf.data.into_data() for leaf in flat_leaf_tensors(tn)]
+
+    want = NumpyBackend(dtype=np.complex128).execute(program, arrays)
+    got = JaxBackend(
+        dtype="complex64", split_complex=True, precision="float32"
+    ).execute(program, arrays)
+    denom = max(float(np.max(np.abs(want))), 1e-30)
+    assert float(np.max(np.abs(got - want))) / denom < 1e-5
+
+
+def test_fused_path_actually_engages(monkeypatch):
+    """A big eligible contraction must route through the kernel (guards
+    against the eligibility gate silently sending everything to the
+    naive fallback)."""
+    from tnc_tpu.contractionpath.contraction_path import ContractionPath
+    from tnc_tpu.ops import pallas_complex
+    from tnc_tpu.ops.backends import JaxBackend, NumpyBackend
+    from tnc_tpu.ops.program import build_program, flat_leaf_tensors
+    from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+    from tnc_tpu.tensornetwork.tensordata import TensorData
+
+    monkeypatch.setenv("TNC_TPU_COMPLEX_MULT", "fused")
+    calls = []
+    real = pallas_complex.fused_complex_dot_kl
+
+    def counting(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(pallas_complex, "fused_complex_dot_kl", counting)
+
+    rng = np.random.default_rng(3)
+    shared = list(range(10))          # 2^10 contracted
+    a_free = list(range(10, 17))      # 2^7 free
+    b_free = list(range(17, 24))      # 2^7 free
+    def leaf(legs):
+        shape = [2] * len(legs)
+        data = (rng.standard_normal(shape) + 1j * rng.standard_normal(shape))
+        return LeafTensor(legs, [2] * len(legs), TensorData.matrix(data / 32.0))
+    tn = CompositeTensor([leaf(shared + a_free), leaf(shared + b_free)])
+    program = build_program(tn, ContractionPath.simple([(0, 1)]))
+    arrays = [l.data.into_data() for l in flat_leaf_tensors(tn)]
+
+    want = NumpyBackend(dtype=np.complex128).execute(program, arrays)
+    got = JaxBackend(
+        dtype="complex64", split_complex=True, precision="float32"
+    ).execute(program, arrays)
+    assert calls, "fused kernel was never invoked"
+    denom = max(float(np.max(np.abs(want))), 1e-30)
+    assert float(np.max(np.abs(got - want))) / denom < 1e-5

@@ -1,0 +1,128 @@
+"""Fused split-complex matmul as a Pallas TPU kernel.
+
+The split-complex step kernel lowers a complex contraction to 4 real
+dots (naive) or 3 dots + 5 elementwise passes (Gauss) — either way XLA
+emits *separate* MXU ops whose operands each travel HBM→VMEM twice (ar
+feeds two products, br feeds two products, …) plus an elementwise
+epilogue over full-size outputs. This kernel computes both outputs in
+one pass:
+
+    re = arᵀ·br − aiᵀ·bi
+    im = arᵀ·bi + aiᵀ·br
+
+with each operand tile loaded into VMEM **once** per grid cell and both
+accumulators living in VMEM scratch across the K loop — roughly halving
+operand HBM traffic on bandwidth-bound steps and deleting the epilogue
+passes entirely (docs/future_work.md item 2; the MFU-attribution work of
+VERDICT r3 #4).
+
+Layout: operands arrive exactly as the program compiler's dot layout
+produces them — contract-dim-leading 2-D views ``A:(K, M)``,
+``B:(K, N)`` (the ``cfirst`` orientation; other orientations fall back
+to the plain naive path). Tile sizes respect the f32 (8, 128) minimum
+and shapes must divide their tiles (program dims are powers of two, so
+any dim ≥ the tile divides it; smaller/ragged shapes fall back).
+
+Selected with ``TNC_TPU_COMPLEX_MULT=fused``; correctness is pinned in
+interpret mode on CPU (tests/test_pallas_complex.py) and the hardware
+A/B runs in ``scripts/hw_campaign.sh``. Meant to be called inside an
+outer ``jax.jit`` (every executor's step kernel already is).
+"""
+
+from __future__ import annotations
+
+MIN_FLOPS = 1 << 22  # below this the dispatch/grid overhead dominates
+
+
+def _tile(dim: int, cap: int, floor: int) -> int | None:
+    """Largest tile ≤ cap that divides ``dim`` and is ≥ floor."""
+    t = min(cap, dim)
+    while t >= floor:
+        if dim % t == 0:
+            return t
+        t //= 2
+    return None
+
+
+def eligible(k: int, m: int, n: int) -> bool:
+    """Can the fused kernel run this (K,M)x(K,N) problem profitably?"""
+    if 2 * k * m * n < MIN_FLOPS:
+        return False
+    return (
+        _tile(m, 128, 8) is not None
+        and _tile(n, 128, 128) is not None
+        and _tile(k, 512, 8) is not None
+    )
+
+
+def fused_complex_dot_kl(ar, ai, br, bi, interpret: bool = False,
+                         precision=None):
+    """``(re, im)`` of the complex product ``(ar+i·ai)ᵀ · (br+i·bi)``.
+
+    ``ar, ai: (K, M)``; ``br, bi: (K, N)``; outputs ``(M, N)`` float32.
+    ``precision`` is the ``lax.Precision`` for the tile dots — callers
+    on the f32 parity contract must pass HIGHEST (MXU default would run
+    bf16-multiply passes and miss the 1e-5 target by orders of
+    magnitude; invisible in interpret mode, which is always full f32).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    k, m = ar.shape
+    _, n = br.shape
+    tm = _tile(m, 128, 8)
+    tn = _tile(n, 128, 128)
+    tk = _tile(k, 512, 8)
+    if tm is None or tn is None or tk is None:
+        raise ValueError(f"shape (K={k}, M={m}, N={n}) not tileable")
+
+    def kernel(ar_ref, ai_ref, br_ref, bi_ref, re_ref, im_ref, racc, iacc):
+        kk = pl.program_id(2)
+
+        @pl.when(kk == 0)
+        def _init():
+            racc[:] = jnp.zeros_like(racc)
+            iacc[:] = jnp.zeros_like(iacc)
+
+        dims = (((0,), (0,)), ((), ()))
+
+        def dot(x, y):
+            return jax.lax.dot_general(
+                x, y, dims,
+                precision=precision,
+                preferred_element_type=jnp.float32,
+            )
+
+        art, ait = ar_ref[:], ai_ref[:]
+        brt, bit = br_ref[:], bi_ref[:]
+        racc[:] += dot(art, brt) - dot(ait, bit)
+        iacc[:] += dot(art, bit) + dot(ait, brt)
+
+        @pl.when(kk == pl.num_programs(2) - 1)
+        def _flush():
+            re_ref[:] = racc[:]
+            im_ref[:] = iacc[:]
+
+    a_spec = pl.BlockSpec((tk, tm), lambda i, j, kk: (kk, i))
+    b_spec = pl.BlockSpec((tk, tn), lambda i, j, kk: (kk, j))
+    out_spec = pl.BlockSpec((tm, tn), lambda i, j, kk: (i, j))
+    f32 = jnp.float32
+    return pl.pallas_call(
+        kernel,
+        grid=(m // tm, n // tn, k // tk),
+        in_specs=[a_spec, a_spec, b_spec, b_spec],
+        out_specs=[out_spec, out_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((m, n), f32),
+            jax.ShapeDtypeStruct((m, n), f32),
+        ],
+        scratch_shapes=_scratch((tm, tn), f32),
+        interpret=interpret,
+    )(ar, ai, br, bi)
+
+
+def _scratch(shape, dtype):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return [pltpu.VMEM(shape, dtype), pltpu.VMEM(shape, dtype)]
