@@ -408,18 +408,41 @@ def _oracle_artifact(cache, plan_key, sp, arrays, n_sub, n_time) -> dict:
     """Complex128 per-slice oracle results + serial complex64 baseline
     timing, cached keyed by the plan. Deterministic host work, so a
     cache hit costs ~0 s of a hardware window; ``BENCH_NO_PLAN_CACHE=1``
-    forces recomputation."""
+    forces recomputation.
+
+    The artifact records the plan *content* fingerprint: oracle slices
+    are meaningless for a different plan, and the plan under a given key
+    can legitimately change across code versions (e.g. the native replay
+    kernel shifted FP tie-breaks in leg selection) — a stale pairing is
+    detected and recomputed rather than producing garbage parity."""
+    import hashlib
+    import pickle
+
     from tnc_tpu.ops.sliced import execute_sliced_numpy, sliced_partials_numpy
 
+    plan_fp = hashlib.sha256(
+        pickle.dumps((sp.signature(),))
+    ).hexdigest()[:16]
     okey = plan_key.replace("northstar-plan", "northstar-oracle")
     obj = (
         None
         if os.environ.get("BENCH_NO_PLAN_CACHE") == "1"
         else cache.load_obj(okey)
     )
+    if isinstance(obj, dict) and obj.get("plan_fp") != plan_fp:
+        # strict: an unstamped artifact is treated as mismatched too —
+        # appending new-plan slices to unverified old-plan partials
+        # would launder a mixed artifact as fresh
+        # (scripts/stamp_oracle_fp.py migrates known-consistent caches)
+        log(
+            f"[bench] oracle cache {okey} was computed for a different "
+            f"plan ({obj.get('plan_fp')} != {plan_fp}); recomputing"
+        )
+        obj = None
     if not isinstance(obj, dict):
         obj = {"n": 0, "per_slice": None, "cpu_per_slice_s": 0.0,
                "cpu_timed_slices": 0}
+    obj["plan_fp"] = plan_fp
     have = int(obj.get("n", 0))
     if have >= n_sub and obj.get("cpu_timed_slices", 0) >= n_time:
         log(
