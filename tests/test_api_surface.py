@@ -195,7 +195,10 @@ def test_round3_additions_surface():
         padded_elems,
         program_peak_bytes,
     )
-    from tnc_tpu.ops.autodiff import contraction_value_and_grad
+    from tnc_tpu.ops.autodiff import (
+        contraction_value_and_grad,
+        sliced_contraction_value_and_grad,
+    )
     from tnc_tpu.parallel.partitioned import (
         distributed_partitioned_sliced_contraction,
         flatten_partitioned_path,
@@ -210,6 +213,7 @@ def test_round3_additions_surface():
         padded_elems,
         program_peak_bytes,
         contraction_value_and_grad,
+        sliced_contraction_value_and_grad,
         distributed_partitioned_sliced_contraction,
         flatten_partitioned_path,
         partitioned_sliced_executor,

@@ -93,3 +93,26 @@ def test_find_slicing_same_result_native_and_python(seed, monkeypatch):
     python = find_slicing(inputs, path, target_size=16.0)
     assert native.legs == python.legs
     assert native.dims == python.dims
+
+
+@pytest.mark.parametrize("seed", [1, 4])
+def test_slice_and_reconfigure_same_result_native_and_python(seed, monkeypatch):
+    """Candidate ordering is pinned ascending-leg-id, so the native and
+    Python replayer arms must produce identical slicings and paths."""
+    inputs, path, dims = _random_instance(seed)
+    # ssa form of the replace path
+    from tnc_tpu.contractionpath.contraction_path import replace_ssa_ordering
+
+    ssa = replace_ssa_ordering(path, len(inputs))
+    try:
+        native_pairs, native_slicing = slice_and_reconfigure(
+            inputs, ssa, target_size=16.0, final_budget=None, step_budget=None
+        )
+    except ValueError:
+        pytest.skip("instance not sliceable to target")
+    monkeypatch.setenv("TNC_TPU_NO_NATIVE", "1")
+    py_pairs, py_slicing = slice_and_reconfigure(
+        inputs, ssa, target_size=16.0, final_budget=None, step_budget=None
+    )
+    assert native_slicing.legs == py_slicing.legs
+    assert native_pairs == py_pairs

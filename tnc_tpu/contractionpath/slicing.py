@@ -61,6 +61,10 @@ class _PyReplayer:
         peak, _ = _replay_sizes(self._inputs, self._path, removed)
         return peak, _reduced_flops(self._inputs, self._path, removed)
 
+    def peak(self, removed):
+        peak, _ = _replay_sizes(self._inputs, self._path, removed)
+        return peak
+
 
 def _make_replayer(inputs, replace_path):
     """Path replayer: native (``native/slicereplay.cpp``) when
@@ -249,21 +253,26 @@ def slice_and_reconfigure(
         peak, leg_peak = replayer.sizes(removed)
         if peak <= target_size:
             break
-        candidates = [
+        # ascending leg id: both replayer arms then see the same
+        # candidate order, so truncation and exact-tie '<' picks cannot
+        # diverge between native and Python-fallback machines (this is
+        # the order the native leg_peak already iterates in, preserving
+        # the canonical prewarmed plan)
+        candidates = sorted(
             leg
             for leg, size in leg_peak.items()
             if size >= peak * 0.99
             and leg not in removed
             and leg not in open_legs
             and dims[leg] > 1
-        ]
+        )
         if not candidates:
             # no sliceable leg in the peak step: fall back to any leg
-            candidates = [
+            candidates = sorted(
                 leg
                 for leg in leg_peak
                 if leg not in removed and leg not in open_legs and dims[leg] > 1
-            ]
+            )
         if not candidates:
             raise ValueError(
                 f"No sliceable legs left but peak {peak:.3e} > "
@@ -298,9 +307,7 @@ def slice_and_reconfigure(
         refined_replace = ssa_replace_ordering(
             ContractionPath.simple(refined.to_ssa_path())
         ).toplevel
-        refined_peak, _ = _make_replayer(
-            inputs, refined_replace
-        ).peak_and_flops(removed)
+        refined_peak = _make_replayer(inputs, refined_replace).peak(removed)
         if refined_peak <= target_size:
             tree = refined
 

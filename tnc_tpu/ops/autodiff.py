@@ -91,3 +91,94 @@ def contraction_value_and_grad(
         np.asarray(result).reshape(canonical_shape),
         [np.asarray(g) for g in grads],
     )
+
+
+def sliced_contraction_value_and_grad(
+    tn: CompositeTensor,
+    contract_path: ContractionPath,
+    slicing,
+    wrt: Sequence[int] | None = None,
+    scalar_fn: Callable | None = None,
+    dtype: str = "complex64",
+):
+    """Like :func:`contraction_value_and_grad` for a *sliced* plan: the
+    value is the sum over all slice programs, and one reverse-mode sweep
+    through the on-device slice loop yields the gradients — the vjp of
+    the slice sum is the sum of per-slice vjps, so memory stays at the
+    sliced peak (the whole point of slicing) instead of the unsliced
+    program's. Closes the "gradients through sliced programs" item of
+    docs/future_work.md (#4).
+
+    The slice loop is a ``lax.fori_loop`` with static bounds, which JAX
+    converts to a scan for reverse-mode; the body is ``jax.checkpoint``-
+    ed so the backward pass stores only the loop carry and recomputes
+    per-slice intermediates (without remat, scan-grad stacks every
+    slice's residuals — exactly the memory slicing exists to avoid).
+    Slice contributions accumulate with the same Kahan compensation as
+    the forward executors. Complex dtype path (like the unsliced
+    version): run on CPU/``jax64`` for gradient workflows.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from tnc_tpu.ops.sliced import (
+        _slice_indices,
+        build_sliced_program,
+        index_buffer,
+        kahan_add,
+    )
+
+    sp = build_sliced_program(tn, contract_path, slicing)
+    leaves = flat_leaf_tensors(tn)
+    arrays = [
+        jnp.asarray(leaf.data.into_data(), dtype=dtype) for leaf in leaves
+    ]
+    if wrt is None:
+        wrt = list(range(len(arrays)))
+    wrt = list(wrt)
+
+    if scalar_fn is None:
+
+        def scalar_fn(result):
+            return jnp.real(result.reshape(-1)[0])
+
+    program = sp.program
+    perm = program.canonical_perm()
+    dim_of = dict(zip(program.result_legs, program.result_shape))
+    canonical_shape = tuple(dim_of[leg] for leg in program.canonical_legs)
+    num = sp.slicing.num_slices
+
+    def forward(diff_arrays):
+        buffers = list(arrays)
+        for slot, arr in zip(wrt, diff_arrays):
+            buffers[slot] = arr
+
+        @jax.checkpoint
+        def contribution(s):
+            indices = _slice_indices(sp.slicing, s)
+            sliced = [
+                index_buffer(jnp, arr, info, indices)
+                for arr, info in zip(buffers, sp.slot_slices)
+            ]
+            return _run_steps(jnp, program, list(sliced))
+
+        def body(s, carry):
+            return kahan_add(carry[0], carry[1], contribution(s))
+
+        zeros = jnp.zeros(program.stored_result_shape, dtype=dtype)
+        acc, comp = lax.fori_loop(0, num, body, (zeros, zeros))
+        out = (acc + comp).reshape(program.result_shape)
+        if perm is not None:
+            out = jnp.transpose(out, perm)
+        return scalar_fn(out), out
+
+    diff_in = tuple(arrays[slot] for slot in wrt)
+    (value_scalar, result), grads = jax.value_and_grad(
+        forward, has_aux=True
+    )(diff_in)
+    del value_scalar
+    return (
+        np.asarray(result).reshape(canonical_shape),
+        [np.asarray(g) for g in grads],
+    )

@@ -403,6 +403,11 @@ class SlicedReplayer:
         peak, flops, _ = self._call(removed, want_leg_peak=False)
         return peak, flops
 
+    def peak(self, removed) -> float:
+        """Peak step size only (acceptance checks)."""
+        peak, _flops, _ = self._call(removed, want_leg_peak=False)
+        return peak
+
 
 def native_optimal_order(
     leg_sets: "list[frozenset[int]]",
