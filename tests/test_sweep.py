@@ -85,3 +85,95 @@ def test_amplitude_sweep_rejects_wildcards_and_ragged():
     with pytest.raises(ValueError):
         amplitude_sweep(_ghz(4), ["0000", "000"])
     assert amplitude_sweep(_ghz(4), []).shape == (0,)
+
+
+def test_amplitude_sweep_gradient_matches_finite_difference():
+    """Gradient of sum|amp|^2 over a batch of bitstrings vs per-entry
+    finite differences through the per-bitstring sweep oracle."""
+    from tnc_tpu.builders.connectivity import ConnectivityLayout
+    from tnc_tpu.tensornetwork.sweep import amplitude_sweep_value_and_grad
+    from tnc_tpu.ops.program import flat_leaf_tensors
+    from tnc_tpu.tensornetwork.tensordata import DataKind
+
+    def build():
+        c = Circuit()
+        reg = c.allocate_register(3)
+        c.append_gate(TensorData.gate("h"), [reg.qubit(0)])
+        c.append_gate(TensorData.gate("cx"), [reg.qubit(0), reg.qubit(1)])
+        c.append_gate(TensorData.gate("ry", [0.4]), [reg.qubit(2)])
+        c.append_gate(TensorData.gate("cz"), [reg.qubit(1), reg.qubit(2)])
+        return c
+
+    bitstrings = ["000", "110", "011", "101"]
+    # pick the first 2-dim gate leaf as the parameter
+    tn_probe, _ = build().into_amplitude_network(bitstrings[0])
+    leaves = flat_leaf_tensors(tn_probe)
+    slot = next(
+        i for i, l in enumerate(leaves)
+        if l.data.kind is DataKind.GATE and l.dims() == 2
+    )
+    x0 = np.asarray(leaves[slot].data.into_data(), dtype=np.complex128)
+
+    amps, (grad,) = amplitude_sweep_value_and_grad(
+        build(), bitstrings, wrt=[slot], dtype="complex128"
+    )
+    assert amps.shape == (4,)
+    # amplitudes agree with the plain sweep
+    from tnc_tpu.ops.backends import NumpyBackend as _NB
+
+    ref = amplitude_sweep(build(), bitstrings, backend=_NB(dtype=np.complex128))
+    assert np.allclose(amps, ref, rtol=1e-8, atol=1e-10)
+
+    def loss_with(x):
+        from tnc_tpu.ops.backends import NumpyBackend
+        from tnc_tpu.ops.program import build_program
+        from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+
+        tn, _ = build().into_amplitude_network(bitstrings[0])
+        lvs = flat_leaf_tensors(tn)
+        n = 3
+        bra_slots = list(range(len(lvs) - n, len(lvs)))
+        result = Greedy(OptMethod.GREEDY).find_path(tn)
+        program = build_program(tn, result.replace_path())
+        arrays = [l.data.into_data() for l in lvs]
+        arrays[slot] = x
+        total = 0.0
+        from tnc_tpu.tensornetwork.sweep import _KET
+        backend = NumpyBackend(dtype=np.complex128)
+        for b in bitstrings:
+            per = list(arrays)
+            for q, s in enumerate(bra_slots):
+                per[s] = _KET[b[q]]
+            amp = complex(np.asarray(backend.execute(program, per)).reshape(-1)[0])
+            total += abs(amp) ** 2
+        return total
+
+    eps = 1e-6
+    it = np.nditer(x0, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        for d in (eps, eps * 1j):
+            xp = x0.copy(); xp[idx] += d
+            xm = x0.copy(); xm[idx] -= d
+            fd = (loss_with(xp) - loss_with(xm)) / (2 * eps)
+            want = np.real(grad[idx]) if d == eps else -np.imag(grad[idx])
+            assert abs(fd - want) < 1e-5, (idx, d, fd, want)
+        it.iternext()
+
+
+def test_amplitude_sweep_grad_rejects_bra_slots():
+    from tnc_tpu.tensornetwork.sweep import amplitude_sweep_value_and_grad
+
+    def build():
+        c = Circuit()
+        reg = c.allocate_register(2)
+        c.append_gate(TensorData.gate("h"), [reg.qubit(0)])
+        c.append_gate(TensorData.gate("cx"), [reg.qubit(0), reg.qubit(1)])
+        return c
+
+    tn_probe, _ = build().into_amplitude_network("00")
+    from tnc_tpu.ops.program import flat_leaf_tensors
+
+    n_leaves = len(flat_leaf_tensors(tn_probe))
+    with pytest.raises(ValueError):
+        amplitude_sweep_value_and_grad(build(), ["00"], wrt=[n_leaves - 1])

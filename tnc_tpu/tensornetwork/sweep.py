@@ -94,3 +94,96 @@ def amplitude_sweep(
         ]
         out[i] = complex(np.asarray(backend.execute(program, per)).reshape(-1)[0])
     return out
+
+
+def amplitude_sweep_value_and_grad(
+    circuit: Circuit,
+    bitstrings: Sequence[str],
+    wrt: Sequence[int] | None = None,
+    scalar_fn=None,
+    pathfinder: Pathfinder | None = None,
+    dtype: str = "complex64",
+):
+    """Amplitudes for every bitstring AND the gradient of a real scalar
+    of them w.r.t. selected (non-bra) leaf tensors — one reverse-mode
+    sweep through the same vmapped program the forward sweep runs
+    (closing the "gradients of amplitude sweeps" half of
+    docs/future_work.md item 4). The natural loss for sampling-based
+    training is the default ``scalar_fn``: total probability mass
+    ``sum |amp_b|^2`` over the batch.
+
+    ``wrt`` indexes the flat leaf order (``flat_leaf_tensors``; bra
+    slots — the trailing ``n`` leaves — are the sweep axis and cannot be
+    differentiated here). Returns ``(amps, grads)``; cotangents follow
+    the same ``df = Re(sum(g * dT))`` convention as
+    :mod:`tnc_tpu.ops.autodiff`.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from tnc_tpu.ops.backends import _run_steps
+
+    if not bitstrings:
+        raise ValueError("amplitude_sweep_value_and_grad needs >= 1 bitstring")
+    n = len(bitstrings[0])
+    for b in bitstrings:
+        if len(b) != n or any(c not in "01" for c in b):
+            raise ValueError(
+                "fully determined, equal-length bitstrings required"
+            )
+
+    tn, _ = circuit.into_amplitude_network(bitstrings[0])
+    leaves = flat_leaf_tensors(tn)
+    bra_slots = list(range(len(leaves) - n, len(leaves)))
+    bra_set = set(bra_slots)
+
+    if pathfinder is None:
+        from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+
+        pathfinder = Greedy(OptMethod.GREEDY)
+    result = pathfinder.find_path(tn)
+    program = build_program(tn, result.replace_path())
+
+    arrays = []
+    for slot, leaf in enumerate(leaves):
+        if slot in bra_set:
+            qubit = slot - bra_slots[0]
+            stacked = np.stack([_KET[b[qubit]] for b in bitstrings])
+            arrays.append(jnp.asarray(stacked, dtype=dtype))
+        else:
+            arrays.append(jnp.asarray(leaf.data.into_data(), dtype=dtype))
+
+    if wrt is None:
+        wrt = [s for s in range(len(leaves)) if s not in bra_set]
+    wrt = list(wrt)
+    if any(s in bra_set for s in wrt):
+        raise ValueError("bra slots carry the sweep axis; not differentiable")
+
+    if scalar_fn is None:
+
+        def scalar_fn(amps):
+            return jnp.sum(jnp.abs(amps) ** 2)
+
+    def forward(diff_arrays):
+        buffers = list(arrays)
+        for slot, arr in zip(wrt, diff_arrays):
+            buffers[slot] = arr
+
+        def single(bra_values):
+            per = list(buffers)
+            for i, slot in enumerate(bra_slots):
+                per[slot] = bra_values[i]
+            return _run_steps(jnp, program, per).reshape(-1)[0]
+
+        bras = jnp.stack([buffers[s] for s in bra_slots], axis=1)  # (B,n,2)
+        amps = jax.vmap(single)(bras)
+        return scalar_fn(amps), amps
+
+    diff_in = tuple(arrays[slot] for slot in wrt)
+    (_scalar, amps), grads = jax.value_and_grad(forward, has_aux=True)(
+        diff_in
+    )
+    return (
+        np.asarray(amps).reshape(len(bitstrings)),
+        [np.asarray(g) for g in grads],
+    )
