@@ -30,6 +30,20 @@ from tnc_tpu.ops.program import build_program, flat_leaf_tensors
 from tnc_tpu.tensornetwork.tensor import CompositeTensor
 
 
+def _validate_wrt(wrt, n_slots: int) -> list[int]:
+    """Flat-slot list for differentiation: in range (no negative
+    indexing — slots are flat leaf indices) and duplicate-free (a
+    duplicate would shadow the previous tracer and silently yield a
+    zero gradient for every occurrence but the last)."""
+    wrt = list(wrt)
+    if len(set(wrt)) != len(wrt):
+        raise ValueError("duplicate slots in wrt")
+    for s in wrt:
+        if not 0 <= s < n_slots:
+            raise ValueError(f"wrt slot {s} out of range 0..{n_slots - 1}")
+    return wrt
+
+
 def contraction_value_and_grad(
     tn: CompositeTensor,
     contract_path: ContractionPath,
@@ -62,7 +76,7 @@ def contraction_value_and_grad(
     ]
     if wrt is None:
         wrt = list(range(len(arrays)))
-    wrt = list(wrt)
+    wrt = _validate_wrt(wrt, len(arrays))
 
     if scalar_fn is None:
 
@@ -136,7 +150,7 @@ def sliced_contraction_value_and_grad(
     ]
     if wrt is None:
         wrt = list(range(len(arrays)))
-    wrt = list(wrt)
+    wrt = _validate_wrt(wrt, len(arrays))
 
     if scalar_fn is None:
 

@@ -34,6 +34,42 @@ _KET = {
 }
 
 
+def _sweep_program(circuit, bitstrings, pathfinder):
+    """Shared sweep prologue: validate bitstrings, build the amplitude
+    network, plan, compile, and stack per-bitstring bra values.
+
+    Returns ``(program, arrays, bra_slots)``; ``arrays[slot]`` for bra
+    slots carries the stacked ``(B, 2)`` sweep axis. The finalizer
+    pushes one bra per qubit, in qubit order, after every circuit
+    tensor — they are the trailing ``n`` leaves.
+    """
+    n = len(bitstrings[0])
+    for b in bitstrings:
+        if len(b) != n:
+            raise ValueError("all bitstrings must have equal length")
+        if any(c not in "01" for c in b):
+            raise ValueError(
+                "amplitude sweeps require fully determined bitstrings "
+                "(no '*' wildcards)"
+            )
+
+    tn, _ = circuit.into_amplitude_network(bitstrings[0])
+    leaves = flat_leaf_tensors(tn)
+    bra_slots = list(range(len(leaves) - n, len(leaves)))
+
+    if pathfinder is None:
+        from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+
+        pathfinder = Greedy(OptMethod.GREEDY)
+    result = pathfinder.find_path(tn)
+    program = build_program(tn, result.replace_path())
+
+    arrays = [leaf.data.into_data() for leaf in leaves]
+    for qubit, slot in enumerate(bra_slots):
+        arrays[slot] = np.stack([_KET[b[qubit]] for b in bitstrings])
+    return program, arrays, bra_slots
+
+
 def amplitude_sweep(
     circuit: Circuit,
     bitstrings: Sequence[str],
@@ -50,32 +86,9 @@ def amplitude_sweep(
     """
     if not bitstrings:
         return np.zeros((0,), dtype=np.complex128)
-    n = len(bitstrings[0])
-    for b in bitstrings:
-        if len(b) != n:
-            raise ValueError("all bitstrings must have equal length")
-        if any(c not in "01" for c in b):
-            raise ValueError(
-                "amplitude_sweep requires fully determined bitstrings "
-                "(no '*' wildcards)"
-            )
-
-    tn, _ = circuit.into_amplitude_network(bitstrings[0])
-    leaves = flat_leaf_tensors(tn)
-    # the finalizer pushes one bra per qubit, in qubit order, after every
-    # circuit tensor — they are the trailing n leaves
-    bra_slots = list(range(len(leaves) - n, len(leaves)))
-
-    if pathfinder is None:
-        from tnc_tpu.contractionpath.paths import Greedy, OptMethod
-
-        pathfinder = Greedy(OptMethod.GREEDY)
-    result = pathfinder.find_path(tn)
-    program = build_program(tn, result.replace_path())
-
-    arrays = [leaf.data.into_data() for leaf in leaves]
-    for qubit, slot in enumerate(bra_slots):
-        arrays[slot] = np.stack([_KET[b[qubit]] for b in bitstrings])
+    program, arrays, bra_slots = _sweep_program(
+        circuit, bitstrings, pathfinder
+    )
 
     if backend is None:
         from tnc_tpu.ops.backends import JaxBackend
@@ -125,39 +138,31 @@ def amplitude_sweep_value_and_grad(
 
     if not bitstrings:
         raise ValueError("amplitude_sweep_value_and_grad needs >= 1 bitstring")
-    n = len(bitstrings[0])
-    for b in bitstrings:
-        if len(b) != n or any(c not in "01" for c in b):
-            raise ValueError(
-                "fully determined, equal-length bitstrings required"
-            )
-
-    tn, _ = circuit.into_amplitude_network(bitstrings[0])
-    leaves = flat_leaf_tensors(tn)
-    bra_slots = list(range(len(leaves) - n, len(leaves)))
+    program, host_arrays, bra_slots = _sweep_program(
+        circuit, bitstrings, pathfinder
+    )
     bra_set = set(bra_slots)
-
-    if pathfinder is None:
-        from tnc_tpu.contractionpath.paths import Greedy, OptMethod
-
-        pathfinder = Greedy(OptMethod.GREEDY)
-    result = pathfinder.find_path(tn)
-    program = build_program(tn, result.replace_path())
-
-    arrays = []
-    for slot, leaf in enumerate(leaves):
-        if slot in bra_set:
-            qubit = slot - bra_slots[0]
-            stacked = np.stack([_KET[b[qubit]] for b in bitstrings])
-            arrays.append(jnp.asarray(stacked, dtype=dtype))
-        else:
-            arrays.append(jnp.asarray(leaf.data.into_data(), dtype=dtype))
+    n_slots = len(host_arrays)
+    arrays = [jnp.asarray(a, dtype=dtype) for a in host_arrays]
 
     if wrt is None:
-        wrt = [s for s in range(len(leaves)) if s not in bra_set]
+        wrt = [s for s in range(n_slots) if s not in bra_set]
     wrt = list(wrt)
-    if any(s in bra_set for s in wrt):
-        raise ValueError("bra slots carry the sweep axis; not differentiable")
+    if len(set(wrt)) != len(wrt):
+        raise ValueError(
+            "duplicate slots in wrt (each would shadow the previous "
+            "tracer and get a silent zero gradient)"
+        )
+    for s in wrt:
+        if not 0 <= s < n_slots:
+            raise ValueError(
+                f"wrt slot {s} out of range 0..{n_slots - 1} (negative "
+                "indices are not accepted — slots are flat leaf indices)"
+            )
+        if s in bra_set:
+            raise ValueError(
+                "bra slots carry the sweep axis; not differentiable"
+            )
 
     if scalar_fn is None:
 
