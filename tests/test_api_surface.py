@@ -24,6 +24,11 @@ SURFACE = {
         "contract_tensor_network",
         "contract_tensor_network_sliced",
     ],
+    "tnc_tpu.tensornetwork.approximate": [
+        "boundary_mps_contract",
+        "collapse_peps_sandwich",
+        "attach_random_data",
+    ],
     "tnc_tpu.tensornetwork.partitioning": [
         "find_partitioning",
         "communication_partitioning",

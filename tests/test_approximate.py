@@ -1,0 +1,78 @@
+"""Boundary-MPS approximate contraction vs the exact contractor.
+
+Approximate contraction is future work in the reference
+(``book/src/future_work.md``); here it must (a) be EXACT when ``chi``
+dominates the boundary rank, (b) degrade gracefully as ``chi`` shrinks,
+and (c) consume the ``builders.peps`` sandwich through
+``collapse_peps_sandwich``.
+"""
+
+import numpy as np
+import pytest
+
+from tnc_tpu.builders.peps import peps
+from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+from tnc_tpu.tensornetwork.approximate import (
+    attach_random_data,
+    boundary_mps_contract,
+    collapse_peps_sandwich,
+)
+from tnc_tpu.tensornetwork.contraction import contract_tensor_network
+
+
+def _exact(tn) -> complex:
+    result = Greedy(OptMethod.GREEDY).find_path(tn)
+    out = contract_tensor_network(tn, result.replace_path(), backend="numpy")
+    return complex(np.asarray(out.data.into_data()).reshape(-1)[0])
+
+
+def _sandwich_case(length, depth, vd, layers, seed):
+    rng = np.random.default_rng(seed)
+    tn = attach_random_data(peps(length, depth, 2, vd, layers), rng)
+    want = _exact(tn)
+    grid = collapse_peps_sandwich(tn, length, depth, layers)
+    return grid, want
+
+
+@pytest.mark.parametrize("shape", [(3, 3), (4, 3), (2, 4)])
+def test_boundary_mps_exact_at_large_chi(shape):
+    length, depth = shape
+    grid, want = _sandwich_case(length, depth, vd=2, layers=1, seed=7)
+    got = boundary_mps_contract(grid, chi=4096)
+    assert abs(got - want) <= 1e-8 * max(1.0, abs(want)), (got, want)
+
+
+def test_boundary_mps_truncation_degrades_gracefully():
+    grid, want = _sandwich_case(4, 4, vd=2, layers=1, seed=3)
+    scale = max(1.0, abs(want))
+    errs = {
+        chi: abs(boundary_mps_contract(grid, chi=chi) - want) / scale
+        for chi in (1, 8, 4096)
+    }
+    assert errs[4096] <= 1e-8
+    assert errs[8] <= errs[1] + 1e-12  # more bond dim never hurts here
+    assert np.isfinite(errs[1])
+
+
+def test_boundary_mps_cutoff_drops_negligible_singulars():
+    grid, want = _sandwich_case(3, 4, vd=2, layers=0, seed=11)
+    got = boundary_mps_contract(grid, chi=4096, cutoff=1e-12)
+    assert abs(got - want) <= 1e-8 * max(1.0, abs(want))
+
+
+def test_grid_validation_errors():
+    grid, _ = _sandwich_case(3, 3, vd=2, layers=0, seed=1)
+    with pytest.raises(ValueError):
+        boundary_mps_contract(grid, chi=0)
+    with pytest.raises(ValueError):
+        boundary_mps_contract(grid[:1], chi=4)  # single row
+    ragged = [list(grid[0]), list(grid[1])[:-1], list(grid[2])]
+    with pytest.raises(ValueError):
+        boundary_mps_contract(ragged, chi=4)
+
+
+def test_collapse_rejects_wrong_count():
+    rng = np.random.default_rng(0)
+    tn = attach_random_data(peps(3, 3, 2, 2, 1), rng)
+    with pytest.raises(ValueError):
+        collapse_peps_sandwich(tn, 3, 3, 2)  # wrong layer count

@@ -1,0 +1,237 @@
+"""Approximate contraction: boundary-MPS with SVD truncation.
+
+The reference lists approximate contraction as future work
+(``book/src/future_work.md``); this module implements the standard
+boundary-MPS scheme for 2-D grid networks (PEPS sandwiches): the top
+row is an MPS, every interior row an MPO; after each MPS·MPO
+application the boundary MPS is compressed to bond dimension ``chi``
+by a QR canonicalization sweep followed by truncated SVDs. Memory and
+time are then polynomial in ``chi`` instead of exponential in the grid
+width — the classic accuracy-for-cost dial exact contraction lacks.
+
+Scope notes:
+
+- Sites may be connected by *several* parallel bonds (a PEPS sandwich
+  has one bond per layer between neighbours); bonds per direction are
+  fused into one dense axis, neighbours aligned by sorted leg id.
+- The linear algebra runs through numpy at complex128 (QR/SVD of
+  χ-sized matrices — planner-scale host work, like pathfinding; the
+  contraction dial is what matters on TPU: pick ``chi`` so the exact
+  *sliced* plan of the compressed network fits, or use the boundary
+  value directly). A jitted fixed-``chi`` device sweep is the natural
+  extension once shapes are frozen.
+- ``collapse_peps_sandwich`` flattens the ``builders.peps`` sandwich
+  (layer-major ordering, ``peps.rs:446-460`` equivalent) into the
+  single-layer grid this module consumes.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+from tnc_tpu.tensornetwork.tensordata import TensorData
+
+
+def _site_array(t: LeafTensor) -> np.ndarray:
+    return np.asarray(t.data.into_data(), dtype=np.complex128).reshape(
+        t.shape
+    )
+
+
+def _grouped(t: LeafTensor, groups: Sequence[Sequence[int]]) -> np.ndarray:
+    """Dense site tensor with axes permuted/fused to the leg groups
+    (one fused axis per group, legs within a group in the given order;
+    missing groups become dim-1 axes)."""
+    arr = _site_array(t)
+    pos = {leg: i for i, leg in enumerate(t.legs)}
+    perm: list[int] = []
+    shape: list[int] = []
+    for group in groups:
+        size = 1
+        for leg in group:
+            perm.append(pos[leg])
+            size *= t.bond_dims[pos[leg]]
+        shape.append(size)
+    if len(perm) != len(t.legs):
+        raise ValueError(
+            f"site tensor has legs {sorted(t.legs)} outside its grid "
+            f"neighbourhood {sorted(l for g in groups for l in g)}"
+        )
+    return np.transpose(arr, perm).reshape(shape)
+
+
+def _truncated_svd(m: np.ndarray, chi: int, cutoff: float):
+    u, s, vh = np.linalg.svd(m, full_matrices=False)
+    keep = int(np.sum(s > cutoff * (s[0] if s.size else 1.0)))
+    keep = max(1, min(keep, chi))
+    return u[:, :keep], s[:keep], vh[:keep]
+
+
+def _compress_mps(
+    mps: list[np.ndarray], chi: int, cutoff: float
+) -> list[np.ndarray]:
+    """Canonicalize left-to-right (QR), then truncate right-to-left
+    (SVD). Tensors are (Dl, d, Dr)."""
+    mps = list(mps)
+    n = len(mps)
+    # left-to-right QR: left-canonical form
+    for i in range(n - 1):
+        dl, d, dr = mps[i].shape
+        q, r = np.linalg.qr(mps[i].reshape(dl * d, dr))
+        mps[i] = q.reshape(dl, d, q.shape[1])
+        mps[i + 1] = np.tensordot(r, mps[i + 1], axes=(1, 0))
+    # right-to-left truncated SVD
+    for i in range(n - 1, 0, -1):
+        dl, d, dr = mps[i].shape
+        u, s, vh = _truncated_svd(mps[i].reshape(dl, d * dr), chi, cutoff)
+        mps[i] = vh.reshape(vh.shape[0], d, dr)
+        carry = u * s  # (dl, keep)
+        mps[i - 1] = np.tensordot(mps[i - 1], carry, axes=(2, 0))
+    return mps
+
+
+def _apply_mpo(
+    mps: list[np.ndarray], mpo: list[np.ndarray]
+) -> list[np.ndarray]:
+    """MPS (Dl, d_up, Dr) x MPO (Wl, Wr, d_up, d_down) →
+    fat MPS (Dl·Wl, d_down, Dr·Wr)."""
+    out = []
+    for a, w in zip(mps, mpo):
+        dl, dup, dr = a.shape
+        wl, wr, wup, wdown = w.shape
+        if dup != wup:
+            raise ValueError(f"vertical bond mismatch: {dup} vs {wup}")
+        t = np.tensordot(a, w, axes=(1, 2))  # (dl, dr, wl, wr, wdown)
+        t = np.transpose(t, (0, 2, 4, 1, 3))  # (dl, wl, wdown, dr, wr)
+        out.append(t.reshape(dl * wl, wdown, dr * wr))
+    return out
+
+
+def boundary_mps_contract(
+    grid: Sequence[Sequence[LeafTensor]],
+    chi: int,
+    cutoff: float = 0.0,
+) -> complex:
+    """Contract a closed 2-D grid network approximately.
+
+    ``grid[r][c]`` are data-carrying leaf tensors whose legs connect
+    only to the four lattice neighbours (parallel bonds allowed, fused
+    per direction). ``chi`` caps the boundary-MPS bond dimension; with
+    ``chi`` at least the exact boundary rank the result is exact.
+    """
+    rows = len(grid)
+    if rows < 2 or any(len(r) != len(grid[0]) for r in grid):
+        raise ValueError("grid must be rectangular with >= 2 rows")
+    cols = len(grid[0])
+    if cols < 1:
+        raise ValueError("grid rows must be non-empty")
+    if chi < 1:
+        raise ValueError("chi must be >= 1")
+
+    legs_of = [[set(t.legs) for t in row] for row in grid]
+
+    def shared(r1, c1, r2, c2) -> list[int]:
+        if 0 <= r2 < rows and 0 <= c2 < cols:
+            return sorted(legs_of[r1][c1] & legs_of[r2][c2])
+        return []
+
+    def groups(r, c):
+        return (
+            shared(r, c, r, c - 1),   # left
+            shared(r, c, r, c + 1),   # right
+            shared(r, c, r - 1, c),   # up
+            shared(r, c, r + 1, c),   # down
+        )
+
+    # top row → MPS over the downward bonds: (left, down, right)
+    mps = []
+    for c in range(cols):
+        left, right, up, down = groups(0, c)
+        if up:
+            raise ValueError("top row must have no upward bonds")
+        site = _grouped(grid[0][c], (left, down, right))
+        mps.append(site)
+
+    # interior rows → MPOs: (left, right, up, down)
+    for r in range(1, rows - 1):
+        mpo = [_grouped(grid[r][c], groups(r, c)) for c in range(cols)]
+        mps = _apply_mpo(mps, mpo)
+        mps = _compress_mps(mps, chi, cutoff)
+
+    # bottom row closes the network: contract each site with the MPS
+    # tensor above it and chain left-to-right
+    env = np.ones((1, 1), dtype=np.complex128)  # (mps_bond, bottom_bond)
+    for c in range(cols):
+        left, right, up, down = groups(rows - 1, c)
+        if down:
+            raise ValueError("bottom row must have no downward bonds")
+        site = _grouped(grid[rows - 1][c], (left, up, right))
+        a = mps[c]  # (Dl, d, Dr)
+        # env (Dl, Bl) · a (Dl, d, Dr) · site (Bl, d, Br) -> (Dr, Br)
+        tmp = np.tensordot(env, a, axes=(0, 0))       # (Bl, d, Dr)
+        env = np.tensordot(tmp, site, axes=((0, 1), (0, 1)))  # (Dr, Br)
+    if env.shape != (1, 1):
+        raise ValueError("grid did not close to a scalar")
+    return complex(env[0, 0])
+
+
+def collapse_peps_sandwich(
+    tn: CompositeTensor, length: int, depth: int, layers: int
+) -> list[list[LeafTensor]]:
+    """Flatten a ``builders.peps`` sandwich (data attached) into the
+    single-layer ``depth × length`` grid ``boundary_mps_contract``
+    consumes: each site's ``layers + 2`` stacked tensors are contracted
+    over their vertical physical bonds (greedy local path), leaving the
+    per-layer horizontal bonds as parallel grid bonds."""
+    from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+    from tnc_tpu.tensornetwork.contraction import contract_tensor_network
+
+    n_layers = layers + 2
+    leaves = list(tn.tensors)
+    if len(leaves) != n_layers * depth * length:
+        raise ValueError(
+            f"expected {n_layers * depth * length} tensors "
+            f"(layer-major peps ordering), got {len(leaves)}"
+        )
+
+    def site_index(k, r, c):
+        return k * depth * length + r * length + c
+
+    grid: list[list[LeafTensor]] = []
+    for r in range(depth):
+        row = []
+        for c in range(length):
+            stack = CompositeTensor(
+                [leaves[site_index(k, r, c)].copy() for k in range(n_layers)]
+            )
+            result = Greedy(OptMethod.GREEDY).find_path(stack)
+            merged = contract_tensor_network(
+                stack, result.replace_path(), backend="numpy"
+            )
+            row.append(merged)
+        grid.append(row)
+    return grid
+
+
+def attach_random_data(
+    tn: CompositeTensor, rng: np.random.Generator, scale: float | None = None
+) -> CompositeTensor:
+    """Fill every metadata-only leaf with seeded complex Gaussian data
+    (builder networks like ``peps`` are metadata-only). ``scale``
+    defaults to per-tensor ``1/sqrt(size)`` so contractions stay O(1)."""
+    for leaf in tn.tensors:
+        if isinstance(leaf, CompositeTensor):
+            attach_random_data(leaf, rng, scale)
+            continue
+        shape = leaf.shape
+        s = scale if scale is not None else 1.0 / np.sqrt(
+            max(1.0, float(np.prod(shape)))
+        )
+        data = (
+            rng.standard_normal(shape) + 1j * rng.standard_normal(shape)
+        ) * s
+        leaf.data = TensorData.matrix(data.astype(np.complex128))
+    return tn

@@ -145,20 +145,12 @@ def amplitude_sweep_value_and_grad(
     n_slots = len(host_arrays)
     arrays = [jnp.asarray(a, dtype=dtype) for a in host_arrays]
 
+    from tnc_tpu.ops.autodiff import _validate_wrt
+
     if wrt is None:
         wrt = [s for s in range(n_slots) if s not in bra_set]
-    wrt = list(wrt)
-    if len(set(wrt)) != len(wrt):
-        raise ValueError(
-            "duplicate slots in wrt (each would shadow the previous "
-            "tracer and get a silent zero gradient)"
-        )
+    wrt = _validate_wrt(wrt, n_slots)
     for s in wrt:
-        if not 0 <= s < n_slots:
-            raise ValueError(
-                f"wrt slot {s} out of range 0..{n_slots - 1} (negative "
-                "indices are not accepted — slots are flat leaf indices)"
-            )
         if s in bra_set:
             raise ValueError(
                 "bra slots carry the sweep axis; not differentiable"
