@@ -109,8 +109,21 @@ for cfg in ghz3 random20 qaoa30 sycamore_m20_partitioned; do
   echo "$cfg rc=$? $(cat "$out/bench_$cfg.json" 2>/dev/null | tail -1)"
 done
 
-echo "== 8. consolidated artifact =="
+echo "== 8. consolidated artifact (copied into the repo: .cache/ is gitignored) =="
 python scripts/consolidate_bench.py "$out" > BENCH_ALL_r04.json 2>> "$out/watch.log" \
   && echo "BENCH_ALL_r04.json written"
+cp -f "$out/bench_main.json" BENCH_r04_campaign.json 2>/dev/null || true
+{
+  echo "# Campaign evidence ($(date -u +%FT%TZ))"
+  echo
+  echo "## Stage results"
+  for f in "$out"/bench_*.json; do
+    echo "- $(basename "$f"): $(tail -1 "$f" 2>/dev/null)"
+  done
+  echo
+  echo "## Hardware test tier (tail)"
+  tail -5 "$out/hw_tier.log" 2>/dev/null | sed 's/^/    /'
+} > CAMPAIGN_EVIDENCE_r04.md
+echo "CAMPAIGN_EVIDENCE_r04.md written"
 
 echo "campaign done $(date -u +%H:%M:%SZ)" | tee -a "$out/STATUS"
