@@ -76,3 +76,23 @@ def test_collapse_rejects_wrong_count():
     tn = attach_random_data(peps(3, 3, 2, 2, 1), rng)
     with pytest.raises(ValueError):
         collapse_peps_sandwich(tn, 3, 3, 2)  # wrong layer count
+
+
+def test_boundary_mps_jax_backend_matches_numpy():
+    """The jitted jax sweep (one static-shape program) must agree with
+    the numpy sweep at the same chi, both truncated and exact."""
+    grid, want = _sandwich_case(3, 3, vd=2, layers=1, seed=7)
+    exact_np = boundary_mps_contract(grid, chi=4096)
+    exact_jax = boundary_mps_contract(grid, chi=4096, backend="jax")
+    assert abs(exact_jax - exact_np) <= 1e-4 * max(1.0, abs(exact_np))
+    assert abs(exact_jax - want) <= 1e-4 * max(1.0, abs(want))
+
+    trunc_np = boundary_mps_contract(grid, chi=4)
+    trunc_jax = boundary_mps_contract(grid, chi=4, backend="jax")
+    # truncated SVD gauge freedom cannot change the value, only FP noise
+    assert abs(trunc_jax - trunc_np) <= 1e-3 * max(1.0, abs(trunc_np))
+
+    with pytest.raises(ValueError):
+        boundary_mps_contract(grid, chi=4, cutoff=1e-10, backend="jax")
+    with pytest.raises(ValueError):
+        boundary_mps_contract(grid, chi=4, backend="bogus")

@@ -27,6 +27,7 @@ Scope notes:
 
 from __future__ import annotations
 
+import functools as _functools
 from typing import Sequence
 
 import numpy as np
@@ -63,16 +64,19 @@ def _grouped(t: LeafTensor, groups: Sequence[Sequence[int]]) -> np.ndarray:
     return np.transpose(arr, perm).reshape(shape)
 
 
-def _truncated_svd(m: np.ndarray, chi: int, cutoff: float):
-    u, s, vh = np.linalg.svd(m, full_matrices=False)
-    keep = int(np.sum(s > cutoff * (s[0] if s.size else 1.0)))
-    keep = max(1, min(keep, chi))
+def _truncated_svd(m, chi: int, cutoff: float, xp=np):
+    u, s, vh = xp.linalg.svd(m, full_matrices=False)
+    if xp is np:
+        keep = int(np.sum(s > cutoff * (s[0] if s.size else 1.0)))
+        keep = max(1, min(keep, chi))
+    else:
+        # jitted path: the kept rank must be static, so the cut is by
+        # chi alone (cutoff-based rank is value-dependent)
+        keep = max(1, min(int(s.shape[0]), chi))
     return u[:, :keep], s[:keep], vh[:keep]
 
 
-def _compress_mps(
-    mps: list[np.ndarray], chi: int, cutoff: float
-) -> list[np.ndarray]:
+def _compress_mps(mps, chi: int, cutoff: float, xp=np):
     """Canonicalize left-to-right (QR), then truncate right-to-left
     (SVD). Tensors are (Dl, d, Dr)."""
     mps = list(mps)
@@ -80,22 +84,22 @@ def _compress_mps(
     # left-to-right QR: left-canonical form
     for i in range(n - 1):
         dl, d, dr = mps[i].shape
-        q, r = np.linalg.qr(mps[i].reshape(dl * d, dr))
+        q, r = xp.linalg.qr(mps[i].reshape(dl * d, dr))
         mps[i] = q.reshape(dl, d, q.shape[1])
-        mps[i + 1] = np.tensordot(r, mps[i + 1], axes=(1, 0))
+        mps[i + 1] = xp.tensordot(r, mps[i + 1], axes=(1, 0))
     # right-to-left truncated SVD
     for i in range(n - 1, 0, -1):
         dl, d, dr = mps[i].shape
-        u, s, vh = _truncated_svd(mps[i].reshape(dl, d * dr), chi, cutoff)
+        u, s, vh = _truncated_svd(
+            mps[i].reshape(dl, d * dr), chi, cutoff, xp
+        )
         mps[i] = vh.reshape(vh.shape[0], d, dr)
         carry = u * s  # (dl, keep)
-        mps[i - 1] = np.tensordot(mps[i - 1], carry, axes=(2, 0))
+        mps[i - 1] = xp.tensordot(mps[i - 1], carry, axes=(2, 0))
     return mps
 
 
-def _apply_mpo(
-    mps: list[np.ndarray], mpo: list[np.ndarray]
-) -> list[np.ndarray]:
+def _apply_mpo(mps, mpo, xp=np):
     """MPS (Dl, d_up, Dr) x MPO (Wl, Wr, d_up, d_down) →
     fat MPS (Dl·Wl, d_down, Dr·Wr)."""
     out = []
@@ -104,8 +108,8 @@ def _apply_mpo(
         wl, wr, wup, wdown = w.shape
         if dup != wup:
             raise ValueError(f"vertical bond mismatch: {dup} vs {wup}")
-        t = np.tensordot(a, w, axes=(1, 2))  # (dl, dr, wl, wr, wdown)
-        t = np.transpose(t, (0, 2, 4, 1, 3))  # (dl, wl, wdown, dr, wr)
+        t = xp.tensordot(a, w, axes=(1, 2))  # (dl, dr, wl, wr, wdown)
+        t = xp.transpose(t, (0, 2, 4, 1, 3))  # (dl, wl, wdown, dr, wr)
         out.append(t.reshape(dl * wl, wdown, dr * wr))
     return out
 
@@ -114,6 +118,7 @@ def boundary_mps_contract(
     grid: Sequence[Sequence[LeafTensor]],
     chi: int,
     cutoff: float = 0.0,
+    backend: str = "numpy",
 ) -> complex:
     """Contract a closed 2-D grid network approximately.
 
@@ -121,6 +126,18 @@ def boundary_mps_contract(
     only to the four lattice neighbours (parallel bonds allowed, fused
     per direction). ``chi`` caps the boundary-MPS bond dimension; with
     ``chi`` at least the exact boundary rank the result is exact.
+
+    ``backend="jax"`` runs the whole sweep as ONE jitted XLA program,
+    explicitly pinned to the CPU platform (complex QR/SVD has no TPU
+    lowering in this stack — the TPU execution path is split-complex):
+    every intermediate shape is static given the grid, so the compiled
+    program is cached per (shapes, chi) and reused across calls. The
+    static-rank constraint means the value-dependent ``cutoff`` is
+    numpy-only. (Platform discovery initializes all registered JAX
+    plugins; on a host whose accelerator plugin wedges at init — the
+    tunnel pathology in docs/running_on_tpu.md — pin
+    ``jax.config.update("jax_platforms", "cpu")`` process-wide first,
+    as everywhere else in this stack.)
     """
     rows = len(grid)
     if rows < 2 or any(len(r) != len(grid[0]) for r in grid):
@@ -130,6 +147,13 @@ def boundary_mps_contract(
         raise ValueError("grid rows must be non-empty")
     if chi < 1:
         raise ValueError("chi must be >= 1")
+    if backend not in ("numpy", "jax"):
+        raise ValueError(f"unknown backend {backend!r}")
+    if backend == "jax" and cutoff:
+        raise ValueError(
+            "cutoff-based rank is value-dependent; the jitted jax sweep "
+            "supports chi truncation only"
+        )
 
     legs_of = [[set(t.legs) for t in row] for row in grid]
 
@@ -146,36 +170,90 @@ def boundary_mps_contract(
             shared(r, c, r + 1, c),   # down
         )
 
-    # top row → MPS over the downward bonds: (left, down, right)
-    mps = []
-    for c in range(cols):
-        left, right, up, down = groups(0, c)
-        if up:
-            raise ValueError("top row must have no upward bonds")
-        site = _grouped(grid[0][c], (left, down, right))
-        mps.append(site)
+    def top_row():
+        out = []
+        for c in range(cols):
+            left, right, up, down = groups(0, c)
+            if up:
+                raise ValueError("top row must have no upward bonds")
+            out.append(_grouped(grid[0][c], (left, down, right)))
+        return out
 
-    # interior rows → MPOs: (left, right, up, down)
-    for r in range(1, rows - 1):
-        mpo = [_grouped(grid[r][c], groups(r, c)) for c in range(cols)]
-        mps = _apply_mpo(mps, mpo)
-        mps = _compress_mps(mps, chi, cutoff)
+    def mid_rows():
+        # lazy per row: only one interior row's dense grouped copies are
+        # alive at a time on the numpy path
+        for r in range(1, rows - 1):
+            yield [_grouped(grid[r][c], groups(r, c)) for c in range(cols)]
 
-    # bottom row closes the network: contract each site with the MPS
-    # tensor above it and chain left-to-right
-    env = np.ones((1, 1), dtype=np.complex128)  # (mps_bond, bottom_bond)
-    for c in range(cols):
-        left, right, up, down = groups(rows - 1, c)
-        if down:
-            raise ValueError("bottom row must have no downward bonds")
-        site = _grouped(grid[rows - 1][c], (left, up, right))
-        a = mps[c]  # (Dl, d, Dr)
-        # env (Dl, Bl) · a (Dl, d, Dr) · site (Bl, d, Br) -> (Dr, Br)
-        tmp = np.tensordot(env, a, axes=(0, 0))       # (Bl, d, Dr)
-        env = np.tensordot(tmp, site, axes=((0, 1), (0, 1)))  # (Dr, Br)
+    def bottom_row():
+        out = []
+        for c in range(cols):
+            left, right, up, down = groups(rows - 1, c)
+            if down:
+                raise ValueError("bottom row must have no downward bonds")
+            out.append(_grouped(grid[rows - 1][c], (left, up, right)))
+        return out
+
+    if backend == "jax":
+        import jax
+
+        # Complex QR/SVD only exists on CPU-like backends (the TPU path
+        # of this stack is split-complex and has no complex dtypes), so
+        # the sweep is pinned to the CPU platform explicitly — on an
+        # accelerator-default environment the default device would be
+        # the TPU and the program could not lower. One compiled program
+        # per (shapes, chi), cached module-wide.
+        cpu = jax.local_devices(backend="cpu")[0]
+        dtype = (
+            "complex128" if jax.config.read("jax_enable_x64") else "complex64"
+        )
+        with jax.default_device(cpu):
+            fn = _jax_sweep_fn(chi)
+            env = np.asarray(
+                fn(
+                    [jax.device_put(np.asarray(a, dtype=dtype), cpu)
+                     for a in top_row()],
+                    [
+                        [jax.device_put(np.asarray(a, dtype=dtype), cpu)
+                         for a in row]
+                        for row in mid_rows()
+                    ],
+                    [jax.device_put(np.asarray(a, dtype=dtype), cpu)
+                     for a in bottom_row()],
+                )
+            )
+    else:
+        env = _sweep(np, top_row(), mid_rows(), bottom_row(), chi, cutoff)
     if env.shape != (1, 1):
         raise ValueError("grid did not close to a scalar")
     return complex(env[0, 0])
+
+
+def _sweep(xp, top, mid_rows, bottom, chi: int, cutoff: float):
+    mps = list(top)
+    for mpo in mid_rows:
+        mps = _apply_mpo(mps, mpo, xp)
+        mps = _compress_mps(mps, chi, cutoff, xp)
+    env = xp.ones((1, 1), dtype=mps[0].dtype)
+    for a, site in zip(mps, bottom):
+        # env (Dl, Bl) · a (Dl, d, Dr) · site (Bl, d, Br) -> (Dr, Br)
+        tmp = xp.tensordot(env, a, axes=(0, 0))  # (Bl, d, Dr)
+        env = xp.tensordot(tmp, site, axes=((0, 1), (0, 1)))
+    return env
+
+
+@_functools.lru_cache(maxsize=16)
+def _jax_sweep_fn(chi: int):
+    """One jitted sweep per ``chi``; XLA's own cache then keys on the
+    input shapes, so same-shape calls (chi sweeps over one grid, many
+    grids of one geometry) compile once and reuse."""
+    import jax
+    import jax.numpy as jnp
+
+    def run(top, mid, bottom):
+        return _sweep(jnp, top, list(mid), bottom, chi, 0.0)
+
+    return jax.jit(run)
 
 
 def collapse_peps_sandwich(
