@@ -9,7 +9,6 @@ executor uses, eligibility gating, and the per-step fallback inside
 """
 
 import numpy as np
-import pytest
 
 import jax
 

@@ -18,7 +18,7 @@ from tnc_tpu.contractionpath.slicing import (
     slice_and_reconfigure,
 )
 from tnc_tpu.partitioning.native_binding import SlicedReplayer
-from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+from tnc_tpu.tensornetwork.tensor import LeafTensor
 
 
 def _random_instance(seed):

@@ -90,7 +90,6 @@ def test_amplitude_sweep_rejects_wildcards_and_ragged():
 def test_amplitude_sweep_gradient_matches_finite_difference():
     """Gradient of sum|amp|^2 over a batch of bitstrings vs per-entry
     finite differences through the per-bitstring sweep oracle."""
-    from tnc_tpu.builders.connectivity import ConnectivityLayout
     from tnc_tpu.tensornetwork.sweep import amplitude_sweep_value_and_grad
     from tnc_tpu.ops.program import flat_leaf_tensors
     from tnc_tpu.tensornetwork.tensordata import DataKind
