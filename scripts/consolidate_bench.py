@@ -38,10 +38,19 @@ def last_record(path: Path) -> dict | None:
 
 def main() -> None:
     out_dir = Path(sys.argv[1] if len(sys.argv) > 1 else ".cache/hw_campaign")
+    # start from the existing repo artifact: a collapsed campaign stage
+    # (missing/err record) must never DELETE a previously captured
+    # config from the consolidated file, only fresh records replace
     merged: dict = {}
+    existing = Path("BENCH_ALL_r04.json")
+    if existing.exists():
+        try:
+            merged = json.loads(existing.read_text())
+        except json.JSONDecodeError:
+            merged = {}
     for fname, config in NAMES.items():
         rec = last_record(out_dir / fname)
-        if rec is not None:
+        if rec is not None and "error" not in rec:
             merged[config] = rec
     print(json.dumps(merged, indent=2))
 

@@ -110,7 +110,10 @@ for cfg in ghz3 random20 qaoa30 sycamore_m20_partitioned; do
 done
 
 echo "== 8. consolidated artifact (copied into the repo: .cache/ is gitignored) =="
-python scripts/consolidate_bench.py "$out" > BENCH_ALL_r04.json 2>> "$out/watch.log" \
+# temp-then-move: consolidate READS the existing artifact as its merge
+# base, so a plain > redirect would truncate it before python runs
+python scripts/consolidate_bench.py "$out" > BENCH_ALL_r04.json.tmp 2>> "$out/watch.log" \
+  && mv BENCH_ALL_r04.json.tmp BENCH_ALL_r04.json \
   && echo "BENCH_ALL_r04.json written"
 cp -f "$out/bench_main.json" BENCH_r04_campaign.json 2>/dev/null || true
 {

@@ -34,15 +34,23 @@ PY
 while true; do
   if probe; then
     echo "$(date -u +%FT%TZ) tunnel ALIVE -> campaign" | tee -a "$out/watch.log"
+    # freshness: a stale main record must not satisfy good_capture if
+    # this campaign's window collapses before stage 1 rewrites it
+    rm -f "$out/bench_main.json"
     bash scripts/hw_campaign.sh 2>&1 | tee -a "$out/watch.log"
     echo "CAMPAIGN_DONE $(date -u +%FT%TZ)" | tee -a "$out/watch.log"
     if good_capture; then
       echo "GOOD_CAPTURE $(date -u +%FT%TZ)" | tee -a "$out/watch.log"
       exit 0
     fi
-    # the tunnel answered but the window collapsed mid-campaign (the r3
-    # failure mode): keep watching for another window
-    echo "$(date -u +%FT%TZ) capture not good; re-arming" | tee -a "$out/watch.log"
+    # either the window collapsed mid-campaign (the r3 failure mode) or
+    # the campaign genuinely measured sub-threshold: re-arm with a real
+    # backoff so a healthy-but-slow tunnel doesn't run campaigns
+    # back-to-back for hours
+    echo "$(date -u +%FT%TZ) capture not good; re-arming after backoff" \
+      | tee -a "$out/watch.log"
+    sleep 1800
+    continue
   fi
   now=$(date +%s)
   if [ $((now - start)) -gt "$MAX_WAIT_S" ]; then

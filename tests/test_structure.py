@@ -1,0 +1,40 @@
+"""Structured-leaf analysis (docs/future_work.md item 6 groundwork)."""
+
+import numpy as np
+
+from tnc_tpu.gates import load_gate
+from tnc_tpu.ops.structure import classify_array, program_structure_report
+
+
+def test_gate_classification():
+    assert classify_array(load_gate("cz")) == "diagonal"
+    assert classify_array(load_gate("t")) == "diagonal"
+    assert classify_array(load_gate("rz", [0.3])) == "diagonal"
+    assert classify_array(load_gate("cx")) == "permutation_scaled"
+    assert classify_array(load_gate("swap")) == "permutation_scaled"
+    assert classify_array(load_gate("x")) == "permutation_scaled"
+    assert classify_array(load_gate("h")) == "dense"
+    assert classify_array(load_gate("iswap")) == "monomial"  # i phases
+    assert classify_array(np.eye(4)) == "identity_scaled"
+    assert classify_array(2j * np.eye(4)) == "identity_scaled"
+    assert classify_array(np.zeros((2, 2))) == "diagonal"
+    assert classify_array(np.arange(6.0)) == "dense"  # non-square
+
+
+def test_program_structure_report_on_circuit():
+    from tnc_tpu.builders.connectivity import ConnectivityLayout
+    from tnc_tpu.builders.random_circuit import random_circuit
+    from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+
+    rng = np.random.default_rng(3)
+    tn = random_circuit(
+        10, 6, 0.5, 0.5, rng, ConnectivityLayout.LINE, bitstring="0" * 10
+    )
+    result = Greedy(OptMethod.GREEDY).find_path(tn)
+    report = program_structure_report(tn, result.replace_path().toplevel)
+    assert report.total_flops > 0
+    assert sum(report.step_flops.values()) == report.total_flops
+    # circuits carry real structure: some non-dense leaves must exist
+    dense = report.leaf_classes.get("dense", 0)
+    assert sum(report.leaf_classes.values()) > dense
+    assert 0.0 <= report.exploitable_fraction <= 1.0
