@@ -199,7 +199,8 @@ def bench_sycamore_amplitude():
     # is cached on disk like the reference's Sweep/Run artifact split
     # (``benchmark/src/main.rs:223-242``): a hardware attempt should spend
     # <1 s loading the plan, not ~107 s recomputing it (VERDICT r3 #3).
-    from tnc_tpu.benchmark.cache import ArtifactCache, cache_key
+    from tnc_tpu.benchmark.cache import ArtifactCache
+    from tnc_tpu.benchmark.northstar import northstar_plan_key
 
     target = 2.0**target_log2
     plan_t0 = time.monotonic()
@@ -208,14 +209,7 @@ def bench_sycamore_amplitude():
             os.path.dirname(os.path.abspath(__file__)), ".cache", "plans"
         )
     )
-    # v2: bump when planner/slicer behavior changes invalidate old plans
-    key = cache_key(
-        "northstar-plan-v2",
-        f"sycamore-{qubits}-m{depth}-seed{seed}-trials{ntrials}",
-        seed,
-        1,
-        f"hyper-target2^{target_log2:g}",
-    )
+    key = northstar_plan_key(qubits, depth, seed, ntrials, target_log2)
     inputs = list(tn.tensors)
     cached = None if os.environ.get("BENCH_NO_PLAN_CACHE") == "1" else cache.load_obj(key)
     if cached is not None:
@@ -415,15 +409,11 @@ def _oracle_artifact(cache, plan_key, sp, arrays, n_sub, n_time) -> dict:
     can legitimately change across code versions (e.g. the native replay
     kernel shifted FP tie-breaks in leg selection) — a stale pairing is
     detected and recomputed rather than producing garbage parity."""
-    import hashlib
-    import pickle
-
+    from tnc_tpu.benchmark.northstar import oracle_key, plan_fingerprint
     from tnc_tpu.ops.sliced import execute_sliced_numpy, sliced_partials_numpy
 
-    plan_fp = hashlib.sha256(
-        pickle.dumps((sp.signature(),))
-    ).hexdigest()[:16]
-    okey = plan_key.replace("northstar-plan", "northstar-oracle")
+    plan_fp = plan_fingerprint(sp)
+    okey = oracle_key(plan_key)
     obj = (
         None
         if os.environ.get("BENCH_NO_PLAN_CACHE") == "1"
@@ -450,28 +440,64 @@ def _oracle_artifact(cache, plan_key, sp, arrays, n_sub, n_time) -> dict:
             f"slices, baseline {obj['cpu_per_slice_s']:.1f}s/slice"
         )
         return obj
-    # incremental + parallel: slices are minutes of numpy each, so fan
-    # a batch of `workers` out over the process pool and store after
-    # every batch — progress survives a killed prewarm, and a later
-    # invocation computes only the remainder
-    workers = max(1, os.cpu_count() or 1)
-    s = have
-    while s < n_sub:
-        batch = list(range(s, min(s + workers, n_sub)))
-        t0 = time.monotonic()
-        part = sliced_partials_numpy(
-            sp, arrays, dtype=np.complex128, slice_ids=batch, workers=workers
-        )
+    # incremental + parallel: slices are minutes of numpy each. Store
+    # after every completed slice so a killed prewarm loses at most one
+    # slice; with multiple cores, ONE spawn pool is started for all
+    # remaining slices (pool cold-start + input pickling cost seconds,
+    # so per-batch pools would pay them repeatedly) and results are
+    # consumed in id order to keep the stored prefix contiguous.
+    workers = max(1, min(os.cpu_count() or 1, n_sub - have))
+
+    def append_and_store(s: int, part: np.ndarray) -> None:
         obj["per_slice"] = (
             part
             if obj["per_slice"] is None
             else np.concatenate([obj["per_slice"], part])
         )
-        s = batch[-1] + 1
-        obj["n"] = s
+        obj["n"] = s + 1
         cache.store_obj(okey, obj)
+
+    if have < n_sub and workers > 1:
+        import concurrent.futures
+        import multiprocessing
+        import pickle
+        import zlib
+
+        from tnc_tpu.ops.sliced import _par_init, _par_slice
+
+        full = [np.asarray(a, dtype=np.complex128) for a in arrays]
+        blob = zlib.compress(pickle.dumps((sp, full)), 1)
+        try:
+            ctx = multiprocessing.get_context("spawn")
+            with concurrent.futures.ProcessPoolExecutor(
+                max_workers=workers, mp_context=ctx,
+                initializer=_par_init, initargs=(blob,),
+            ) as pool:
+                futures = {
+                    s: pool.submit(_par_slice, s) for s in range(have, n_sub)
+                }
+                for s in range(have, n_sub):
+                    t0 = time.monotonic()
+                    part = np.asarray(futures[s].result()).reshape(
+                        (1,) + tuple(sp.program.result_shape)
+                    )
+                    append_and_store(s, part)
+                    log(
+                        f"[bench] oracle slice {s + 1}/{n_sub} in "
+                        f"{time.monotonic() - t0:.1f}s (cached)"
+                    )
+            have = n_sub
+        except Exception as e:  # pool failure: serial loop below
+            log(f"[bench] oracle pool failed ({e}); continuing serially")
+            have = int(obj.get("n", have))
+    for s in range(have, n_sub):
+        t0 = time.monotonic()
+        part = sliced_partials_numpy(
+            sp, arrays, dtype=np.complex128, slice_ids=[s], workers=1
+        )
+        append_and_store(s, part)
         log(
-            f"[bench] oracle slices {batch[0] + 1}-{s}/{n_sub} in "
+            f"[bench] oracle slice {s + 1}/{n_sub} in "
             f"{time.monotonic() - t0:.1f}s (cached)"
         )
     if obj.get("cpu_timed_slices", 0) < n_time:

@@ -48,10 +48,20 @@ def main() -> None:
             merged = json.loads(existing.read_text())
         except json.JSONDecodeError:
             merged = {}
+    def is_tpu(rec: dict) -> bool:
+        return str(rec.get("device", "")).startswith("tpu")
+
     for fname, config in NAMES.items():
         rec = last_record(out_dir / fname)
-        if rec is not None and "error" not in rec:
-            merged[config] = rec
+        if rec is None or "error" in rec:
+            continue
+        # never replace captured hardware evidence with a cpu-fallback
+        # record from a later collapsed window; cpu records only fill
+        # gaps or refresh other cpu records
+        old = merged.get(config)
+        if old is not None and is_tpu(old) and not is_tpu(rec):
+            continue
+        merged[config] = rec
     print(json.dumps(merged, indent=2))
 
 

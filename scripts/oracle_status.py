@@ -13,7 +13,11 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from tnc_tpu.benchmark.cache import ArtifactCache, cache_key  # noqa: E402
+from tnc_tpu.benchmark.cache import ArtifactCache  # noqa: E402
+from tnc_tpu.benchmark.northstar import (  # noqa: E402
+    northstar_plan_key,
+    oracle_key,
+)
 
 
 def main() -> None:
@@ -29,14 +33,8 @@ def main() -> None:
             "plans",
         )
     )
-    key = cache_key(
-        "northstar-plan-v2",
-        f"sycamore-{qubits}-m{depth}-seed{seed}-trials{ntrials}",
-        seed,
-        1,
-        f"hyper-target2^{target_log2:g}",
-    )
-    okey = key.replace("northstar-plan", "northstar-oracle")
+    key = northstar_plan_key(qubits, depth, seed, ntrials, target_log2)
+    okey = oracle_key(key)
     obj = cache.load_obj(okey)
     status = {
         "plan_cached": cache.has(key),

@@ -10,9 +10,7 @@ sliced program from the cached plan, computes the fingerprint exactly
 as ``bench._oracle_artifact`` does, and stamps the oracle artifact.
 """
 
-import hashlib
 import os
-import pickle
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -25,6 +23,7 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 
 from tnc_tpu.benchmark.cache import ArtifactCache  # noqa: E402
+from tnc_tpu.benchmark.northstar import plan_fingerprint  # noqa: E402
 
 
 def main() -> None:
@@ -68,7 +67,7 @@ def main() -> None:
             # network); leave unstamped — strict check will recompute
             print(f"{okey}: not a sycamore-53 m=14 plan ({e}); skipped")
             continue
-        fp = hashlib.sha256(pickle.dumps((sp.signature(),))).hexdigest()[:16]
+        fp = plan_fingerprint(sp)
         obj["plan_fp"] = fp
         cache.store_obj(okey, obj)
         print(f"{okey}: stamped {fp}")

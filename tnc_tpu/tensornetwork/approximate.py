@@ -298,11 +298,17 @@ def attach_random_data(
     tn: CompositeTensor, rng: np.random.Generator, scale: float | None = None
 ) -> CompositeTensor:
     """Fill every metadata-only leaf with seeded complex Gaussian data
-    (builder networks like ``peps`` are metadata-only). ``scale``
-    defaults to per-tensor ``1/sqrt(size)`` so contractions stay O(1)."""
+    (builder networks like ``peps`` are metadata-only); leaves that
+    already carry data (gates, matrices, file refs) are left untouched.
+    ``scale`` defaults to per-tensor ``1/sqrt(size)`` so contractions
+    stay O(1)."""
+    from tnc_tpu.tensornetwork.tensordata import DataKind
+
     for leaf in tn.tensors:
         if isinstance(leaf, CompositeTensor):
             attach_random_data(leaf, rng, scale)
+            continue
+        if leaf.data.kind is not DataKind.NONE:
             continue
         shape = leaf.shape
         s = scale if scale is not None else 1.0 / np.sqrt(
