@@ -53,6 +53,7 @@ print(f"<Z> = {value.reshape(-1)[0].real:+.6f}   (cos θ = {np.cos(theta):+.6f})
 eps = 1e-7
 from tnc_tpu.gates import load_gate, load_gate_adjoint
 
+total = 0.0
 for slot, g in zip(slots, grads):
     leaf = flat_leaf_tensors(tn)[slot]
     name, angles, adj = leaf.data.payload
@@ -60,21 +61,7 @@ for slot, g in zip(slots, grads):
     dgate = (load(name, [theta + eps]) - load(name, [theta - eps])) / (2 * eps)
     contrib = np.sum(g * dgate.reshape(g.shape)).real
     print(f"  slot {slot}: dθ contribution {contrib:+.6f}")
-total = sum(
-    np.sum(
-        g
-        * (
-            (load_gate_adjoint if flat_leaf_tensors(tn)[s].data.payload[2] else load_gate)(
-                "rx", [theta + eps]
-            )
-            - (load_gate_adjoint if flat_leaf_tensors(tn)[s].data.payload[2] else load_gate)(
-                "rx", [theta - eps]
-            )
-        ).reshape(g.shape)
-        / (2 * eps)
-    ).real
-    for s, g in zip(slots, grads)
-)
+    total += contrib
 print(f"d<Z>/dθ = {total:+.6f}   (-sin θ = {-np.sin(theta):+.6f})")
 assert abs(total + np.sin(theta)) < 1e-5
 

@@ -48,8 +48,11 @@ def main() -> None:
             merged = json.loads(existing.read_text())
         except json.JSONDecodeError:
             merged = {}
-    def is_tpu(rec: dict) -> bool:
-        return str(rec.get("device", "")).startswith("tpu")
+    def is_hw(rec: dict) -> bool:
+        # device is "{platform}:{device_kind}" — anything that isn't a
+        # CPU / cpu-fallback / virtual-mesh record is hardware evidence
+        dev = str(rec.get("device", ""))
+        return bool(dev) and not dev.startswith(("cpu", "virtual"))
 
     for fname, config in NAMES.items():
         rec = last_record(out_dir / fname)
@@ -59,7 +62,7 @@ def main() -> None:
         # record from a later collapsed window; cpu records only fill
         # gaps or refresh other cpu records
         old = merged.get(config)
-        if old is not None and is_tpu(old) and not is_tpu(rec):
+        if old is not None and is_hw(old) and not is_hw(rec):
             continue
         merged[config] = rec
     print(json.dumps(merged, indent=2))
