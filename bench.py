@@ -42,6 +42,7 @@ Env knobs:
     tunnel's profiler wedges — see _maybe_trace),
   BENCH_SUBSET_TIMEOUT (900; parity-subset subprocess, accelerators),
   BENCH_INLINE_FETCH=1 (accelerators: fetch parity in-process, pre-r4),
+  BENCH_NO_PARITY=1 (skip parity entirely; wall-clock A/B stages),
   BENCH_PRECISION float32 (full-f32 dots) | default (bf16 3-pass, faster),
   BENCH_STAGE_TIMEOUT (1500 + 2*BENCH_FULL_SECONDS; per retry stage)
 """
@@ -372,7 +373,11 @@ def bench_sycamore_amplitude():
     on_accel = jax.devices()[0].platform != "cpu"
     n_sub = max(1, min(_env_int("BENCH_PARITY_SLICES", 16), slicing.num_slices))
     parity_skip_reason = None
-    if on_accel and os.environ.get("BENCH_INLINE_FETCH") != "1":
+    if os.environ.get("BENCH_NO_PARITY") == "1":
+        # wall-clock-only A/B stages: the parity subprocess costs ~2 min
+        # of hardware window (fresh client init + probe) per invocation
+        parity_skip_reason = "BENCH_NO_PARITY=1"
+    elif on_accel and os.environ.get("BENCH_INLINE_FETCH") != "1":
         got_partial = _subset_via_subprocess(n_sub)
         if got_partial is None:  # one retry: a fresh client each attempt
             got_partial = _subset_via_subprocess(n_sub)
@@ -398,6 +403,23 @@ def bench_sycamore_amplitude():
     peak = _device_peak_flops(jax.devices()[0])
     if peak:
         extra["mfu"] = round(achieved / peak, 4)
+        if achieved > peak:
+            # Physicality guard: implied throughput above the device's
+            # bf16 headline peak means the timed region did not await
+            # completion (measured r4: the tunnel resolves readiness of
+            # a single fori_loop dispatch early — 4096 slices "in" 70 ms
+            # = 6x peak — while multi-dispatch chunked timing is linear
+            # in slice count and physically consistent). Never publish
+            # such a number as a claim.
+            extra["timing_suspect"] = (
+                "implied FLOP/s exceeds device peak; completion not "
+                "awaited by the timed region (tunnel early-ready — see "
+                "CAMPAIGN_EVIDENCE_r04.md)"
+            )
+            log(
+                f"[bench] TIMING SUSPECT: {achieved / 1e12:.1f} TFLOP/s "
+                f"> device peak {peak / 1e12:.0f}"
+            )
     log(
         f"[bench] achieved {achieved / 1e12:.2f} TFLOP/s"
         + (f" (MFU {achieved / peak:.1%} of bf16 peak)" if peak else "")
@@ -409,7 +431,11 @@ def bench_sycamore_amplitude():
     # results and the serial baseline timing are cached keyed by the
     # plan (BENCH_PREWARM=1 computes them tunnel-independently).
     oracle = _oracle_artifact(
-        cache, key, sp, arrays, n_sub,
+        cache, key, sp, arrays,
+        # parity-skipped stages still need the serial CPU baseline for
+        # vs_baseline, but must not pay minutes-per-slice of complex128
+        # numpy for per-slice oracle results nothing will compare
+        0 if parity_skip_reason is not None else n_sub,
         max(1, min(cpu_slices, slicing.num_slices)),
     )
     if parity_skip_reason is None:
@@ -609,6 +635,35 @@ def _sa_rebalance(tn, partitioning, sa_rng, sa_seconds):
     if max_rounds:
         report["sa_rounds"] = max_rounds
     return best_solution[0], report
+
+
+def _is_hw_device(dev: str) -> bool:
+    """device is "{platform}:{device_kind}" — anything that isn't a
+    CPU / cpu-fallback / virtual-mesh record is hardware evidence
+    (same rule as scripts/consolidate_bench.py)."""
+    return bool(dev) and not dev.startswith(("cpu", "virtual"))
+
+
+def _attach_last_hw_record(record: dict, config: str) -> None:
+    """On a cpu-fallback capture, attach the round's most recent ON-DEVICE
+    record for the same config from the consolidated repo artifact, so a
+    collapsed tunnel window at capture time (the round-3 failure: good
+    mid-round hardware evidence, cpu-fallback in the official JSON)
+    doesn't strip the artifact of its pointer to real measurements. The
+    fallback stays clearly labelled — this only ADDs provenance."""
+    import glob
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:  # newest consolidated round artifact wins
+        art = sorted(glob.glob(os.path.join(here, "BENCH_ALL_r*.json")))[-1]
+        with open(art) as f:
+            merged = json.load(f)
+        prior = merged.get(config)
+        if isinstance(prior, dict) and _is_hw_device(str(prior.get("device", ""))):
+            record["last_hw_record"] = prior
+            record["last_hw_record_source"] = os.path.basename(art)
+    except Exception:  # best-effort annotation must never break the run
+        pass
 
 
 def _subset_via_subprocess(n_sub: int) -> "np.ndarray | None":
@@ -1069,6 +1124,7 @@ def main() -> None:
         if platform == "cpu-fallback":
             record["device"] = "cpu-fallback"
             record["note"] = "accelerator init failed; measured on CPU"
+            _attach_last_hw_record(record, config)
         _emit(record)
         if platform not in ("cpu", "cpu-fallback"):
             # Skip interpreter teardown: a wedged tunnel client can hang
@@ -1157,6 +1213,7 @@ def main() -> None:
                 if cpu_stage:
                     record["device"] = "cpu-fallback"
                     record["note"] = "accelerator run failed; measured on CPU"
+                    _attach_last_hw_record(record, config)
                 else:
                     record["retry_stage"] = stage
                 _emit(record)

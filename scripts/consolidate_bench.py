@@ -1,7 +1,8 @@
 #!/usr/bin/env python
 """Merge the campaign's per-config bench JSONs into one artifact.
 
-Usage: python scripts/consolidate_bench.py .cache/hw_campaign
+Usage: python scripts/consolidate_bench.py [.cache/hw_campaign]
+           [--artifact BENCH_ALL_rNN.json]
 
 Emits a single JSON object mapping BASELINE.md config names to their
 bench records (the reference benchmark's consolidated results file,
@@ -9,9 +10,14 @@ bench records (the reference benchmark's consolidated results file,
 record per config.
 """
 
+import argparse
 import json
 import sys
 from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+from bench import _is_hw_device  # noqa: E402 — the one hardware-device rule
 
 NAMES = {
     "bench_ghz3.json": "ghz3",
@@ -36,23 +42,30 @@ def last_record(path: Path) -> dict | None:
         return None
 
 
+def newest_artifact() -> Path:
+    """Newest consolidated round artifact in the repo root — the same
+    resolution bench.py's provenance helper uses (anchored to the repo,
+    not the cwd, so running from any directory merges the same base)."""
+    candidates = sorted(REPO_ROOT.glob("BENCH_ALL_r*.json"))
+    return candidates[-1] if candidates else REPO_ROOT / "BENCH_ALL_r04.json"
+
+
 def main() -> None:
-    out_dir = Path(sys.argv[1] if len(sys.argv) > 1 else ".cache/hw_campaign")
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("out_dir", nargs="?", default=".cache/hw_campaign")
+    ap.add_argument("--artifact", type=Path, default=None)
+    args = ap.parse_args()
+    out_dir = Path(args.out_dir)
     # start from the existing repo artifact: a collapsed campaign stage
     # (missing/err record) must never DELETE a previously captured
     # config from the consolidated file, only fresh records replace
+    existing = args.artifact if args.artifact is not None else newest_artifact()
     merged: dict = {}
-    existing = Path("BENCH_ALL_r04.json")
     if existing.exists():
         try:
             merged = json.loads(existing.read_text())
         except json.JSONDecodeError:
             merged = {}
-    def is_hw(rec: dict) -> bool:
-        # device is "{platform}:{device_kind}" — anything that isn't a
-        # CPU / cpu-fallback / virtual-mesh record is hardware evidence
-        dev = str(rec.get("device", ""))
-        return bool(dev) and not dev.startswith(("cpu", "virtual"))
 
     for fname, config in NAMES.items():
         rec = last_record(out_dir / fname)
@@ -62,7 +75,11 @@ def main() -> None:
         # record from a later collapsed window; cpu records only fill
         # gaps or refresh other cpu records
         old = merged.get(config)
-        if old is not None and is_hw(old) and not is_hw(rec):
+        if (
+            isinstance(old, dict)
+            and _is_hw_device(str(old.get("device", "")))
+            and not _is_hw_device(str(rec.get("device", "")))
+        ):
             continue
         merged[config] = rec
     print(json.dumps(merged, indent=2))

@@ -70,7 +70,7 @@ echo "rc=$? $(tail -1 "$out/hw_tier.log")"
 echo "== 3. loop-unroll A/B (256-slice subset) =="
 for unroll in 1 8; do
   BENCH_EXEC=loop BENCH_LOOP_UNROLL=$unroll BENCH_MAX_SLICES=256 \
-    BENCH_REPS=1 BENCH_TRACE=0 BENCH_NO_RETRY=1 \
+    BENCH_REPS=1 BENCH_TRACE=0 BENCH_NO_RETRY=1 BENCH_NO_PARITY=1 \
     timeout 1800 python bench.py \
     > "$out/bench_loop_u$unroll.json" 2> "$out/bench_loop_u$unroll.log"
   echo "unroll=$unroll rc=$? $(cat "$out/bench_loop_u$unroll.json" 2>/dev/null | tail -1)"
@@ -79,7 +79,7 @@ done
 echo "== 4. lanemix take-vs-matmul A/B (chunked, 256-slice subset) =="
 for mode in matmul take; do
   TNC_TPU_LANEMIX=$mode BENCH_MAX_SLICES=256 BENCH_REPS=1 BENCH_TRACE=0 \
-    BENCH_NO_RETRY=1 timeout 1800 python bench.py \
+    BENCH_NO_RETRY=1 BENCH_NO_PARITY=1 timeout 1800 python bench.py \
     > "$out/bench_lanemix_$mode.json" 2> "$out/bench_lanemix_$mode.log"
   echo "lanemix=$mode rc=$? $(cat "$out/bench_lanemix_$mode.json" 2>/dev/null | tail -1)"
 done
@@ -96,7 +96,7 @@ done
 echo "== 6. chunk-size sweep (256-slice subset) =="
 for cs in 24 96; do
   BENCH_CHUNK_STEPS=$cs BENCH_MAX_SLICES=256 BENCH_REPS=1 BENCH_TRACE=0 \
-    BENCH_NO_RETRY=1 timeout 1800 python bench.py \
+    BENCH_NO_RETRY=1 BENCH_NO_PARITY=1 timeout 1800 python bench.py \
     > "$out/bench_chunk_$cs.json" 2> "$out/bench_chunk_$cs.log"
   echo "chunk=$cs rc=$? $(cat "$out/bench_chunk_$cs.json" 2>/dev/null | tail -1)"
 done
@@ -112,9 +112,12 @@ done
 echo "== 8. consolidated artifact (copied into the repo: .cache/ is gitignored) =="
 # temp-then-move: consolidate READS the existing artifact as its merge
 # base, so a plain > redirect would truncate it before python runs
-python scripts/consolidate_bench.py "$out" > BENCH_ALL_r04.json.tmp 2>> "$out/watch.log" \
-  && mv BENCH_ALL_r04.json.tmp BENCH_ALL_r04.json \
-  && echo "BENCH_ALL_r04.json written"
+art=$(ls BENCH_ALL_r*.json 2>/dev/null | sort | tail -1)
+art=${art:-BENCH_ALL_r04.json}
+python scripts/consolidate_bench.py "$out" --artifact "$art" \
+    > "$art.tmp" 2>> "$out/watch.log" \
+  && mv "$art.tmp" "$art" \
+  && echo "$art written"
 cp -f "$out/bench_main.json" BENCH_r04_campaign.json 2>/dev/null || true
 {
   echo "# Campaign evidence ($(date -u +%FT%TZ))"

@@ -56,6 +56,30 @@ def _ghz_network(n=16):
     return tn, result
 
 
+def _hbm_scale_program():
+    """A deterministic instance whose greedy program peaks at ~2^29
+    bytes split-complex (2^26 elements) — big enough that HBM budget
+    questions are meaningful, small enough to compile on a 16 GB v5e.
+    LINE-layout circuits cannot serve here: their chain structure keeps
+    greedy peaks near 2^20 bytes at any qubit count, so the budget
+    tests would assert on toys (measured round 4)."""
+    from tnc_tpu.builders.connectivity import ConnectivityLayout
+    from tnc_tpu.builders.random_circuit import random_circuit
+    from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+    from tnc_tpu.ops.program import build_program
+    from tnc_tpu.tensornetwork.simplify import simplify_network
+
+    rng = np.random.default_rng(4)
+    tn = simplify_network(
+        random_circuit(
+            32, 10, 0.5, 0.5, rng, ConnectivityLayout.SYCAMORE,
+            bitstring="0" * 32,
+        )
+    )
+    result = Greedy(OptMethod.GREEDY).find_path(tn)
+    return tn, build_program(tn, result.replace_path())
+
+
 @requires_tpu_env
 def test_whole_path_contraction_parity(device):
     """complex64 split-complex whole-path program vs numpy oracle."""
@@ -107,8 +131,19 @@ def test_sliced_execution_parity(device):
     tn, result = _ghz_network(12)
     replace = result.replace_path()
     inputs = list(tn.tensors)
-    slicing = find_slicing(inputs, replace.toplevel, max(result.size / 8, 2.0))
-    if slicing.num_slices < 2:
+    # GHZ networks are chain-structured: an aggressive target can be
+    # unreachable (find_slicing raises), so relax it stepwise and skip
+    # if the instance will not slice at all
+    for divisor in (8.0, 4.0, 2.0):
+        try:
+            slicing = find_slicing(
+                inputs, replace.toplevel, max(result.size / divisor, 2.0)
+            )
+            if slicing.num_slices >= 2:
+                break
+        except ValueError:
+            continue
+    else:
         pytest.skip("network did not slice")
     sp = build_sliced_program(tn, replace, slicing)
     arrays = [leaf.data.into_data() for leaf in flat_leaf_tensors(tn)]
@@ -145,23 +180,12 @@ def test_compiled_peak_matches_budget_model(device):
     a 34 GB tile-padded allocation (VERDICT round 2, weak #1/#2)."""
     import jax
 
-    from tnc_tpu.builders.connectivity import ConnectivityLayout
-    from tnc_tpu.builders.random_circuit import random_circuit
-    from tnc_tpu.contractionpath.paths import Greedy, OptMethod
     from tnc_tpu.ops.budget import compiled_peak_bytes, program_peak_bytes
-    from tnc_tpu.ops.program import build_program, flat_leaf_tensors
+    from tnc_tpu.ops.program import flat_leaf_tensors
     from tnc_tpu.ops.split_complex import run_steps_split
-    from tnc_tpu.tensornetwork.simplify import simplify_network
 
     # ~2^26-element intermediates: a significant fraction of v5e HBM
-    rng = np.random.default_rng(4)
-    tn = simplify_network(
-        random_circuit(
-            26, 12, 0.5, 0.5, rng, ConnectivityLayout.LINE, bitstring="0" * 26
-        )
-    )
-    result = Greedy(OptMethod.GREEDY).find_path(tn)
-    program = build_program(tn, result.replace_path())
+    tn, program = _hbm_scale_program()
     est = program_peak_bytes(program, split_complex=True, batch=1)
     assert est.peak_bytes > 1 << 28, "test network too small to be meaningful"
 
@@ -250,21 +274,9 @@ def test_amplitude_sweep_on_device(device):
 def test_budget_clamp_prevents_oom_scale_batches(device):
     """The chunked executor's auto-clamp must reduce an oversized batch
     request to one that fits the real device's HBM."""
-    from tnc_tpu.builders.connectivity import ConnectivityLayout
-    from tnc_tpu.builders.random_circuit import random_circuit
-    from tnc_tpu.contractionpath.paths import Greedy, OptMethod
     from tnc_tpu.ops.budget import clamp_slice_batch, device_hbm_bytes
-    from tnc_tpu.ops.program import build_program
-    from tnc_tpu.tensornetwork.simplify import simplify_network
 
-    rng = np.random.default_rng(4)
-    tn = simplify_network(
-        random_circuit(
-            26, 12, 0.5, 0.5, rng, ConnectivityLayout.LINE, bitstring="0" * 26
-        )
-    )
-    result = Greedy(OptMethod.GREEDY).find_path(tn)
-    program = build_program(tn, result.replace_path())
+    tn, program = _hbm_scale_program()
     hbm = device_hbm_bytes(device)
     clamped = clamp_slice_batch(program, 4096, device=device)
     # a 4096-wide batch of 2^26-element intermediates cannot fit 16-32 GB
