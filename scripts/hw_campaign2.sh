@@ -1,0 +1,138 @@
+#!/usr/bin/env bash
+# Second-wave hardware campaign (round 4, post-capture): runs when the
+# tunnel next answers. The first campaign landed the official record
+# (BENCH_ALL_r04.json); this wave settles the open questions it raised,
+# ordered so the cheapest highest-value stages run before the stages
+# with known tunnel-wedge risk (the wedge probability grows with
+# cumulative window use — campaign 1 wedged only at its very end):
+#
+#   1. full-measured GAUSS north-star — ~10% faster than naive at equal
+#      parity margin in the A/Bs; replaces the official record only on
+#      parity pass AND better wall-clock (and then becomes the bench
+#      default via .cache/best_config.json)
+#   2. hardware test tier — re-run after the r4 test fixes
+#   3. sync audit — is blocked host=False timing honest per executor?
+#      (the loop executor's non-physical A/B numbers; certifies the
+#      official chunked record's integrity)
+#   4. if the audit certifies the loop executor, a full-measured loop
+#      capture too (potential further win)
+#
+# Usage: bash scripts/hw_campaign2.sh
+set -uo pipefail
+cd "$(dirname "$0")/.."
+out=.cache/hw_campaign
+mkdir -p "$out"
+
+probe() {
+  timeout 90 python -c "
+import jax, time
+import jax.numpy as jnp
+assert jax.devices()[0].platform == 'tpu', jax.devices()
+t0 = time.time()
+x = jnp.ones((256, 256), jnp.bfloat16)
+print('probe ok:', float((x @ x).sum()), f'{time.time()-t0:.1f}s')" \
+    > "$out/probe.log" 2>&1
+}
+
+if ! probe; then
+  echo "tunnel unreachable; aborting campaign2" | tee "$out/STATUS2"
+  exit 1
+fi
+echo "tunnel alive, campaign2 starting $(date -u +%H:%M:%SZ)" | tee "$out/STATUS2"
+
+promote() {
+  # promote $1 over the campaign main record iff it is an on-device,
+  # parity-passing, non-suspect, fully-measured record with a better
+  # wall-clock; on success, pin its config as the bench default so the
+  # driver's end-of-round run uses the promoted configuration ($2 is a
+  # JSON fragment of tuned defaults, e.g. '{"complex_mult": "gauss"}')
+  python - "$1" "$2" << 'PY'
+import glob, json, sys
+cand_path, tuned = sys.argv[1], json.loads(sys.argv[2])
+try:
+    cand = json.loads(
+        [l for l in open(cand_path) if l.strip().startswith("{")][-1]
+    )
+    # incumbent = this campaign's already-promoted record if any (so a
+    # later stage never overwrites an earlier FASTER promotion), else
+    # the newest consolidated round artifact (stage-5's resolution)
+    try:
+        cur = json.loads(
+            [
+                l
+                for l in open(".cache/hw_campaign/bench_main.json")
+                if l.strip().startswith("{")
+            ][-1]
+        )
+    except Exception:
+        art = sorted(glob.glob("BENCH_ALL_r*.json"))[-1]
+        cur = json.load(open(art))["sycamore_amplitude"]
+except Exception as e:
+    sys.exit(f"promote: cannot read records: {e}")
+ok = (
+    str(cand.get("device", "")).startswith("tpu")
+    and "error" not in cand
+    and "timing_suspect" not in cand
+    and "extrapolated_from_slices" not in cand
+    and cand.get("parity", 1.0) <= 1e-5
+    and cand.get("value", 1e30) < cur.get("value", 0)
+)
+if not ok:
+    sys.exit(f"promote: candidate not better/valid ({cand_path})")
+open(".cache/hw_campaign/bench_main.json", "w").write(json.dumps(cand) + "\n")
+try:
+    best = json.load(open(".cache/best_config.json"))
+except Exception:
+    best = {}
+best.update(tuned)
+open(".cache/best_config.json", "w").write(json.dumps(best))
+print(f"promoted {cand_path} -> bench_main.json "
+      f"({cand.get('value')}s vs {cur.get('value')}s); tuned={best}")
+PY
+}
+
+echo "== 1. full-measured gauss north-star (official-record candidate) =="
+BENCH_COMPLEX_MULT=gauss BENCH_NO_RETRY=1 timeout 3600 python bench.py \
+  > "$out/bench_gauss_full.json" 2> "$out/bench_gauss_full.log"
+echo "rc=$? $(cat "$out/bench_gauss_full.json" 2>/dev/null | tail -1)"
+promote "$out/bench_gauss_full.json" '{"complex_mult": "gauss"}' \
+  && echo "gauss promoted"
+
+echo "== 2. hardware test tier (post-fix re-run) =="
+timeout 2400 python -m pytest tests/test_tpu_hardware.py -q -p no:cacheprovider \
+  > "$out/hw_tier2.log" 2>&1
+echo "rc=$? $(tail -1 "$out/hw_tier2.log")"
+
+echo "== 3. sync audit (timing honesty per executor) =="
+timeout 7200 python scripts/sync_audit.py \
+  > "$out/sync_audit.json" 2> "$out/sync_audit.log"
+echo "rc=$? $(tail -c 400 "$out/sync_audit.json" 2>/dev/null)"
+cp -f "$out/sync_audit.json" SYNC_AUDIT_r04.json 2>/dev/null || true
+
+echo "== 4. conditional: full-measured loop capture if audit certified it =="
+loop_ok=$(python -c "
+import json
+try:
+    a = json.load(open('$out/sync_audit.json'))
+    print(1 if a.get('loop_256', {}).get('timing_honest') else 0)
+except Exception:
+    print(0)")
+if [ "$loop_ok" = "1" ]; then
+  BENCH_EXEC=loop BENCH_NO_RETRY=1 timeout 5400 python bench.py \
+    > "$out/bench_loop_full.json" 2> "$out/bench_loop_full.log"
+  echo "rc=$? $(cat "$out/bench_loop_full.json" 2>/dev/null | tail -1)"
+  promote "$out/bench_loop_full.json" '{"exec": "loop"}' \
+    && echo "loop promoted"
+else
+  echo "loop executor not certified by audit; skipping"
+fi
+
+echo "== 5. consolidate =="
+art=$(ls BENCH_ALL_r*.json 2>/dev/null | sort | tail -1)
+art=${art:-BENCH_ALL_r04.json}
+python scripts/consolidate_bench.py "$out" --artifact "$art" \
+    > "$art.tmp" 2>> "$out/watch.log" \
+  && mv "$art.tmp" "$art" \
+  && echo "$art written"
+cp -f "$out/bench_main.json" BENCH_r04_campaign.json 2>/dev/null || true
+echo "campaign2 done $(date -u +%H:%M:%SZ)" | tee -a "$out/STATUS2"

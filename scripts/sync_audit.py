@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Timing-honesty audit for the tunneled accelerator (round 4).
+
+The campaign exposed that ``jax.block_until_ready`` on the output of a
+SINGLE long-running dispatch (the loop executor's fori_loop program)
+resolves early on the axon tunnel — 4096 slices "completed" in 70 ms,
+6x over the device's headline peak (CAMPAIGN_EVIDENCE_r04.md). This
+script settles, per executor, whether blocked `host=False` wall-clocks
+are honest, using the one operation that provably awaits completion: a
+device->host fetch of the result buffer.
+
+Protocol (every measurement in a FRESH process — the tunnel's first-D2H
+cliff is per-process state, TPU_EVIDENCE_r03.md):
+
+  cliff    tiny matmul, block, then time a scalar fetch
+           -> fetch_s ~= the cliff constant (~42 s), no backlog
+  chunked  K full north-star runs (host=False, blocked; times recorded),
+           then time ONE scalar fetch of the last accumulator
+  loop     one N-slice loop-executor run (host=False, blocked),
+           then time the scalar fetch
+
+The TPU executes one program at a time, so the last result's fetch
+blocks on ALL outstanding device work. backlog := fetch_s - cliff.fetch_s.
+If blocked timing is honest, backlog ~= 0; if readiness resolved early,
+the hidden compute surfaces here (K runs amplify the chunked signal).
+
+Usage: python scripts/sync_audit.py            # orchestrate all modes
+       python scripts/sync_audit.py MODE ...   # internal worker
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _load_northstar():
+    """Cache-hit-only plan load (same key construction as bench.py /
+    scripts/oracle_status.py); the audit must spend a hardware window on
+    device work, never on replanning."""
+    import numpy as np
+
+    from tnc_tpu.benchmark.cache import ArtifactCache
+    from tnc_tpu.benchmark.northstar import northstar_plan_key
+    from tnc_tpu.builders.sycamore_circuit import sycamore_circuit
+    from tnc_tpu.contractionpath.contraction_path import ContractionPath
+    from tnc_tpu.ops.program import flat_leaf_tensors
+    from tnc_tpu.ops.sliced import build_sliced_program
+    from tnc_tpu.tensornetwork.simplify import simplify_network
+
+    qubits, depth, seed = 53, 14, 42
+    rng = np.random.default_rng(seed)
+    raw, _ = sycamore_circuit(qubits, depth, rng).into_amplitude_network(
+        "0" * qubits
+    )
+    tn = simplify_network(raw)
+    cache = ArtifactCache(os.path.join(REPO, ".cache", "plans"))
+    key = northstar_plan_key(qubits, depth, seed, 128, 29.0)
+    cached = cache.load_obj(key)
+    if cached is None:
+        raise SystemExit(f"plan cache miss ({key}); run the prewarm first")
+    _, _, replace_pairs, slicing = cached
+    replace = ContractionPath.simple(replace_pairs)
+    sp = build_sliced_program(tn, replace, slicing)
+    arrays = [leaf.data.into_data() for leaf in flat_leaf_tensors(tn)]
+    return sp, arrays
+
+
+def _fetch_scalar(result) -> float:
+    """One tiny D2H of the result buffer — the completion ground truth."""
+    import numpy as np
+
+    leaf = result[0] if isinstance(result, (tuple, list)) else result
+    while isinstance(leaf, (tuple, list)):
+        leaf = leaf[0]
+    return float(np.asarray(leaf).reshape(-1)[0].real)
+
+
+def worker(mode: str, args: list[str]) -> None:
+    import jax
+
+    if os.environ.get("SYNC_AUDIT_CPU") == "1":
+        # CPU smoke-test pin: the env-var pin (JAX_PLATFORMS=cpu) is NOT
+        # enough on this host — sitecustomize initializes the axon
+        # plugin at startup and a wedged tunnel hangs jax.devices();
+        # only the config pin isolates (see .claude/skills/verify)
+        jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    out: dict = {"mode": mode, "device": f"{dev.platform}:{dev.device_kind}"}
+
+    if mode == "cliff":
+        import jax.numpy as jnp
+
+        x = jnp.ones((256, 256), jnp.bfloat16)
+        y = x @ x
+        jax.block_until_ready(y)
+        t0 = time.monotonic()
+        out["probe_value"] = _fetch_scalar(y)
+        out["fetch_s"] = round(time.monotonic() - t0, 3)
+    else:
+        from tnc_tpu.ops.backends import JaxBackend
+
+        sp, arrays = _load_northstar()
+        n = (int(args[0]) or None) if args else None  # 0 -> all slices
+        reps = int(args[1]) if len(args) > 1 else 1
+        backend = JaxBackend(
+            dtype="complex64",
+            sliced_strategy=mode,
+            slice_batch=int(os.environ.get("BENCH_BATCH", "8")),
+            chunk_steps=int(os.environ.get("BENCH_CHUNK_STEPS", "48")),
+            precision="float32",
+            loop_unroll=1,
+        )
+        runs = []
+        result = None
+        t_all = time.monotonic()
+        for _ in range(reps):
+            t0 = time.monotonic()
+            result = backend.execute_sliced(
+                sp, arrays, max_slices=n, host=False
+            )
+            jax.block_until_ready(result)
+            runs.append(round(time.monotonic() - t0, 4))
+        out["max_slices"] = n or sp.slicing.num_slices
+        out["blocked_runs_s"] = runs
+        out["blocked_total_s"] = round(time.monotonic() - t_all, 3)
+        t0 = time.monotonic()
+        out["probe_value"] = _fetch_scalar(result)
+        out["fetch_s"] = round(time.monotonic() - t0, 3)
+    print(json.dumps(out), flush=True)
+
+
+def orchestrate() -> None:
+    stages = [
+        # (label, argv, timeout_s) — cheap to expensive; every stage is
+        # its own process, so a wedge kills one reading, not the audit
+        ("cliff", ["cliff"], 600),
+        # 256-slice loop run: claimed 54 ms blocked; r3's honest
+        # fori_loop rate (217 ms/slice) predicts ~55 s of backlog
+        # surfacing in the fetch. If backlog ~= 0 the loop executor
+        # really did get fast (staged prep reshaped its body since r3)
+        # and is promotion material, not an artifact.
+        ("loop_256", ["loop", "256"], 3600),
+        # 10 x 1024-slice chunked runs (~5 s claimed): backlog signal at
+        # moderate dispatch volume, below the full-scale wedge regime
+        ("chunked_1024_x10", ["chunked", "1024", "10"], 3600),
+        # 5 x full 4096-slice runs (~10 s claimed): the official
+        # number's own regime; known wedge risk after full-scale runs —
+        # a timeout here is recorded as a result, not a crash
+        ("chunked_full_x5", ["chunked", "0", "5"], 3600),
+        ("cliff_recheck", ["cliff"], 600),
+    ]
+    results = {}
+    for label, argv, timeout_s in stages:
+        print(f"[audit] {label} ...", file=sys.stderr, flush=True)
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), *argv],
+                capture_output=True,
+                text=True,
+                timeout=timeout_s,
+            )
+            line = [
+                l for l in r.stdout.splitlines() if l.strip().startswith("{")
+            ]
+            results[label] = (
+                json.loads(line[-1])
+                if line
+                else {"error": f"rc={r.returncode}", "stderr": r.stderr[-800:]}
+            )
+        except subprocess.TimeoutExpired:
+            # the fetch itself hanging IS a result: an unbounded backlog
+            results[label] = {"error": f"timeout after {timeout_s}s"}
+        print(f"[audit] {label}: {results[label]}", file=sys.stderr, flush=True)
+
+    cliff = results.get("cliff", {}).get("fetch_s")
+    for label in ("loop_256", "chunked_1024_x10", "chunked_full_x5"):
+        rec = results.get(label, {})
+        if cliff is not None and "fetch_s" in rec:
+            rec["backlog_s"] = round(rec["fetch_s"] - cliff, 3)
+            rec["timing_honest"] = bool(
+                rec["backlog_s"] < max(5.0, 0.2 * cliff)
+            )
+    print(json.dumps(results, indent=2))
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1:
+        worker(sys.argv[1], sys.argv[2:])
+    else:
+        orchestrate()

@@ -1,0 +1,82 @@
+"""Unit tier for ``bench.py``'s pure helpers: the hardware-promoted
+config marker, the hardware-device rule shared with
+``scripts/consolidate_bench.py``, and the cpu-fallback provenance
+attach (the round-3 'lost hardware evidence' failure mode)."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def test_tuned_default_missing_marker(tmp_path):
+    assert (
+        bench._tuned_default(
+            "exec", "chunked", ("chunked", "loop"),
+            marker_path=str(tmp_path / "nope.json"),
+        )
+        == "chunked"
+    )
+
+
+def test_tuned_default_reads_marker_and_validates(tmp_path):
+    marker = tmp_path / "best_config.json"
+    marker.write_text(json.dumps({"exec": "loop", "complex_mult": "quux"}))
+    assert (
+        bench._tuned_default(
+            "exec", "chunked", ("chunked", "loop"), marker_path=str(marker)
+        )
+        == "loop"
+    )
+    # unknown values never escape the allowed set
+    assert (
+        bench._tuned_default(
+            "complex_mult", "naive", ("naive", "gauss", "fused"),
+            marker_path=str(marker),
+        )
+        == "naive"
+    )
+    marker.write_text("not json{")
+    assert (
+        bench._tuned_default(
+            "exec", "chunked", ("chunked", "loop"), marker_path=str(marker)
+        )
+        == "chunked"
+    )
+
+
+def test_is_hw_device_rule():
+    assert bench._is_hw_device("tpu:TPU v5 lite")
+    assert bench._is_hw_device("gpu:H100")
+    assert not bench._is_hw_device("cpu:cpu")
+    assert not bench._is_hw_device("cpu-fallback")
+    assert not bench._is_hw_device("virtual8:cpu")
+    assert not bench._is_hw_device("")
+
+
+def test_attach_last_hw_record(tmp_path):
+    hw = {"device": "tpu:TPU v5 lite", "value": 1.9, "vs_baseline": 129489.0}
+    (tmp_path / "BENCH_ALL_r03.json").write_text(
+        json.dumps({"northstar": {"device": "tpu:old", "value": 9.0}})
+    )
+    (tmp_path / "BENCH_ALL_r04.json").write_text(
+        json.dumps({"northstar": hw, "cpu_cfg": {"device": "cpu:cpu"}})
+    )
+    rec: dict = {}
+    bench._attach_last_hw_record(rec, "northstar", root=str(tmp_path))
+    # newest round artifact wins
+    assert rec["last_hw_record"] == hw
+    assert rec["last_hw_record_source"] == "BENCH_ALL_r04.json"
+
+    # cpu records are never attached as hardware provenance
+    rec2: dict = {}
+    bench._attach_last_hw_record(rec2, "cpu_cfg", root=str(tmp_path))
+    assert "last_hw_record" not in rec2
+
+    # missing config / corrupt artifact: best-effort, no raise
+    bench._attach_last_hw_record({}, "absent", root=str(tmp_path))
+    (tmp_path / "BENCH_ALL_r05.json").write_text("[1, 2]")
+    bench._attach_last_hw_record({}, "northstar", root=str(tmp_path))
