@@ -319,15 +319,21 @@ def bench_sycamore_amplitude():
         os.environ.get("BENCH_COMPLEX_MULT")
         or _tuned_default("complex_mult", "naive", ("naive", "gauss", "fused")),
     )
+    precision = os.environ.get("BENCH_PRECISION") or _tuned_default(
+        "precision", "float32", ("float32", "high", "default")
+    )
     backend = JaxBackend(
         dtype="complex64",
         sliced_strategy=strategy,
         slice_batch=_env_int("BENCH_BATCH", 8),
         chunk_steps=_env_int("BENCH_CHUNK_STEPS", 48),
-        precision=os.environ.get("BENCH_PRECISION", "float32"),
+        precision=precision,
         loop_unroll=_env_int("BENCH_LOOP_UNROLL", 1),
     )
-    log(f"[bench] executor: {strategy} (complex_mult={complex_mult})")
+    log(
+        f"[bench] executor: {strategy} "
+        f"(complex_mult={complex_mult}, precision={precision})"
+    )
 
     subset_npz = os.environ.get("BENCH_SUBSET_NPZ")
     if subset_npz:
@@ -356,6 +362,7 @@ def bench_sycamore_amplitude():
         "sliced_total_flops": float(f"{total_flops:.4e}"),
         "num_slices": slicing.num_slices,
         "complex_mult": complex_mult,
+        "precision": precision,
     }
     num = slicing.num_slices
 

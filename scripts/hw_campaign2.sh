@@ -6,16 +6,21 @@
 # with known tunnel-wedge risk (the wedge probability grows with
 # cumulative window use — campaign 1 wedged only at its very end):
 #
-#   1. full-measured GAUSS north-star — ~10% faster than naive at equal
-#      parity margin in the A/Bs; replaces the official record only on
-#      parity pass AND better wall-clock (and then becomes the bench
-#      default via .cache/best_config.json)
-#   2. hardware test tier — re-run after the r4 test fixes
-#   3. sync audit — is blocked host=False timing honest per executor?
-#      (the loop executor's non-physical A/B numbers; certifies the
-#      official chunked record's integrity)
-#   4. if the audit certifies the loop executor, a full-measured loop
-#      capture too (potential further win)
+#   1.  full-measured GAUSS north-star — ~10% faster than naive at equal
+#       parity margin in the A/Bs; replaces the official record only on
+#       parity pass AND better wall-clock (and then becomes the bench
+#       default via .cache/best_config.json)
+#   1b. precision ladder probe — bf16x3 (HIGH) dots on a 256-slice
+#       subset WITH the 16-slice parity oracle; cheap (~3 min)
+#   1c. (only if 1b passes parity) full-measured HIGH capture — the
+#       biggest single lever if it holds: dot time roughly halves vs
+#       the 6-pass HIGHEST default
+#   2.  hardware test tier — re-run after the r4 test fixes
+#   3.  sync audit — is blocked host=False timing honest per executor?
+#       (the loop executor's non-physical A/B numbers; certifies the
+#       official chunked record's integrity)
+#   4.  if the audit certifies the loop executor, a full-measured loop
+#       capture too (potential further win)
 #
 # Usage: bash scripts/hw_campaign2.sh
 set -uo pipefail
@@ -97,6 +102,48 @@ BENCH_COMPLEX_MULT=gauss BENCH_NO_RETRY=1 timeout 3600 python bench.py \
 echo "rc=$? $(cat "$out/bench_gauss_full.json" 2>/dev/null | tail -1)"
 promote "$out/bench_gauss_full.json" '{"complex_mult": "gauss"}' \
   && echo "gauss promoted"
+
+echo "== 1b. precision ladder: bf16x3 dots (256-slice subset, WITH parity) =="
+# HIGH (3-pass bf16) halves dot time vs the HIGHEST (6-pass) default;
+# the open question is parity. Measured WITH the 16-slice oracle so a
+# pass here licenses the full-measured capture below.
+BENCH_PRECISION=high BENCH_MAX_SLICES=256 BENCH_REPS=1 BENCH_TRACE=0 \
+  BENCH_NO_RETRY=1 timeout 1800 python bench.py \
+  > "$out/bench_prec_high.json" 2> "$out/bench_prec_high.log"
+echo "rc=$? $(cat "$out/bench_prec_high.json" 2>/dev/null | tail -1)"
+# gate verdict: ok / parity_miss:<v> / unmeasured / invalid — the
+# distinction matters for the evidence trail (a wedge or timeout must
+# not be recorded as an accuracy failure of bf16x3)
+prec_verdict=$(python - "$out/bench_prec_high.json" << 'PY'
+import json, os, sys
+target = float(os.environ.get("BENCH_PARITY_TARGET", "1e-5"))
+try:
+    r = json.loads(
+        [l for l in open(sys.argv[1]) if l.strip().startswith("{")][-1]
+    )
+except Exception:
+    print("invalid")
+    raise SystemExit
+if "error" in r or "timing_suspect" in r:
+    print("invalid")
+elif "parity" not in r:
+    print("unmeasured")
+elif r["parity"] > target:
+    print(f"parity_miss:{r['parity']}")
+else:
+    print("ok")
+PY
+)
+if [ "$prec_verdict" = "ok" ]; then
+  echo "== 1c. full-measured high-precision capture (promotion candidate) =="
+  BENCH_PRECISION=high BENCH_NO_RETRY=1 timeout 3600 python bench.py \
+    > "$out/bench_prec_high_full.json" 2> "$out/bench_prec_high_full.log"
+  echo "rc=$? $(cat "$out/bench_prec_high_full.json" 2>/dev/null | tail -1)"
+  promote "$out/bench_prec_high_full.json" '{"precision": "high"}' \
+    && echo "high precision promoted"
+else
+  echo "bf16x3 NOT promoted (verdict: $prec_verdict); staying at float32"
+fi
 
 echo "== 2. hardware test tier (post-fix re-run) =="
 timeout 2400 python -m pytest tests/test_tpu_hardware.py -q -p no:cacheprovider \

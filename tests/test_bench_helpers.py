@@ -80,3 +80,17 @@ def test_attach_last_hw_record(tmp_path):
     bench._attach_last_hw_record({}, "absent", root=str(tmp_path))
     (tmp_path / "BENCH_ALL_r05.json").write_text("[1, 2]")
     bench._attach_last_hw_record({}, "northstar", root=str(tmp_path))
+
+
+def test_resolve_precision_ladder():
+    """The device dot-precision ladder: default (1-pass bf16) < high
+    (bf16x3) < float32/anything-else (bf16x6 HIGHEST)."""
+    from jax import lax
+
+    from tnc_tpu.ops.split_complex import _resolve_precision
+
+    assert _resolve_precision(None) is None
+    assert _resolve_precision("default") is None
+    assert _resolve_precision("high") is lax.Precision.HIGH
+    assert _resolve_precision("float32") is lax.Precision.HIGHEST
+    assert _resolve_precision("anything") is lax.Precision.HIGHEST
