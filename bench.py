@@ -165,6 +165,18 @@ def _current_exec() -> str:
     )
 
 
+def _current_target_log2() -> float:
+    """Resolved slicing target: BENCH_TARGET_LOG2_PEAK env, else the
+    hardware-promoted marker, else 2^29. One definition shared by the
+    run, the retry ladder's target-downgrade step, and
+    scripts/oracle_status.py's parity clamp (which must report the
+    oracle cache of the SAME plan the run will execute)."""
+    return float(
+        os.environ.get("BENCH_TARGET_LOG2_PEAK")
+        or _tuned_default("target_log2", "29", ("28", "29", "30"))
+    )
+
+
 def _time_backend(run, reps):
     """Median wall-clock of ``run()`` over ``reps`` after one warmup.
 
@@ -208,8 +220,11 @@ def bench_sycamore_amplitude():
     seed = _env_int("BENCH_SEED", 42)
     # 2^29 beats 2^28 on every axis for the north-star (CPU-verified
     # sweep, planner_refine r3): 12% fewer total flops, half the
-    # dispatch count, modeled peak 5.5 GiB/slice -> batch clamp 2
-    target_log2 = float(os.environ.get("BENCH_TARGET_LOG2_PEAK", "29"))
+    # dispatch count, modeled peak 5.5 GiB/slice -> batch clamp 2.
+    # 2^30 cuts sliced-total flops another 9.7% (7.55e13, 2048 slices)
+    # at batch clamp 1 — whether that wins on-device is campaign2's
+    # stage 1d/1e A/B; a promotion pins it via the marker.
+    target_log2 = _current_target_log2()
     ntrials = _env_int("BENCH_NTRIALS", 128)
     # one oracle slice by default: with the polished planner each slice
     # is ~4x bigger, and one 2^29-peak slice already takes minutes on a
@@ -1197,7 +1212,7 @@ def main() -> None:
     # climb the on-accelerator retry ladder in fresh subprocesses (this
     # process may hold a poisoned backend): smaller slice batch → deeper
     # slicing → the other executor. Only then fall back to CPU.
-    target = float(os.environ.get("BENCH_TARGET_LOG2_PEAK", "29"))
+    target = _current_target_log2()
     cur_exec = _current_exec()
     ladder: list[tuple[str, dict]] = []
     if config == "sycamore_amplitude":

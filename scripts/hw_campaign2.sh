@@ -12,9 +12,18 @@
 #       default via .cache/best_config.json)
 #   1b. precision ladder probe — bf16x3 (HIGH) dots on a 256-slice
 #       subset WITH the 16-slice parity oracle; cheap (~3 min)
-#   1c. (only if 1b passes parity) full-measured HIGH capture — the
-#       biggest single lever if it holds: dot time roughly halves vs
-#       the 6-pass HIGHEST default
+#   1c. (only if 1b passes parity) full-measured HIGH capture — a
+#       potential large lever (the pass count of HIGHEST on this
+#       libtpu is unknown; the A/B resolves it empirically)
+#   1d. slicing-target ladder — the 2^30 plan (2048 slices, -9.7%
+#       sliced-total flops, batch clamp 1) on a 256-slice subset with
+#       its own prewarmed oracle; skipped if the prewarm hasn't cached
+#       at least 2 oracle slices
+#   1e. (only if 1d passes parity) full-measured 2^30 capture
+#       Every promotion merges into .cache/best_config.json, so each
+#       later stage measures the BEST-SO-FAR combination — promoted
+#       configs compose, and the final record is always a measured
+#       combination, never an assumed one.
 #   2.  hardware test tier — re-run after the r4 test fixes
 #   3.  sync audit — is blocked host=False timing honest per executor?
 #       (the loop executor's non-physical A/B numbers; certifies the
@@ -44,6 +53,43 @@ if ! probe; then
   exit 1
 fi
 echo "tunnel alive, campaign2 starting $(date -u +%H:%M:%SZ)" | tee "$out/STATUS2"
+
+# clamp parity sampling to the oracle cache of the plan bench will
+# actually run (oracle_status resolves the promoted marker, so this
+# stays correct even after a prior campaign promoted target_log2=30):
+# a live window must never compute minutes-per-slice host oracle work
+ostat=$(python scripts/oracle_status.py 2>/dev/null || echo '{}')
+echo "oracle status (marker-resolved target): $ostat" | tee -a "$out/STATUS2"
+cached=$(printf '%s' "$ostat" | sed -n 's/.*"oracle_slices": \([0-9]*\).*/\1/p')
+cached=${cached:-0}
+parity=$(( cached >= 2 ? (cached > 16 ? 16 : cached) : 2 ))
+export BENCH_PARITY_SLICES=$parity
+echo "BENCH_PARITY_SLICES=$parity"
+
+record_verdict() {
+  # ok / parity_miss:<v> / unmeasured / invalid — the distinction
+  # matters for the evidence trail (a wedge or timeout must not be
+  # recorded as an accuracy failure of the config under test)
+  python - "$1" << 'PY'
+import json, os, sys
+target = float(os.environ.get("BENCH_PARITY_TARGET", "1e-5"))
+try:
+    r = json.loads(
+        [l for l in open(sys.argv[1]) if l.strip().startswith("{")][-1]
+    )
+except Exception:
+    print("invalid")
+    raise SystemExit
+if "error" in r or "timing_suspect" in r:
+    print("invalid")
+elif "parity" not in r:
+    print("unmeasured")
+elif r["parity"] > target:
+    print(f"parity_miss:{r['parity']}")
+else:
+    print("ok")
+PY
+}
 
 promote() {
   # promote $1 over the campaign main record iff it is an on-device,
@@ -111,29 +157,7 @@ BENCH_PRECISION=high BENCH_MAX_SLICES=256 BENCH_REPS=1 BENCH_TRACE=0 \
   BENCH_NO_RETRY=1 timeout 1800 python bench.py \
   > "$out/bench_prec_high.json" 2> "$out/bench_prec_high.log"
 echo "rc=$? $(cat "$out/bench_prec_high.json" 2>/dev/null | tail -1)"
-# gate verdict: ok / parity_miss:<v> / unmeasured / invalid — the
-# distinction matters for the evidence trail (a wedge or timeout must
-# not be recorded as an accuracy failure of bf16x3)
-prec_verdict=$(python - "$out/bench_prec_high.json" << 'PY'
-import json, os, sys
-target = float(os.environ.get("BENCH_PARITY_TARGET", "1e-5"))
-try:
-    r = json.loads(
-        [l for l in open(sys.argv[1]) if l.strip().startswith("{")][-1]
-    )
-except Exception:
-    print("invalid")
-    raise SystemExit
-if "error" in r or "timing_suspect" in r:
-    print("invalid")
-elif "parity" not in r:
-    print("unmeasured")
-elif r["parity"] > target:
-    print(f"parity_miss:{r['parity']}")
-else:
-    print("ok")
-PY
-)
+prec_verdict=$(record_verdict "$out/bench_prec_high.json")
 if [ "$prec_verdict" = "ok" ]; then
   echo "== 1c. full-measured high-precision capture (promotion candidate) =="
   BENCH_PRECISION=high BENCH_NO_RETRY=1 timeout 3600 python bench.py \
@@ -143,6 +167,35 @@ if [ "$prec_verdict" = "ok" ]; then
     && echo "high precision promoted"
 else
   echo "bf16x3 NOT promoted (verdict: $prec_verdict); staying at float32"
+fi
+
+echo "== 1d. slicing-target ladder: 2^30 plan (256-slice subset, WITH parity) =="
+# same path flops, 2048 slices, sliced-total 7.55e13 (-9.7% work) at
+# batch clamp 1; gated on its own prewarmed oracle (separate cache key)
+p30=$(BENCH_TARGET_LOG2_PEAK=30 python scripts/oracle_status.py 2>/dev/null \
+  | sed -n 's/.*"oracle_slices": \([0-9]*\).*/\1/p')
+p30=${p30:-0}
+if [ "$p30" -ge 2 ]; then
+  BENCH_TARGET_LOG2_PEAK=30 BENCH_PARITY_SLICES=$(( p30 > 16 ? 16 : p30 )) \
+    BENCH_MAX_SLICES=256 BENCH_REPS=1 BENCH_TRACE=0 BENCH_NO_RETRY=1 \
+    timeout 1800 python bench.py \
+    > "$out/bench_t30.json" 2> "$out/bench_t30.log"
+  echo "rc=$? $(cat "$out/bench_t30.json" 2>/dev/null | tail -1)"
+  t30_verdict=$(record_verdict "$out/bench_t30.json")
+  if [ "$t30_verdict" = "ok" ]; then
+    echo "== 1e. full-measured 2^30 capture (promotion candidate) =="
+    BENCH_TARGET_LOG2_PEAK=30 \
+      BENCH_PARITY_SLICES=$(( p30 > 16 ? 16 : p30 )) BENCH_NO_RETRY=1 \
+      timeout 3600 python bench.py \
+      > "$out/bench_t30_full.json" 2> "$out/bench_t30_full.log"
+    echo "rc=$? $(cat "$out/bench_t30_full.json" 2>/dev/null | tail -1)"
+    promote "$out/bench_t30_full.json" '{"target_log2": "30"}' \
+      && echo "2^30 target promoted"
+  else
+    echo "2^30 NOT promoted (verdict: $t30_verdict); staying at 2^29"
+  fi
+else
+  echo "2^30 oracle not prewarmed ($p30 slices); skipping the target ladder"
 fi
 
 echo "== 2. hardware test tier (post-fix re-run) =="

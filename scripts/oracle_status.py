@@ -21,11 +21,15 @@ from tnc_tpu.benchmark.northstar import (  # noqa: E402
 
 
 def main() -> None:
+    from bench import _current_target_log2
+
     qubits = int(os.environ.get("BENCH_QUBITS", "53"))
     depth = int(os.environ.get("BENCH_DEPTH", "14"))
     seed = int(os.environ.get("BENCH_SEED", "42"))
     ntrials = int(os.environ.get("BENCH_NTRIALS", "128"))
-    target_log2 = float(os.environ.get("BENCH_TARGET_LOG2_PEAK", "29"))
+    # marker-aware (env > promoted .cache/best_config.json > 29): the
+    # clamp must describe the oracle cache of the plan bench will RUN
+    target_log2 = _current_target_log2()
     cache = ArtifactCache(
         os.path.join(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
