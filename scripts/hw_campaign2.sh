@@ -227,6 +227,36 @@ else
   echo "loop executor not certified by audit; skipping"
 fi
 
+echo "== 4b. stamp the audit verdict onto the main record =="
+python - << 'PY'
+import json
+
+try:
+    audit = json.load(open(".cache/hw_campaign/sync_audit.json"))
+    path = ".cache/hw_campaign/bench_main.json"
+    rec = json.loads(
+        [l for l in open(path) if l.strip().startswith("{")][-1]
+    )
+except Exception as e:
+    raise SystemExit(f"stamp: nothing to do ({e})")
+summary = {}
+for label in ("loop_256", "chunked_1024_x10", "chunked_full_x5"):
+    r = audit.get(label, {})
+    keep = {
+        k: r[k]
+        for k in ("backlog_s", "timing_honest", "fetch_s", "error")
+        if k in r
+    }
+    if keep:
+        summary[label] = keep
+if summary:
+    rec["sync_audit"] = summary
+    open(path, "w").write(json.dumps(rec) + "\n")
+    print(f"stamped sync_audit onto bench_main.json: {summary}")
+else:
+    print("no audit readings to stamp")
+PY
+
 echo "== 5. consolidate =="
 art=$(ls BENCH_ALL_r*.json 2>/dev/null | sort | tail -1)
 art=${art:-BENCH_ALL_r04.json}
