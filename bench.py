@@ -156,6 +156,17 @@ def _tuned_default(
         return fallback
 
 
+def _plan_cache():
+    """The on-disk plan/oracle artifact cache (``.cache/plans/``)."""
+    from tnc_tpu.benchmark.cache import ArtifactCache
+
+    return ArtifactCache(
+        os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".cache", "plans"
+        )
+    )
+
+
 def _current_exec() -> str:
     """Resolved sliced-executor strategy: BENCH_EXEC env, else the
     hardware-promoted marker, else chunked. One definition so the retry
@@ -248,16 +259,11 @@ def bench_sycamore_amplitude():
     # is cached on disk like the reference's Sweep/Run artifact split
     # (``benchmark/src/main.rs:223-242``): a hardware attempt should spend
     # <1 s loading the plan, not ~107 s recomputing it (VERDICT r3 #3).
-    from tnc_tpu.benchmark.cache import ArtifactCache
     from tnc_tpu.benchmark.northstar import northstar_plan_key
 
     target = 2.0**target_log2
     plan_t0 = time.monotonic()
-    cache = ArtifactCache(
-        os.path.join(
-            os.path.dirname(os.path.abspath(__file__)), ".cache", "plans"
-        )
-    )
+    cache = _plan_cache()
     key = northstar_plan_key(qubits, depth, seed, ntrials, target_log2)
     inputs = list(tn.tensors)
     cached = None if os.environ.get("BENCH_NO_PLAN_CACHE") == "1" else cache.load_obj(key)
@@ -940,7 +946,6 @@ def bench_sycamore_m20_partitioned():
 
     from tnc_tpu.builders.sycamore_circuit import sycamore_circuit
     from tnc_tpu.contractionpath.repartitioning import compute_solution
-    from tnc_tpu.ops.budget import device_hbm_bytes
     from tnc_tpu.parallel.partitioned import partitioned_sliced_executor
     from tnc_tpu.tensornetwork.partitioning import find_partitioning
     from tnc_tpu.tensornetwork.simplify import simplify_network
@@ -972,24 +977,116 @@ def bench_sycamore_m20_partitioned():
     log(f"[bench] network: {len(raw)} -> {len(tn)} cores (m={depth})")
 
     t0 = time.monotonic()
-    partitioning = find_partitioning(tn, k)
-    # SA rebalancing of the initial cut: on this instance it cuts the
-    # critical path ~500x vs the raw min-cut partitioning (measured:
-    # parallel 9.3e12 -> 1.9e10, plan speedup 1.0 -> 1.8; composed
-    # wall-clock 63M s -> 617 s, TPU_EVIDENCE_r04.md)
+    # SA rebalancing of the initial min-cut partitioning: on this
+    # instance it cuts the critical path ~500x (measured: parallel
+    # 9.3e12 -> 1.9e10; TPU_EVIDENCE_r04.md).
+    # Best-known ratchet: the SA trajectory is wall-budgeted and runs
+    # pooled chains, so equal-seed outcomes vary run to run (measured
+    # r4: critical-path 1.5e10 vs 3.5e10 across equal 300 s budgets).
+    # The best assignment is cached by instance key; each run WARM-
+    # STARTS SA from it (the optimizer seeds best-so-far with the
+    # initial solution) and the store is improve-only — captures never
+    # regress, the same ratchet discipline the north-star plan cache
+    # provides. "Better" is LEXICOGRAPHIC (the composed pipeline's
+    # actual global slice count at the device budget, then critical
+    # path): SA's critical-path objective alone happily trades memory
+    # for parallel cost, and the composed run then pays for it in
+    # global slices — measured r4: a 1.85e10 critical path needing 128
+    # slices ran ~8x slower end-to-end than a 1.85e10 one needing 32;
+    # on the mesh the per-slice fixed cost dominates the flop term. The
+    # slice count comes from the SAME planner the executor runs
+    # (plan_global_slicing), so the rank is execution-faithful.
+    from tnc_tpu.benchmark.cache import cache_key
+    from tnc_tpu.parallel.partitioned import (
+        flatten_partitioned_path,
+        global_slicing_target,
+        plan_global_slicing,
+    )
+
+    # The budget is the MODELED device's (BASELINE #5 is an 8-way v5e
+    # mesh; the virtual CPU mesh stands in for it), pinned explicitly so
+    # plan ranks are comparable across hosts and processes — CPU
+    # backends report host-dependent memory limits.
+    hbm = _env_int("BENCH_HBM_BYTES", 0) or 16 * 2**30
+
+    def _rank(assignment):
+        """(global_slices, critical_path) for lexicographic compare."""
+        p_tn, p_path, par, ser = compute_solution(
+            tn, assignment, rng=pyrandom.Random(seed)
+        )
+        leaves, pairs = flatten_partitioned_path(p_tn, p_path)
+        slicing = plan_global_slicing(
+            leaves, pairs, global_slicing_target(hbm)
+        )
+        return (slicing.num_slices, par), (p_tn, p_path, par, ser)
+
+    use_plan_cache = os.environ.get("BENCH_NO_PLAN_CACHE") != "1"
+    pcache = _plan_cache()
+    # budget is part of the key: ranks computed under different budgets
+    # are not comparable (slice counts depend on the slicing target)
+    pkey = cache_key(
+        "config5-partition-v4",
+        f"sycamore-{qubits}-m{depth}-hbm{hbm}",
+        seed,
+        k,
+        "sa",
+    )
+
+    def _valid(obj) -> bool:
+        # stale-artifact guard: an assignment is positional over the
+        # simplified network's tensors; any upstream change that shifts
+        # the tensor count invalidates it (fail safe: replan)
+        return (
+            isinstance(obj, dict)
+            and len(obj.get("assignment", ())) == len(tn.tensors)
+            and len(obj.get("rank", ())) == 2
+        )
+
+    cached_best = pcache.load_obj(pkey) if use_plan_cache else None
+    if cached_best is not None and not _valid(cached_best):
+        log("[bench] cached partitioning is stale (size mismatch); replanning")
+        cached_best = None
+    if cached_best is not None:
+        partitioning = cached_best["assignment"]
+    else:
+        partitioning = find_partitioning(tn, k)
     partitioning, sa_report = _sa_rebalance(
         tn, partitioning, pyrandom.Random(seed), sa_seconds
     )
-    ptn, ppath, parallel_cost, serial_cost = compute_solution(
-        tn, partitioning, rng=pyrandom.Random(seed)
-    )
+    if cached_best is not None:
+        sa_report["warm_started_from_cache"] = True
+    rank, (ptn, ppath, parallel_cost, serial_cost) = _rank(partitioning)
+    if cached_best is not None and tuple(cached_best["rank"]) < rank:
+        log(
+            f"[bench] cached partitioning wins: rank "
+            f"{tuple(cached_best['rank'])} < {rank}"
+        )
+        partitioning = cached_best["assignment"]
+        sa_report["from_plan_cache"] = True
+        rank, (ptn, ppath, parallel_cost, serial_cost) = _rank(partitioning)
+    elif use_plan_cache:
+        # improve-only store under an exclusive lock: concurrent runs
+        # serialize the load-compare-store, so the ratchet is monotone
+        import contextlib
+        import fcntl
+
+        lock_path = str(pcache.directory / f"{pkey}.lock")
+        with open(lock_path, "w") as lf:
+            with contextlib.suppress(OSError):
+                fcntl.flock(lf, fcntl.LOCK_EX)
+            latest = pcache.load_obj(pkey)
+            if not _valid(latest) or rank < tuple(latest["rank"]):
+                pcache.store_obj(
+                    pkey,
+                    {"assignment": list(partitioning), "rank": list(rank)},
+                )
+    sa_report["planned_global_slices"] = rank[0]
     planning_s = time.monotonic() - t0
     log(
         f"[bench] partitioned: k={k}, critical-path {parallel_cost:.3e}, "
         f"serial {serial_cost:.3e} (planned in {planning_s:.1f}s)"
     )
 
-    hbm = device_hbm_bytes(devices[0])
     t0 = time.monotonic()
     run, slicing, _meta = partitioned_sliced_executor(
         ptn, ppath, devices=devices[:k], split_complex=split_complex,
@@ -1020,12 +1117,13 @@ def bench_sycamore_m20_partitioned():
     log(f"[bench] partial amplitude: {amp}")
 
     extra = {
-        "extrapolated_from_slices": n_probe,
         "global_slices": slicing.num_slices,
         "sliced_legs": len(slicing.legs),
         "plan_parallel_speedup": round(serial_cost / max(parallel_cost, 1), 2),
         "planning_s": round(planning_s, 1),
     }
+    if n_probe < slicing.num_slices:
+        extra["extrapolated_from_slices"] = n_probe
     extra.update(sa_report)
     return (
         f"sycamore{qubits}_m{depth}_partitioned{k}_wallclock",

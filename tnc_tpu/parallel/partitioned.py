@@ -527,6 +527,33 @@ def distributed_partitioned_sliced_contraction(
     )
 
 
+def global_slicing_target(hbm_bytes: float) -> float:
+    """Per-slice element target for the composed pipeline: padded
+    split-complex working set ~8 bytes/elem x ~8 live copies."""
+    return max(float(hbm_bytes) / 64.0, 4.0)
+
+
+def plan_global_slicing(flat_leaves, flat_pairs, target_size: float):
+    """Find the global slicing for a flattened partitioned path at
+    ``target_size`` elements, relaxing the target 4x at a time when it
+    needs more slices than the planner's cap (the per-slice footprint
+    then overshoots the budget — best effort; the caller sees the
+    slicing and can re-plan). Host-only: benchmark plan ranking calls
+    this without touching devices."""
+    from tnc_tpu.contractionpath.slicing import find_slicing
+
+    while True:
+        try:
+            return find_slicing(flat_leaves, flat_pairs, target_size)
+        except ValueError:
+            if target_size > 2.0**62:
+                raise
+            target_size *= 4.0
+            logger.warning(
+                "global slicing target relaxed to %g elements", target_size
+            )
+
+
 def partitioned_sliced_executor(
     tn: CompositeTensor,
     contract_path: ContractionPath,
@@ -546,7 +573,6 @@ def partitioned_sliced_executor(
     import jax
     import jax.numpy as jnp
 
-    from tnc_tpu.contractionpath.slicing import find_slicing
     from tnc_tpu.ops.backends import _run_steps
     from tnc_tpu.ops.budget import device_hbm_bytes
     from tnc_tpu.ops.sliced import (
@@ -569,22 +595,8 @@ def partitioned_sliced_executor(
     if target_size is None:
         if hbm_bytes is None:
             hbm_bytes = device_hbm_bytes(devices[0])
-        # padded split-complex working set ~8 bytes/elem x ~8 live copies
-        target_size = max(float(hbm_bytes) / 64.0, 4.0)
-    while True:
-        try:
-            slicing = find_slicing(flat_leaves, flat_pairs, target_size)
-            break
-        except ValueError:
-            # target needs more slices than the planner's cap: back off —
-            # the per-slice footprint then overshoots the budget (best
-            # effort; the caller sees the slicing and can re-plan)
-            if target_size > 2.0**62:
-                raise
-            target_size *= 4.0
-            logger.warning(
-                "global slicing target relaxed to %g elements", target_size
-            )
+        target_size = global_slicing_target(hbm_bytes)
+    slicing = plan_global_slicing(flat_leaves, flat_pairs, target_size)
     logger.debug(
         "global slicing: %d legs, %d slices (target %g elems)",
         len(slicing.legs),
