@@ -43,8 +43,15 @@ Env knobs:
   BENCH_SUBSET_TIMEOUT (900; parity-subset subprocess, accelerators),
   BENCH_INLINE_FETCH=1 (accelerators: fetch parity in-process, pre-r4),
   BENCH_NO_PARITY=1 (skip parity entirely; wall-clock A/B stages),
-  BENCH_PRECISION float32 (full-f32 dots) | default (bf16 3-pass, faster),
-  BENCH_STAGE_TIMEOUT (1500 + 2*BENCH_FULL_SECONDS; per retry stage)
+  BENCH_PRECISION float32 (HIGHEST dots, default) | high (bf16x3) |
+    default (1-pass bf16),
+  BENCH_STAGE_TIMEOUT (1500 + 2*BENCH_FULL_SECONDS; per retry stage),
+  BENCH_SA_SECONDS (60) / BENCH_SA_ROUNDS (partitioned configs; SA budget),
+  BENCH_PARTITIONS (8) / BENCH_HBM_BYTES (16 GiB; config-5 modeled
+    per-device budget — part of the partitioning-ratchet cache key)
+
+Executor/precision/target defaults may also come from the hardware-
+promoted marker .cache/best_config.json (see _tuned_default); env wins.
 """
 
 import json
