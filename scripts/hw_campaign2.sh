@@ -54,6 +54,17 @@ if ! probe; then
 fi
 echo "tunnel alive, campaign2 starting $(date -u +%H:%M:%SZ)" | tee "$out/STATUS2"
 
+# Between stages: a collapsed window must abort WITHOUT the done-marker
+# (the watcher then re-arms with backoff) instead of burning hours of
+# stage timeouts against a dead tunnel and disarming the watcher.
+require_tunnel() {
+  if ! probe; then
+    echo "tunnel lost before stage $1; aborting for watcher re-arm" \
+      | tee -a "$out/STATUS2"
+    exit 1
+  fi
+}
+
 # clamp parity sampling to the oracle cache of the plan bench will
 # actually run (oracle_status resolves the promoted marker, so this
 # stays correct even after a prior campaign promoted target_log2=30):
@@ -149,6 +160,7 @@ echo "rc=$? $(cat "$out/bench_gauss_full.json" 2>/dev/null | tail -1)"
 promote "$out/bench_gauss_full.json" '{"complex_mult": "gauss"}' \
   && echo "gauss promoted"
 
+require_tunnel "1b"
 echo "== 1b. precision ladder: bf16x3 dots (256-slice subset, WITH parity) =="
 # HIGH (3-pass bf16) halves dot time vs the HIGHEST (6-pass) default;
 # the open question is parity. Measured WITH the 16-slice oracle so a
@@ -169,6 +181,7 @@ else
   echo "bf16x3 NOT promoted (verdict: $prec_verdict); staying at float32"
 fi
 
+require_tunnel "1d"
 echo "== 1d. slicing-target ladder: 2^30 plan (256-slice subset, WITH parity) =="
 # same path flops, 2048 slices, sliced-total 7.55e13 (-9.7% work) at
 # batch clamp 1; gated on its own prewarmed oracle (separate cache key)
@@ -198,17 +211,20 @@ else
   echo "2^30 oracle not prewarmed ($p30 slices); skipping the target ladder"
 fi
 
+require_tunnel "2"
 echo "== 2. hardware test tier (post-fix re-run) =="
 timeout 2400 python -m pytest tests/test_tpu_hardware.py -q -p no:cacheprovider \
   > "$out/hw_tier2.log" 2>&1
 echo "rc=$? $(tail -1 "$out/hw_tier2.log")"
 
+require_tunnel "3"
 echo "== 3. sync audit (timing honesty per executor) =="
 timeout 7200 python scripts/sync_audit.py \
   > "$out/sync_audit.json" 2> "$out/sync_audit.log"
 echo "rc=$? $(tail -c 400 "$out/sync_audit.json" 2>/dev/null)"
 cp -f "$out/sync_audit.json" SYNC_AUDIT_r04.json 2>/dev/null || true
 
+require_tunnel "4"
 echo "== 4. conditional: full-measured loop capture if audit certified it =="
 loop_ok=$(python -c "
 import json
