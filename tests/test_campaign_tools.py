@@ -1,0 +1,103 @@
+"""Pin the campaign consolidation rules (scripts/consolidate_bench.py):
+fresh non-error records replace, hardware evidence is never replaced by
+cpu-fallback records, and collapsed stages never delete captured
+configs."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_consolidate():
+    spec = importlib.util.spec_from_file_location(
+        "consolidate_bench",
+        os.path.join(REPO, "scripts", "consolidate_bench.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _run(tmp_path, out_dir, artifact):
+    r = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "scripts", "consolidate_bench.py"),
+            str(out_dir),
+            "--artifact",
+            str(artifact),
+        ],
+        capture_output=True,
+        text=True,
+        cwd=tmp_path,
+    )
+    assert r.returncode == 0, r.stderr
+    return json.loads(r.stdout)
+
+
+def test_merge_prefers_fresh_and_protects_hardware(tmp_path):
+    out = tmp_path / "stages"
+    out.mkdir()
+    art = tmp_path / "BENCH_ALL_r98.json"
+    art.write_text(
+        json.dumps(
+            {
+                "sycamore_amplitude": {
+                    "device": "tpu:TPU v5 lite",
+                    "value": 1.9,
+                },
+                "ghz3": {"device": "cpu:cpu", "value": 0.1},
+                # no stage file at all: must survive the merge untouched
+                "random20": {"device": "tpu:TPU v5 lite", "value": 0.07},
+                # stage file exists but is an error record: ditto
+                "qaoa30": {"device": "cpu:cpu", "value": 0.02},
+            }
+        )
+    )
+    # fresh cpu record must NOT replace the captured hardware record
+    (out / "bench_main.json").write_text(
+        json.dumps({"device": "cpu-fallback", "value": 99.0}) + "\n"
+    )
+    # fresh cpu record MAY replace an old cpu record
+    (out / "bench_ghz3.json").write_text(
+        json.dumps({"device": "cpu:cpu", "value": 0.05}) + "\n"
+    )
+    # error records are ignored entirely
+    (out / "bench_qaoa30.json").write_text(
+        json.dumps({"device": "cpu:cpu", "error": "boom"}) + "\n"
+    )
+    # a missing stage file must not delete a previously captured config
+    merged = _run(tmp_path, out, art)
+    assert merged["sycamore_amplitude"]["value"] == 1.9  # hw protected
+    assert merged["ghz3"]["value"] == 0.05  # cpu refreshed
+    assert merged["qaoa30"]["value"] == 0.02  # error record never deletes
+    assert merged["random20"]["value"] == 0.07  # missing stage never deletes
+
+    # and a fresh hardware record DOES replace hardware
+    (out / "bench_main.json").write_text(
+        json.dumps({"device": "tpu:TPU v5 lite", "value": 1.7}) + "\n"
+    )
+    merged = _run(tmp_path, out, art)
+    assert merged["sycamore_amplitude"]["value"] == 1.7
+
+
+def test_last_json_line_wins_and_garbage_is_skipped(tmp_path):
+    mod = _load_consolidate()
+    p = tmp_path / "rec.json"
+    p.write_text("noise\n" + json.dumps({"v": 1}) + "\n" + json.dumps({"v": 2}) + "\n")
+    assert mod.last_record(p) == {"v": 2}
+    p.write_text("not json at all\n")
+    assert mod.last_record(p) is None
+    assert mod.last_record(tmp_path / "missing.json") is None
+
+
+def test_newest_artifact_resolution():
+    mod = _load_consolidate()
+    art = mod.newest_artifact()
+    # repo root resolution, independent of cwd
+    assert os.path.dirname(os.path.abspath(art)) == REPO
+    assert os.path.basename(str(art)).startswith("BENCH_ALL_r")
