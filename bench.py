@@ -35,6 +35,8 @@ Env knobs:
   BENCH_PARITY_TARGET (1e-5), BENCH_COMPLEX_MULT naive|gauss|fused,
   BENCH_NO_PLAN_CACHE=1 (force replanning),
   BENCH_REPS (3), BENCH_PEAK_FLOPS (per device),
+  BENCH_PIPELINE_CALLS (32; small configs — dispatches enqueued per
+    timed region, blocked once: steady-state per-eval time),
   BENCH_EXEC chunked|loop, BENCH_BATCH (8), BENCH_PROBE_SLICES (64),
   BENCH_LOOP_UNROLL (1; loop strategy only — unrolled-scan slice loop),
   BENCH_FULL_SECONDS (900; run all slices if projected under this),
@@ -217,6 +219,47 @@ def _time_backend(run, reps):
         times.append(time.monotonic() - t0)
     log(f"[bench] runs: {[round(t, 4) for t in times]}")
     return float(np.median(times)), out
+
+
+def _time_pipelined(bound, reps, calls=None):
+    """Steady-state per-evaluation wall-clock of a zero-transfer bound
+    executable (``JaxBackend.bind_resident``): enqueue ``calls``
+    dispatches back-to-back and block once on the last result, so
+    dispatch latency overlaps device execution instead of paying a full
+    host↔device round-trip per evaluation (the VERDICT-r4 async timing
+    discipline for dispatch-bound small networks). Median over ``reps``
+    such timed regions; returns (per_eval_s, calls, last_out)."""
+    import jax
+
+    if calls is None:
+        calls = _env_int("BENCH_PIPELINE_CALLS", 32)
+    t0 = time.monotonic()
+    out = bound()
+    jax.block_until_ready(out)
+    log(f"[bench] warmup (incl. compile): {time.monotonic() - t0:.2f}s")
+    times = []
+    for _ in range(reps):
+        t0 = time.monotonic()
+        for _ in range(calls):
+            out = bound()
+        jax.block_until_ready(out)
+        times.append((time.monotonic() - t0) / calls)
+    log(f"[bench] pipelined per-eval (x{calls}): "
+        f"{[round(t * 1e3, 4) for t in times]} ms")
+    return float(np.median(times)), calls, out
+
+
+def _time_numpy(run, reps):
+    """CPU-oracle counterpart of :func:`_time_pipelined`: same
+    steady-state contract (arrays already in memory, repeated
+    evaluation), median per-eval over ``reps`` regions."""
+    run()  # warmup: allocator + BLAS thread pools
+    times = []
+    for _ in range(reps):
+        t0 = time.monotonic()
+        run()
+        times.append(time.monotonic() - t0)
+    return float(np.median(times))
 
 
 def bench_sycamore_amplitude():
@@ -834,20 +877,20 @@ def bench_ghz3():
     arrays = [leaf.data.into_data() for leaf in flat_leaf_tensors(tn)]
 
     backend = JaxBackend(dtype="complex64")
-    # device-resident timing (host=False contract): the tunnel's first
-    # D2H degrades later dispatches ~430x, so fetch once after timing
-    tpu_s, out = _time_backend(
-        lambda: backend.execute_on_device(program, arrays), reps
-    )
+    # steady-state contract: inputs resident in HBM, dispatches
+    # pipelined (block once per region), D2H only after timing — the
+    # tunnel's first D2H degrades later dispatches ~430x
+    bound = backend.bind_resident(program, arrays)
+    tpu_s, calls, out = _time_pipelined(bound, reps)
     sv = _fetch_device_result(backend, out).reshape(-1)
     if abs(abs(sv[0]) - 1 / np.sqrt(2)) >= 1e-5:
         raise BenchCheckError(f"ghz3 amplitude wrong: {sv[0]} vs 1/sqrt(2)")
 
     cpu = NumpyBackend(dtype=np.complex64)
-    t0 = time.monotonic()
-    cpu.execute(program, arrays)
-    cpu_s = time.monotonic() - t0
-    return "ghz3_statevector_wallclock", tpu_s, cpu_s / tpu_s if tpu_s else 0.0
+    cpu_s = _time_numpy(lambda: cpu.execute(program, arrays), reps)
+    extra = {"timing": "pipelined-steady-state", "pipeline_calls": calls}
+    return ("ghz3_statevector_wallclock", tpu_s,
+            cpu_s / tpu_s if tpu_s else 0.0, extra)
 
 
 def bench_random20():
@@ -870,9 +913,8 @@ def bench_random20():
     arrays = [leaf.data.into_data() for leaf in flat_leaf_tensors(tn)]
 
     backend = JaxBackend(dtype="complex64")
-    tpu_s, out = _time_backend(
-        lambda: backend.execute_on_device(program, arrays), reps
-    )
+    bound = backend.bind_resident(program, arrays)
+    tpu_s, calls, out = _time_pipelined(bound, reps)
     sv = _fetch_device_result(backend, out).reshape(-1)
     norm = float(np.vdot(sv, sv).real)
     log(f"[bench] statevector norm: {norm:.6f}")
@@ -880,10 +922,10 @@ def bench_random20():
         raise BenchCheckError(f"random20 statevector norm wrong: {norm}")
 
     cpu = NumpyBackend(dtype=np.complex64)
-    t0 = time.monotonic()
-    cpu.execute(program, arrays)
-    cpu_s = time.monotonic() - t0
-    return "random20_d12_statevector_wallclock", tpu_s, cpu_s / tpu_s if tpu_s else 0.0
+    cpu_s = _time_numpy(lambda: cpu.execute(program, arrays), reps)
+    extra = {"timing": "pipelined-steady-state", "pipeline_calls": calls}
+    return ("random20_d12_statevector_wallclock", tpu_s,
+            cpu_s / tpu_s if tpu_s else 0.0, extra)
 
 
 def bench_qaoa30():
@@ -921,17 +963,16 @@ def bench_qaoa30():
     arrays = [leaf.data.into_data() for leaf in flat_leaf_tensors(ptn)]
 
     backend = JaxBackend(dtype="complex64")
-    tpu_s, out = _time_backend(
-        lambda: backend.execute_on_device(program, arrays), reps
-    )
+    bound = backend.bind_resident(program, arrays)
+    tpu_s, calls, out = _time_pipelined(bound, reps)
     ev = complex(_fetch_device_result(backend, out).reshape(-1)[0])
     log(f"[bench] <Z...Z> = {ev}")
 
     cpu = NumpyBackend(dtype=np.complex64)
-    t0 = time.monotonic()
-    cpu.execute(program, arrays)
-    cpu_s = time.monotonic() - t0
-    return f"qaoa{qubits}_expectation_wallclock", tpu_s, cpu_s / tpu_s if tpu_s else 0.0
+    cpu_s = _time_numpy(lambda: cpu.execute(program, arrays), reps)
+    extra = {"timing": "pipelined-steady-state", "pipeline_calls": calls}
+    return (f"qaoa{qubits}_expectation_wallclock", tpu_s,
+            cpu_s / tpu_s if tpu_s else 0.0, extra)
 
 
 def bench_sycamore_m20_partitioned():
@@ -1186,7 +1227,7 @@ def _run_config(config: str) -> dict:
     extra = out[3] if len(out) > 3 else {}
     record = {
         "metric": metric,
-        "value": round(tpu_s, 4),
+        "value": round(tpu_s, 4) if tpu_s >= 0.001 else float(f"{tpu_s:.3g}"),
         "unit": "s",
         "vs_baseline": round(vs_baseline, 2),
         "device": f"{device.platform}:{device.device_kind}",

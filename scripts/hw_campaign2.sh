@@ -68,19 +68,28 @@ require_tunnel() {
 # clamp parity sampling to the oracle cache of the plan bench will
 # actually run (oracle_status resolves the promoted marker, so this
 # stays correct even after a prior campaign promoted target_log2=30):
-# a live window must never compute minutes-per-slice host oracle work
-ostat=$(python scripts/oracle_status.py 2>/dev/null || echo '{}')
-echo "oracle status (marker-resolved target): $ostat" | tee -a "$out/STATUS2"
-cached=$(printf '%s' "$ostat" | sed -n 's/.*"oracle_slices": \([0-9]*\).*/\1/p')
-cached=${cached:-0}
-parity=$(( cached >= 2 ? (cached > 16 ? 16 : cached) : 2 ))
-export BENCH_PARITY_SLICES=$parity
-echo "BENCH_PARITY_SLICES=$parity"
+# a live window must never compute minutes-per-slice host oracle work.
+# Called again after every stage that can promote target_log2 (the
+# r4-advisor medium finding: a stale clamp from the pre-promotion
+# target can exceed the new target's oracle cache and trigger
+# minutes-per-slice host numpy inside the window).
+reclamp_parity() {
+  ostat=$(python scripts/oracle_status.py 2>/dev/null || echo '{}')
+  echo "oracle status (marker-resolved target): $ostat" | tee -a "$out/STATUS2"
+  cached=$(printf '%s' "$ostat" | sed -n 's/.*"oracle_slices": \([0-9]*\).*/\1/p')
+  cached=${cached:-0}
+  parity=$(( cached >= 2 ? (cached > 16 ? 16 : cached) : 2 ))
+  export BENCH_PARITY_SLICES=$parity
+  echo "BENCH_PARITY_SLICES=$parity"
+}
+reclamp_parity
 
 record_verdict() {
-  # ok / parity_miss:<v> / unmeasured / invalid — the distinction
-  # matters for the evidence trail (a wedge or timeout must not be
-  # recorded as an accuracy failure of the config under test)
+  # ok / cpu-fallback / parity_miss:<v> / unmeasured / invalid — the
+  # distinction matters for the evidence trail (a wedge or timeout must
+  # not be recorded as an accuracy failure of the config under test; a
+  # silent CPU fallback must not license an hour-scale follow-up stage
+  # whose on-device parity was never validated — r4-advisor finding)
   python - "$1" << 'PY'
 import json, os, sys
 target = float(os.environ.get("BENCH_PARITY_TARGET", "1e-5"))
@@ -91,8 +100,12 @@ try:
 except Exception:
     print("invalid")
     raise SystemExit
+from bench import _is_hw_device  # the one hardware-device rule
+
 if "error" in r or "timing_suspect" in r:
     print("invalid")
+elif not _is_hw_device(str(r.get("device", ""))):
+    print("cpu-fallback")
 elif "parity" not in r:
     print("unmeasured")
 elif r["parity"] > target:
@@ -107,7 +120,14 @@ promote() {
   # parity-passing, non-suspect, fully-measured record with a better
   # wall-clock; on success, pin its config as the bench default so the
   # driver's end-of-round run uses the promoted configuration ($2 is a
-  # JSON fragment of tuned defaults, e.g. '{"complex_mult": "gauss"}')
+  # JSON fragment of tuned defaults, e.g. '{"complex_mult": "gauss"}').
+  # Refuses while the hardware test tier is red (VERDICT r4 #1a): a
+  # published record must never sit next to a failing device-parity
+  # test.
+  if [ "${TIER_GREEN:-0}" != "1" ]; then
+    echo "promote: REFUSED — hardware test tier is not green"
+    return 1
+  fi
   python - "$1" "$2" << 'PY'
 import glob, json, sys
 cand_path, tuned = sys.argv[1], json.loads(sys.argv[2])
@@ -153,6 +173,27 @@ print(f"promoted {cand_path} -> bench_main.json "
 PY
 }
 
+echo "== 0. hardware test tier (gates all promotion/publication) =="
+TNC_TPU_TEST_PLATFORM=tpu timeout 2400 python -m pytest \
+  tests/test_tpu_hardware.py -q -p no:cacheprovider \
+  > "$out/hw_tier2.log" 2>&1
+tier_rc=$?
+tail -1 "$out/hw_tier2.log" | tee -a "$out/STATUS2"
+if [ "$tier_rc" = "0" ]; then
+  TIER_GREEN=1
+  echo "hardware tier GREEN — promotions enabled" | tee -a "$out/STATUS2"
+else
+  TIER_GREEN=0
+  echo "hardware tier RED (rc=$tier_rc) — promotions and consolidation" \
+    "DISABLED; fix the tier first" | tee -a "$out/STATUS2"
+  tail -40 "$out/hw_tier2.log" >> "$out/STATUS2"
+  # exit WITHOUT the done-marker: the watcher re-arms with backoff, so a
+  # fixed tier gets a fresh fully-enabled campaign in the next window
+  exit 1
+fi
+export TIER_GREEN
+
+require_tunnel "1"
 echo "== 1. full-measured gauss north-star (official-record candidate) =="
 BENCH_COMPLEX_MULT=gauss BENCH_NO_RETRY=1 timeout 3600 python bench.py \
   > "$out/bench_gauss_full.json" 2> "$out/bench_gauss_full.log"
@@ -203,7 +244,7 @@ if [ "$p30" -ge 2 ]; then
       > "$out/bench_t30_full.json" 2> "$out/bench_t30_full.log"
     echo "rc=$? $(cat "$out/bench_t30_full.json" 2>/dev/null | tail -1)"
     promote "$out/bench_t30_full.json" '{"target_log2": "30"}' \
-      && echo "2^30 target promoted"
+      && { echo "2^30 target promoted"; reclamp_parity; }
   else
     echo "2^30 NOT promoted (verdict: $t30_verdict); staying at 2^29"
   fi
@@ -212,10 +253,16 @@ else
 fi
 
 require_tunnel "2"
-echo "== 2. hardware test tier (post-fix re-run) =="
-timeout 2400 python -m pytest tests/test_tpu_hardware.py -q -p no:cacheprovider \
-  > "$out/hw_tier2.log" 2>&1
-echo "rc=$? $(tail -1 "$out/hw_tier2.log")"
+echo "== 2. small-config captures (pipelined steady-state timing, r5) =="
+# ghz3/qaoa30 lost to the CPU oracle in r4 because each timed rep paid
+# per-leaf H2D over the tunnel; the r5 benches stage inputs once and
+# pipeline dispatches (VERDICT r4 #2). Capture all three so the
+# consolidated artifact carries on-TPU numbers for every config.
+for cfg in ghz3 random20 qaoa30; do
+  BENCH_CONFIG=$cfg BENCH_NO_RETRY=1 timeout 1500 python bench.py \
+    > "$out/bench_$cfg.json" 2> "$out/bench_$cfg.log"
+  echo "rc=$? $(tail -1 "$out/bench_$cfg.json" 2>/dev/null)"
+done
 
 require_tunnel "3"
 echo "== 3. sync audit (timing honesty per executor) =="
@@ -274,11 +321,21 @@ else:
 PY
 
 echo "== 5. consolidate =="
-art=$(ls BENCH_ALL_r*.json 2>/dev/null | sort | tail -1)
-art=${art:-BENCH_ALL_r04.json}
-python scripts/consolidate_bench.py "$out" --artifact "$art" \
-    > "$art.tmp" 2>> "$out/watch.log" \
-  && mv "$art.tmp" "$art" \
-  && echo "$art written"
-cp -f "$out/bench_main.json" BENCH_r04_campaign.json 2>/dev/null || true
+if [ "${TIER_GREEN:-0}" = "1" ]; then
+  # round-5 records must land in the r05 artifact, never overwrite the
+  # published r04 one (seed r05 from the newest artifact if absent)
+  art=BENCH_ALL_r05.json
+  if [ ! -f "$art" ]; then
+    prev=$(ls BENCH_ALL_r*.json 2>/dev/null | sort | tail -1)
+    [ -n "$prev" ] && cp "$prev" "$art"
+  fi
+  python scripts/consolidate_bench.py "$out" --artifact "$art" \
+      > "$art.tmp" 2>> "$out/watch.log" \
+    && mv "$art.tmp" "$art" \
+    && echo "$art written"
+  cp -f "$out/bench_main.json" BENCH_r05_campaign.json 2>/dev/null || true
+else
+  echo "consolidation SKIPPED: hardware tier red — no records published" \
+    | tee -a "$out/STATUS2"
+fi
 echo "campaign2 done $(date -u +%H:%M:%SZ)" | tee -a "$out/STATUS2"

@@ -59,7 +59,14 @@ def _load_northstar():
     )
     tn = simplify_network(raw)
     cache = ArtifactCache(os.path.join(REPO, ".cache", "plans"))
-    key = northstar_plan_key(qubits, depth, seed, 128, 29.0)
+    # resolve the slicing target the same way bench.py does (env +
+    # promoted marker) so the audit certifies the SAME plan the capture
+    # stage will run — a hardcoded 29.0 diverges after a 2^30 promotion
+    # (r4-advisor finding)
+    from bench import _current_target_log2
+
+    ntrials = int(os.environ.get("BENCH_NTRIALS", "128"))
+    key = northstar_plan_key(qubits, depth, seed, ntrials, _current_target_log2())
     cached = cache.load_obj(key)
     if cached is None:
         raise SystemExit(f"plan cache miss ({key}); run the prewarm first")

@@ -1,0 +1,118 @@
+"""Fan-in-aware tree-cut partitioning (``tnc_tpu.contractionpath.treecut``).
+
+The partition-assignment analogue of the reference's balancing tier
+(``tnc/src/contractionpath/contraction_tree/balancing.rs``): cutting a
+serial contraction tree must yield (a) a valid dense assignment, (b)
+local paths that reproduce the serial amplitude exactly through
+``compute_solution_with_paths``, and (c) a critical path no worse than
+the serial total.
+"""
+
+import random as pyrandom
+
+import numpy as np
+import pytest
+
+from tnc_tpu.builders.connectivity import ConnectivityLayout
+from tnc_tpu.builders.random_circuit import random_circuit
+from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+from tnc_tpu.contractionpath.repartitioning import compute_solution_with_paths
+from tnc_tpu.contractionpath.treecut import plan_treecut
+from tnc_tpu.tensornetwork.contraction import contract_tensor_network
+from tnc_tpu.tensornetwork.simplify import simplify_network
+
+
+def _instance(seed=7, qubits=16, depth=10):
+    rng = np.random.default_rng(seed)
+    tn = simplify_network(
+        random_circuit(
+            qubits, depth, 0.5, 0.5, rng, ConnectivityLayout.SYCAMORE,
+            bitstring="0" * qubits,
+        )
+    )
+    result = Greedy(OptMethod.GREEDY).find_path(tn)
+    return tn, result
+
+
+def test_assignment_shape_and_density():
+    tn, result = _instance()
+    for k in (2, 4, 8):
+        plan = plan_treecut(
+            list(tn.tensors), result.ssa_path.toplevel, k, steps=0
+        )
+        assert len(plan.assignment) == len(tn.tensors)
+        blocks = sorted(set(plan.assignment))
+        assert blocks == list(range(len(blocks)))
+        assert len(blocks) <= k
+        assert len(plan.local_paths) == len(blocks)
+        # each block's path fully contracts the block
+        sizes = [plan.assignment.count(b) for b in blocks]
+        for b, size in zip(blocks, sizes):
+            assert len(plan.local_paths[b]) == size - 1
+
+
+def test_partitioned_amplitude_matches_serial():
+    tn, result = _instance()
+    plan = plan_treecut(
+        list(tn.tensors), result.ssa_path.toplevel, 4, steps=300, seed=3
+    )
+    ptn, ppath, par, ser = compute_solution_with_paths(
+        tn, plan.assignment, plan.local_paths, rng=pyrandom.Random(0)
+    )
+    got = complex(
+        contract_tensor_network(ptn, ppath, backend="numpy").data.into_data()
+    )
+    want = complex(
+        contract_tensor_network(
+            tn, result.replace_path(), backend="numpy"
+        ).data.into_data()
+    )
+    assert abs(got - want) <= 1e-8 * max(1.0, abs(want))
+
+
+def test_anneal_does_not_regress_critical():
+    tn, result = _instance()
+    cold = plan_treecut(list(tn.tensors), result.ssa_path.toplevel, 8, steps=0)
+    hot = plan_treecut(
+        list(tn.tensors), result.ssa_path.toplevel, 8, steps=1500, seed=1
+    )
+    assert hot.critical_estimate <= cold.critical_estimate
+    assert hot.critical_estimate <= hot.serial_estimate
+    assert hot.speedup_estimate >= 1.0
+
+
+def test_trivial_k1_and_tiny_network():
+    tn, result = _instance()
+    plan = plan_treecut(list(tn.tensors), result.ssa_path.toplevel, 1)
+    assert set(plan.assignment) == {0}
+    assert len(plan.local_paths[0]) == len(tn.tensors) - 1
+    # k=1 local path must reproduce the serial amplitude too
+    ptn, ppath, _, _ = compute_solution_with_paths(
+        tn, plan.assignment, plan.local_paths, rng=pyrandom.Random(0)
+    )
+    got = complex(
+        contract_tensor_network(ptn, ppath, backend="numpy").data.into_data()
+    )
+    want = complex(
+        contract_tensor_network(
+            tn, result.replace_path(), backend="numpy"
+        ).data.into_data()
+    )
+    assert abs(got - want) <= 1e-8 * max(1.0, abs(want))
+
+    # n <= k: every tensor its own block
+    small_tn, small_res = _instance(qubits=4, depth=2)
+    n = len(small_tn.tensors)
+    plan2 = plan_treecut(
+        list(small_tn.tensors), small_res.ssa_path.toplevel, n + 3
+    )
+    assert plan2.assignment == list(range(n))
+    assert all(p == [] for p in plan2.local_paths)
+
+
+def test_determinism():
+    tn, result = _instance()
+    a = plan_treecut(list(tn.tensors), result.ssa_path.toplevel, 4, steps=400, seed=9)
+    b = plan_treecut(list(tn.tensors), result.ssa_path.toplevel, 4, steps=400, seed=9)
+    assert a.assignment == b.assignment
+    assert a.critical_estimate == b.critical_estimate

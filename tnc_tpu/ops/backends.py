@@ -475,6 +475,24 @@ class JaxBackend(Backend):
         """
         return self._run(program, self._device_buffers(arrays))
 
+    def bind_resident(self, program: ContractionProgram, arrays: Sequence[Any]):
+        """Stage ``arrays`` to the device once and return a zero-transfer
+        callable: each call re-dispatches the compiled program on the
+        resident input buffers and returns the device-resident result
+        (stored shape; a (real, imag) pair in split mode).
+
+        Donation is disabled for the bound executable so the resident
+        inputs survive arbitrarily many calls — this is the steady-state
+        evaluation shape (gate tensors live in HBM, only the dispatch
+        recurs), the analogue of the reference's timed contraction region
+        which starts after data placement
+        (``benchmark/src/main.rs:355-405``).
+        """
+        precision = self.precision if self.split_complex else None
+        fn = jit_program(program, self.split_complex, precision, donate=False)
+        buffers = self._device_buffers(arrays)
+        return lambda: fn(buffers)
+
 
 _BACKENDS: dict[str, Backend] = {}
 
