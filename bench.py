@@ -748,6 +748,88 @@ def _sa_rebalance(tn, partitioning, sa_rng, sa_seconds):
     return best_solution[0], report
 
 
+def _ssa_to_replace(ssa_pairs):
+    """SSA pair list → replace-left pair list (flat paths only); thin
+    wrapper over the canonical converter."""
+    from tnc_tpu.contractionpath.contraction_path import (
+        ContractionPath,
+        ssa_replace_ordering,
+    )
+
+    return ssa_replace_ordering(
+        ContractionPath.simple(list(ssa_pairs)), len(ssa_pairs) + 1
+    ).toplevel
+
+
+def _rank_solution(solution, hbm):
+    """Execution-faithful lexicographic rank of a partitioned solution:
+    (global slice count at the device budget, critical-path cost). The
+    slice count comes from the SAME planner the executor runs
+    (``plan_global_slicing``) — on the mesh the per-slice fixed cost
+    dominates the flop term (measured round 4)."""
+    from tnc_tpu.parallel.partitioned import (
+        flatten_partitioned_path,
+        global_slicing_target,
+        plan_global_slicing,
+    )
+
+    ptn, ppath, par, _ser = solution
+    leaves, pairs = flatten_partitioned_path(ptn, ppath)
+    slicing = plan_global_slicing(leaves, pairs, global_slicing_target(hbm))
+    return (slicing.num_slices, par), slicing
+
+
+def _config5_serial_plan(tn, qubits, depth, seed):
+    """Best-known *serial* plan for the config-5 instance (native hyper
+    search, disk-cached): (flops, ssa_pairs, peak_elements). The serial
+    plan anchors two candidate strategies (tree-cut partitioning and
+    slice-parallel SPMD) and the honest cross-strategy speedup metric
+    ``speedup_vs_best_serial``. Returns None when planning fails."""
+    from tnc_tpu.benchmark.cache import cache_key
+
+    trials = _env_int("BENCH_CONFIG5_TRIALS", 16)
+    pcache = _plan_cache()
+    key = cache_key(
+        "config5-serial-v1", f"sycamore-{qubits}-m{depth}", seed, trials, "hyper"
+    )
+    use_cache = os.environ.get("BENCH_NO_PLAN_CACHE") != "1"
+    if use_cache:
+        cached = pcache.load_obj(key)
+        if (
+            isinstance(cached, dict)
+            and len(cached.get("ssa", ())) == len(tn.tensors) - 1
+        ):
+            return cached["flops"], cached["ssa"], cached["peak"]
+    try:
+        from tnc_tpu.contractionpath.paths.hyper import Hyperoptimizer
+
+        t0 = time.monotonic()
+        result = Hyperoptimizer(
+            ntrials=trials,
+            seed=seed,
+            reconfigure_budget=float(
+                os.environ.get("BENCH_CONFIG5_RECONF_S", "30")
+            ),
+            polish_rounds=_env_int("BENCH_CONFIG5_POLISH", 6),
+        ).find_path(tn)
+        log(
+            f"[bench] serial plan: {result.flops:.4e} flops, "
+            f"peak 2^{np.log2(max(result.size, 1)):.1f} "
+            f"({time.monotonic() - t0:.1f}s)"
+        )
+        obj = {
+            "flops": float(result.flops),
+            "ssa": [tuple(p) for p in result.ssa_path.toplevel],
+            "peak": float(result.size),
+        }
+        if use_cache:
+            pcache.store_obj(key, obj)
+        return obj["flops"], obj["ssa"], obj["peak"]
+    except Exception as e:  # noqa: BLE001 — serial plan is an optional anchor
+        log(f"[bench] serial plan failed: {type(e).__name__}: {e}")
+        return None
+
+
 def _is_hw_device(dev: str) -> bool:
     """device is "{platform}:{device_kind}" — anything that isn't a
     CPU / cpu-fallback / virtual-mesh record is hardware evidence
@@ -1045,11 +1127,6 @@ def bench_sycamore_m20_partitioned():
     # slice count comes from the SAME planner the executor runs
     # (plan_global_slicing), so the rank is execution-faithful.
     from tnc_tpu.benchmark.cache import cache_key
-    from tnc_tpu.parallel.partitioned import (
-        flatten_partitioned_path,
-        global_slicing_target,
-        plan_global_slicing,
-    )
 
     # The budget is the MODELED device's (BASELINE #5 is an 8-way v5e
     # mesh; the virtual CPU mesh stands in for it), pinned explicitly so
@@ -1059,14 +1136,9 @@ def bench_sycamore_m20_partitioned():
 
     def _rank(assignment):
         """(global_slices, critical_path) for lexicographic compare."""
-        p_tn, p_path, par, ser = compute_solution(
-            tn, assignment, rng=pyrandom.Random(seed)
-        )
-        leaves, pairs = flatten_partitioned_path(p_tn, p_path)
-        slicing = plan_global_slicing(
-            leaves, pairs, global_slicing_target(hbm)
-        )
-        return (slicing.num_slices, par), (p_tn, p_path, par, ser)
+        solution = compute_solution(tn, assignment, rng=pyrandom.Random(seed))
+        r, _slicing = _rank_solution(solution, hbm)
+        return r, solution
 
     use_plan_cache = os.environ.get("BENCH_NO_PLAN_CACHE") != "1"
     pcache = _plan_cache()
@@ -1129,11 +1201,189 @@ def bench_sycamore_m20_partitioned():
                     {"assignment": list(partitioning), "rank": list(rank)},
                 )
     sa_report["planned_global_slices"] = rank[0]
-    planning_s = time.monotonic() - t0
     log(
         f"[bench] partitioned: k={k}, critical-path {parallel_cost:.3e}, "
-        f"serial {serial_cost:.3e} (planned in {planning_s:.1f}s)"
+        f"serial {serial_cost:.3e}"
     )
+
+    # ---- candidate strategies beyond the SA-rebalanced assignment ----
+    # (round 5, VERDICT r4 #5): all ranks are execution-faithful
+    # (sequential mesh rounds, then critical-path naive op cost) so the
+    # three parallelism shapes compare on what the mesh actually pays.
+    from tnc_tpu.contractionpath.repartitioning import (
+        compute_solution_with_paths,
+    )
+    from tnc_tpu.contractionpath.communication_schemes import (
+        CommunicationScheme,
+    )
+    from tnc_tpu.contractionpath.slicing import (
+        find_parallel_slicing,
+        sliced_flops,
+    )
+    from tnc_tpu.contractionpath.treecut import plan_treecut
+
+    serial_plan = _config5_serial_plan(tn, qubits, depth, seed)
+    strategy = os.environ.get("BENCH_STRATEGY", "auto")
+    chosen = {
+        "strategy": "partitioned",
+        "rank": rank,
+        "solution": (ptn, ppath, parallel_cost, serial_cost),
+        "report": sa_report,
+    }
+
+    if serial_plan is not None:
+        serial_flops, serial_ssa, _serial_peak = serial_plan
+        # (b) tree-cut partitioning: contiguous frontier of the serial
+        # tree, local paths preserved, latency-aware fan-in
+        try:
+            tc = plan_treecut(
+                list(tn.tensors), serial_ssa, k,
+                steps=_env_int("BENCH_TREECUT_STEPS", 4000), seed=seed,
+            )
+            tc_sol = compute_solution_with_paths(
+                tn, tc.assignment, tc.local_paths,
+                communication_scheme=CommunicationScheme.WEIGHTED_BRANCH_BOUND,
+                rng=pyrandom.Random(seed),
+            )
+            tc_rank, tc_detail = _rank_solution(tc_sol, hbm)
+            log(
+                f"[bench] treecut candidate: rank {tc_rank} "
+                f"(critical {tc_sol[2]:.3e}, serial {tc_sol[3]:.3e})"
+            )
+            if tc_rank < chosen["rank"]:
+                chosen = {
+                    "strategy": "treecut",
+                    "rank": tc_rank,
+                    "solution": tc_sol,
+                    "report": dict(sa_report, treecut=True),
+                }
+        except Exception as e:  # noqa: BLE001 — candidate is optional
+            log(f"[bench] treecut candidate failed: {type(e).__name__}: {e}")
+
+        # (c) slice-parallel SPMD: the serial plan, sliced into a
+        # device-divisible slice set; every device runs its share, one
+        # psum combines (tnc_tpu.parallel.sliced_parallel)
+        try:
+            from tnc_tpu.parallel.partitioned import global_slicing_target
+
+            replace_pairs = _ssa_to_replace(serial_ssa)
+            # same budget model as the partitioned pipeline (padded
+            # split-complex working set), so the strategies rank under
+            # one memory story
+            target_elems = global_slicing_target(hbm)
+            psl = find_parallel_slicing(
+                list(tn.tensors), replace_pairs, k, target_size=target_elems
+            )
+            if psl is not None:
+                tot = sliced_flops(list(tn.tensors), replace_pairs, psl)
+                sp_rank = (psl.num_slices // k, tot / k)
+                log(
+                    f"[bench] slice-parallel candidate: rank {sp_rank} "
+                    f"({psl.num_slices} slices, total {tot:.3e}, "
+                    f"overhead {tot/serial_flops:.2f}x, "
+                    f"vs-best-serial {serial_flops/(tot/k):.2f}x)"
+                )
+                if strategy == "sliced" or (
+                    strategy == "auto" and sp_rank < chosen["rank"]
+                ):
+                    chosen = {
+                        "strategy": "sliced",
+                        "rank": sp_rank,
+                        "slicing": psl,
+                        "replace_pairs": replace_pairs,
+                        "total_flops": tot,
+                        "report": {
+                            "slice_overhead": round(tot / serial_flops, 3),
+                            "speedup_vs_best_serial": round(
+                                serial_flops / (tot / k), 2
+                            ),
+                        },
+                    }
+        except Exception as e:  # noqa: BLE001 — candidate is optional
+            log(
+                f"[bench] slice-parallel candidate failed: "
+                f"{type(e).__name__}: {e}"
+            )
+        if strategy == "partitioned":
+            if chosen["strategy"] != "partitioned":
+                chosen = {
+                    "strategy": "partitioned",
+                    "rank": rank,
+                    "solution": (ptn, ppath, parallel_cost, serial_cost),
+                    "report": sa_report,
+                }
+
+    planning_s = time.monotonic() - t0
+    log(f"[bench] strategy: {chosen['strategy']} (planned {planning_s:.1f}s)")
+
+    if chosen["strategy"] == "sliced":
+        from tnc_tpu.contractionpath.contraction_path import ContractionPath
+        from tnc_tpu.parallel.sliced_parallel import (
+            distributed_sliced_contraction,
+            make_mesh,
+        )
+
+        psl = chosen["slicing"]
+        tot = chosen["total_flops"]
+        mesh = make_mesh(k)
+        path_obj = ContractionPath.simple(chosen["replace_pairs"])
+        rounds_total = psl.num_slices // k
+
+        rounds_probe = max(1, min(probe, rounds_total))
+        t0 = time.monotonic()
+        distributed_sliced_contraction(
+            tn, path_obj, psl, mesh=mesh, split_complex=split_complex,
+            max_slices=rounds_probe * k,
+        )  # warmup at the probe's own chunk: compile stays out of the
+        # timed region (the SPMD executable is cached per chunk)
+        warmup_s = time.monotonic() - t0
+        log(f"[bench] warmup (incl. compile): {warmup_s:.1f}s")
+
+        t0 = time.monotonic()
+        out = distributed_sliced_contraction(
+            tn, path_obj, psl, mesh=mesh, split_complex=split_complex,
+            max_slices=rounds_probe * k,
+        )
+        subset_s = time.monotonic() - t0
+        per_round = subset_s / rounds_probe
+        total = per_round * rounds_total
+        amp = complex(
+            np.asarray(out.data.into_data()).reshape(-1)[0]
+        )
+        log(
+            f"[bench] {rounds_probe}/{rounds_total} mesh rounds in "
+            f"{subset_s:.1f}s -> extrapolated full {total:.1f}s; "
+            f"partial amplitude {amp}"
+        )
+        critical_of_plan = tot / k
+        # vs_baseline: speedup over the BEST SERIAL plan executed on one
+        # device — the honest cross-strategy number. (The same-plan
+        # ratio serial/critical is definitionally k for slice-parallel;
+        # it is still recorded as plan_parallel_speedup with that
+        # caveat in the field name's docs.)
+        vs_serial = serial_flops / max(critical_of_plan, 1)
+        extra = {
+            "strategy": "sliced-parallel",
+            "global_slices": psl.num_slices,
+            "sliced_legs": len(psl.legs),
+            "mesh_rounds": rounds_total,
+            "serial_plan_flops": serial_flops,
+            "plan_parallel_speedup": round(tot / max(critical_of_plan, 1), 2),
+            "plan_parallel_speedup_note": "definitional k for slice-parallel",
+            "planning_s": round(planning_s, 1),
+        }
+        if rounds_probe < rounds_total:
+            extra["extrapolated_from_slices"] = rounds_probe * k
+        extra.update(chosen["report"])
+        return (
+            f"sycamore{qubits}_m{depth}_partitioned{k}_wallclock",
+            total,
+            vs_serial,
+            extra,
+        )
+
+    ptn, ppath, parallel_cost, serial_cost = chosen["solution"]
+    sa_report = chosen["report"]
 
     t0 = time.monotonic()
     run, slicing, _meta = partitioned_sliced_executor(
@@ -1165,11 +1415,17 @@ def bench_sycamore_m20_partitioned():
     log(f"[bench] partial amplitude: {amp}")
 
     extra = {
+        "strategy": chosen["strategy"],
         "global_slices": slicing.num_slices,
         "sliced_legs": len(slicing.legs),
         "plan_parallel_speedup": round(serial_cost / max(parallel_cost, 1), 2),
         "planning_s": round(planning_s, 1),
     }
+    if serial_plan is not None:
+        extra["serial_plan_flops"] = serial_plan[0]
+        extra["speedup_vs_best_serial"] = round(
+            serial_plan[0] / max(parallel_cost, 1), 2
+        )
     if n_probe < slicing.num_slices:
         extra["extrapolated_from_slices"] = n_probe
     extra.update(sa_report)

@@ -18,6 +18,9 @@ python scripts/run_doctests.py
 echo "== tests + coverage (floor ${COVERAGE_MIN:-75}%) =="
 python scripts/coverage_gate.py tests/ -q
 
+echo "== configuration matrix (cargo-hack analogue) =="
+bash scripts/matrix.sh
+
 echo "== examples =="
 # TNC_TPU_PLATFORM pins JAX to CPU via jax.config (env vars alone can be
 # overridden by interpreter startup hooks that pre-wire an accelerator);

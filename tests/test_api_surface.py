@@ -89,8 +89,13 @@ SURFACE = {
     "tnc_tpu.contractionpath.slicing": [
         "Slicing",
         "find_slicing",
+        "find_parallel_slicing",
         "sliced_flops",
         "slice_and_reconfigure",
+    ],
+    "tnc_tpu.contractionpath.treecut": [
+        "TreecutPlan",
+        "plan_treecut",
     ],
     "tnc_tpu.parallel.partitioned": [
         "broadcast_path",

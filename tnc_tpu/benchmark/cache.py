@@ -26,11 +26,30 @@ from tnc_tpu.tensornetwork.tensor import CompositeTensor
 def cache_key(
     scheme: str, circuit_text: str, seed: int, partitions: int, method: str
 ) -> str:
+    """Deterministic artifact key (circuit text hashed, params inline).
+
+    >>> key = cache_key("greedy", "OPENQASM 2.0;", 7, 4, "sa")
+    >>> key == cache_key("greedy", "OPENQASM 2.0;", 7, 4, "sa")
+    True
+    >>> key.startswith("greedy_") and key.endswith("_7_4_sa")
+    True
+    """
     digest = hashlib.sha256(circuit_text.encode()).hexdigest()[:16]
     return f"{scheme}_{digest}_{seed}_{partitions}_{method}"
 
 
 class ArtifactCache:
+    """Keyed compressed artifact store with atomic writes.
+
+    >>> import tempfile
+    >>> cache = ArtifactCache(tempfile.mkdtemp())
+    >>> cache.store_obj("k", {"plan": [1, 2]})
+    >>> cache.load_obj("k")
+    {'plan': [1, 2]}
+    >>> cache.load_obj("missing") is None
+    True
+    """
+
     def __init__(self, directory: str | Path):
         self.directory = Path(directory)
         self.directory.mkdir(parents=True, exist_ok=True)

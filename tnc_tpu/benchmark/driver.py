@@ -70,6 +70,23 @@ def do_sweep(
 ) -> OptimizationResult | None:
     """Optimize one scenario, cache the artifact, append the record.
 
+    >>> import tempfile, os, numpy as np
+    >>> from tnc_tpu.builders.connectivity import ConnectivityLayout
+    >>> from tnc_tpu.builders.random_circuit import random_circuit
+    >>> d = tempfile.mkdtemp()
+    >>> tn = random_circuit(6, 4, 0.5, 0.5, np.random.default_rng(0),
+    ...                     ConnectivityLayout.LINE)
+    >>> sc = Scenario("toy", "toy-circuit", 2, 1, "greedy")
+    >>> r = do_sweep(sc, tn, ArtifactCache(os.path.join(d, "cache")),
+    ...     ResultWriter(os.path.join(d, "r.jsonl")),
+    ...     Protocol(os.path.join(d, "p.jsonl")), time_budget=5.0)
+    >>> r.method, r.flops > 0
+    ('greedy', True)
+    >>> do_sweep(sc, tn, ArtifactCache(os.path.join(d, "cache")),
+    ...     ResultWriter(os.path.join(d, "r.jsonl")),
+    ...     Protocol(os.path.join(d, "p.jsonl")), time_budget=5.0) is None
+    True
+
     Returns None when the protocol says this cell already ran (or
     crashed last time) — the crash-resume behavior of the reference.
     """

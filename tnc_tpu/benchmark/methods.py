@@ -130,6 +130,23 @@ def _flat_finder(make_finder):
     return run
 
 
+def methods_example():
+    """The sweep-method registry mirrors the reference's method set.
+
+    >>> sorted(METHODS)[:4]
+    ['genetic', 'greedy', 'greedy-balance', 'hyper']
+    >>> import numpy as np
+    >>> from tnc_tpu.builders.connectivity import ConnectivityLayout
+    >>> from tnc_tpu.builders.random_circuit import random_circuit
+    >>> tn = random_circuit(6, 4, 0.5, 0.5, np.random.default_rng(0),
+    ...                     ConnectivityLayout.LINE)
+    >>> ctx = MethodContext(tn, partitions=2, seed=1, time_budget=2.0)
+    >>> ptn, path = METHODS["greedy"].run(ctx)
+    >>> len(ptn) >= 1 and path.toplevel is not None
+    True
+    """
+
+
 METHODS: dict[str, MethodRun] = {
     m.name: m
     for m in [

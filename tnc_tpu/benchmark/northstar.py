@@ -21,6 +21,14 @@ PLAN_SCHEME = "northstar-plan-v2"
 def northstar_plan_key(
     qubits: int, depth: int, seed: int, ntrials: int, target_log2: float
 ) -> str:
+    """Stable cache key for the north-star plan.
+
+    >>> northstar_plan_key(53, 14, 42, 128, 29.0) == northstar_plan_key(
+    ...     53, 14, 42, 128, 29.0)
+    True
+    >>> northstar_plan_key(53, 14, 42, 128, 29.0).endswith("hyper-target2^29")
+    True
+    """
     return cache_key(
         PLAN_SCHEME,
         f"sycamore-{qubits}-m{depth}-seed{seed}-trials{ntrials}",

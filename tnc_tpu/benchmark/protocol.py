@@ -13,6 +13,22 @@ from pathlib import Path
 
 
 class Protocol:
+    """Append-only Trying/Done/Error journal.
+
+    >>> import tempfile, os
+    >>> p = os.path.join(tempfile.mkdtemp(), "journal.jsonl")
+    >>> proto = Protocol(p)
+    >>> proto.should_run("cell-1")
+    True
+    >>> proto.trying("cell-1"); proto.done("cell-1")
+    >>> proto.should_run("cell-1")
+    False
+    >>> proto.trying("cell-2")  # crash here: no Done follows
+    >>> resumed = Protocol(p)   # restart marks cell-2 as Error
+    >>> resumed.should_run("cell-2"), sorted(resumed.failed)
+    (False, ['cell-2'])
+    """
+
     def __init__(self, path: str | Path):
         self.path = Path(path)
         self.path.parent.mkdir(parents=True, exist_ok=True)

@@ -44,7 +44,14 @@ class RunResult:
 
 
 class ResultWriter:
-    """Append-only JSON-lines writer."""
+    """Append-only JSON-lines writer.
+
+    >>> import tempfile, os
+    >>> w = ResultWriter(os.path.join(tempfile.mkdtemp(), "results.jsonl"))
+    >>> w.write(RunResult("id1", "sa", "ghz", 4, 7, 1.5))
+    >>> [r["kind"] for r in w.read_all()]
+    ['RunResult']
+    """
 
     def __init__(self, path: str | Path):
         self.path = Path(path)

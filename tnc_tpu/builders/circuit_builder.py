@@ -61,6 +61,16 @@ class QuantumRegister:
 class Permutor:
     """Transposes the final tensor to the target (natural) leg order
     (``circuit_builder.rs:77-122``).
+
+    >>> from tnc_tpu.tensornetwork.tensor import LeafTensor
+    >>> from tnc_tpu.tensornetwork.tensordata import TensorData
+    >>> import numpy as np
+    >>> t = LeafTensor([5, 3], [2, 4],
+    ...     TensorData.matrix(np.arange(8.0).reshape(2, 4)))
+    >>> Permutor([3, 5]).apply(t).bond_dims
+    [4, 2]
+    >>> Permutor([]).is_identity()
+    True
     """
 
     def __init__(self, target_leg_order: Sequence[EdgeIndex]) -> None:

@@ -28,6 +28,12 @@ def peps(
     """Build the closed PEPS/PEPO sandwich network.
 
     Total tensors: ``(layers + 2) * length * depth``.
+
+    >>> tn = peps(3, 3, 2, 3, 1)
+    >>> len(tn.tensors)            # (1 + 2) * 3 * 3
+    27
+    >>> tn.external_tensor().legs  # closed sandwich: no open legs
+    []
     """
     if length < 2:
         raise ValueError("PEPS should have length greater than 1")

@@ -31,6 +31,12 @@ def qaoa_circuit(
 ) -> Circuit:
     """QAOA MaxCut ansatz with ``rounds`` (γ, β) layers of random angles
     on the ``layout`` coupling graph (default: a line of ``qubits``).
+
+    >>> import numpy as np
+    >>> c = qaoa_circuit(4, 2, np.random.default_rng(0))
+    >>> tn = c.into_expectation_value_network()
+    >>> tn.external_tensor().legs  # <psi|Z...Z|psi> closes every leg
+    []
     """
     graph = Connectivity.new(layout, qubits)
     edges = [(u, v) for (u, v) in graph.connectivity if u < qubits and v < qubits]

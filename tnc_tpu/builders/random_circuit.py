@@ -82,6 +82,17 @@ def random_circuit(
     ``bitstring`` defaults to |0…0⟩ (the reference's behavior,
     ``random_circuit.rs:29-80``); pass ``"*" * qubits`` for an open
     statevector network.
+
+    >>> import numpy as np
+    >>> from tnc_tpu.builders.connectivity import ConnectivityLayout
+    >>> tn = random_circuit(6, 4, 0.5, 0.5, np.random.default_rng(0),
+    ...                     ConnectivityLayout.LINE)
+    >>> tn.external_tensor().legs          # amplitude: fully closed
+    []
+    >>> sv = random_circuit(6, 4, 0.5, 0.5, np.random.default_rng(0),
+    ...                     ConnectivityLayout.LINE, bitstring="*" * 6)
+    >>> len(sv.external_tensor().legs)     # statevector: 6 open legs
+    6
     """
     circuit = random_open_circuit(
         qubits,

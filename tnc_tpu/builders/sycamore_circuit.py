@@ -33,6 +33,16 @@ def sycamore_circuit(
 ) -> Circuit:
     """Build a Sycamore-scheme circuit on ``qubits`` qubits with ``depth``
     rounds. ``qubits`` is capped at 53 (the original device size).
+
+    >>> import numpy as np
+    >>> tn, _ = sycamore_circuit(12, 4, np.random.default_rng(1)
+    ...     ).into_amplitude_network("0" * 12)
+    >>> len(tn.tensors) > 12 and tn.external_tensor().legs == []
+    True
+    >>> sycamore_circuit(54, 1)
+    Traceback (most recent call last):
+        ...
+    ValueError: Only circuits up to the original 53-qubit Sycamore device are supported
     """
     if qubits > 53:
         raise ValueError(

@@ -19,6 +19,13 @@ def random_sparse_tensor_data_with_rng(
     """Fill random complex entries at random locations until the fill
     fraction reaches ``sparsity`` (default 0.5)
     (``tensorgeneration.rs:19-55``).
+
+    >>> import numpy as np
+    >>> data = random_sparse_tensor_data_with_rng(
+    ...     [2, 2], 0.5, np.random.default_rng(0))
+    >>> arr = data.into_data()
+    >>> arr.shape, int((arr != 0).sum())
+    ((2, 2), 2)
     """
     if sparsity is None:
         sparsity = 0.5

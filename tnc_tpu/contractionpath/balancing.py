@@ -620,7 +620,19 @@ def balance_partitions_iter(
 ) -> tuple[int, CompositeTensor, ContractionPath, list[float]]:
     """Iteratively rebalance ``partitioning``; returns
     (best iteration, best partitioned network, best path, cost history)
-    (``balancing.rs:98-210``)."""
+    (``balancing.rs:98-210``).
+
+    >>> import random
+    >>> from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+    >>> tn = CompositeTensor([LeafTensor([0, 1], [2, 2]),
+    ...     LeafTensor([1, 2], [2, 2]), LeafTensor([2, 3], [2, 2]),
+    ...     LeafTensor([3, 0], [2, 2])])
+    >>> it, ptn, path, history = balance_partitions_iter(
+    ...     tn, [0, 0, 0, 1], BalanceSettings(iterations=3),
+    ...     random.Random(0))
+    >>> len(ptn) >= 1 and len(history) >= 1
+    True
+    """
     settings = settings or BalanceSettings()
     rng = rng or random.Random(42)
 

@@ -52,7 +52,17 @@ class CommunicationScheme(enum.Enum):
         latency_map: dict[int, float] | None = None,
         rng: random.Random | None = None,
     ) -> list[tuple[int, int]]:
-        """Replace-format fan-in path over the partition tensors."""
+        """Replace-format fan-in path over the partition tensors.
+
+        >>> from tnc_tpu.tensornetwork.tensor import LeafTensor
+        >>> parts = [LeafTensor([0, 1], [4, 4]), LeafTensor([1, 2], [4, 4]),
+        ...          LeafTensor([2, 0], [4, 4])]
+        >>> sorted(CommunicationScheme.GREEDY.communication_path(parts))
+        [(0, 1), (0, 2)]
+        >>> CommunicationScheme.WEIGHTED_BRANCH_BOUND.communication_path(
+        ...     parts, {0: 1000.0, 1: 0.0, 2: 0.0})[0]  # defer latency-1000
+        (1, 2)
+        """
         if latency_map is None:
             latency_map = {i: 0.0 for i in range(len(children_tensors))}
         if len(children_tensors) <= 1:

@@ -43,7 +43,18 @@ class _Node:
 
 
 class ContractionTree:
-    """Binary contraction tree over ``n`` leaf tensors."""
+    """Binary contraction tree over ``n`` leaf tensors.
+
+    >>> from tnc_tpu.tensornetwork.tensor import LeafTensor
+    >>> ts = [LeafTensor([0, 1], [4, 4]), LeafTensor([1, 2], [4, 4]),
+    ...       LeafTensor([2, 0], [4, 4])]
+    >>> tree = ContractionTree.from_ssa_path(ts, [(0, 1), (3, 2)])
+    >>> tree.to_ssa_path()
+    [(0, 1), (3, 2)]
+    >>> flops, peak = tree.total_cost()
+    >>> flops > 0 and peak >= 48.0
+    True
+    """
 
     def __init__(self, leaf_legs: Sequence[frozenset[int]], dims: dict[int, int]):
         self.dims = dims

@@ -25,7 +25,14 @@ class CostType(enum.Enum):
 
 @dataclass
 class BasicContractionPathResult:
-    """SSA path + predicted cost (``paths.rs:47-76``)."""
+    """SSA path + predicted cost (``paths.rs:47-76``).
+
+    >>> from tnc_tpu.contractionpath.contraction_path import ContractionPath
+    >>> r = BasicContractionPathResult(
+    ...     ContractionPath.simple([(0, 1), (2, 3)]), 100.0, 16.0)
+    >>> r.replace_path().toplevel   # ssa ids -> replace-left slots
+    [(0, 1), (2, 0)]
+    """
 
     ssa_path: ContractionPath
     flops: float

@@ -168,7 +168,15 @@ class _BranchSearch:
 
 
 class BranchBound(Pathfinder):
-    """DFS branch-and-bound minimizing complex-op flops (or size)."""
+    """DFS branch-and-bound minimizing complex-op flops (or size).
+
+    >>> from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+    >>> tn = CompositeTensor([LeafTensor([0, 1], [4, 4]),
+    ...     LeafTensor([1, 2], [4, 4]), LeafTensor([2, 0], [4, 4])])
+    >>> result = BranchBound().find_path(tn)
+    >>> len(result.replace_path().toplevel)
+    2
+    """
 
     def __init__(
         self,
@@ -188,7 +196,16 @@ class BranchBound(Pathfinder):
 
 
 class WeightedBranchBound(Pathfinder):
-    """Branch-and-bound over the critical path with per-input latencies."""
+    """Branch-and-bound over the critical path with per-input latencies.
+
+    >>> from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+    >>> tn = CompositeTensor([LeafTensor([0, 1], [4, 4]),
+    ...     LeafTensor([1, 2], [4, 4]), LeafTensor([2, 0], [4, 4])])
+    >>> finder = WeightedBranchBound({0: 100.0, 1: 0.0, 2: 0.0})
+    >>> result = finder.find_path(tn)  # defers the latency-100 input
+    >>> result.replace_path().toplevel[0]
+    (1, 2)
+    """
 
     def __init__(
         self,

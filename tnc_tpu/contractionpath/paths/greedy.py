@@ -147,7 +147,15 @@ def _ssa_greedy(
 
 
 class Greedy(Pathfinder):
-    """Greedy / random-greedy pathfinder (cotengrust equivalent)."""
+    """Greedy / random-greedy pathfinder (cotengrust equivalent).
+
+    >>> from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+    >>> tn = CompositeTensor([LeafTensor([0, 1], [4, 4]),
+    ...     LeafTensor([1, 2], [4, 4]), LeafTensor([2, 0], [4, 4])])
+    >>> result = Greedy(OptMethod.GREEDY).find_path(tn)
+    >>> len(result.replace_path().toplevel), result.flops > 0
+    (2, True)
+    """
 
     def __init__(
         self,

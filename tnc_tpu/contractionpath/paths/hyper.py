@@ -38,6 +38,21 @@ from tnc_tpu.tensornetwork.tensor import LeafTensor
 
 
 class Hyperoptimizer(Pathfinder):
+    """Native recursive-bisection hyper-search with annealing polish.
+
+    >>> import numpy as np
+    >>> from tnc_tpu.builders.connectivity import ConnectivityLayout
+    >>> from tnc_tpu.builders.random_circuit import random_circuit
+    >>> from tnc_tpu.contractionpath.paths.greedy import Greedy, OptMethod
+    >>> tn = random_circuit(8, 6, 0.5, 0.5, np.random.default_rng(3),
+    ...                     ConnectivityLayout.LINE)
+    >>> hy = Hyperoptimizer(ntrials=2, reconfigure_budget=2.0,
+    ...                     polish_rounds=1, polish_steps=200)
+    >>> result = hy.find_path(tn)
+    >>> result.flops <= Greedy(OptMethod.GREEDY).find_path(tn).flops
+    True
+    """
+
     def __init__(
         self,
         ntrials: int = 16,

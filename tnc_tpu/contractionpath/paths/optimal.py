@@ -20,6 +20,17 @@ from tnc_tpu.tensornetwork.tensor import LeafTensor
 
 
 class Optimal(Pathfinder):
+    """Exact subset-DP pathfinder (O(3^n); ``paths/optimal.rs``).
+
+    >>> from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+    >>> tn = CompositeTensor([LeafTensor([0, 1], [4, 4]),
+    ...     LeafTensor([1, 2], [4, 4]), LeafTensor([2, 0], [4, 4])])
+    >>> from tnc_tpu.contractionpath.paths.greedy import Greedy, OptMethod
+    >>> best = Optimal().find_path(tn)
+    >>> best.flops <= Greedy(OptMethod.GREEDY).find_path(tn).flops
+    True
+    """
+
     def __init__(self, cost_type: CostType = CostType.FLOPS, max_tensors: int = 18):
         self.cost_type = cost_type
         self.max_tensors = max_tensors

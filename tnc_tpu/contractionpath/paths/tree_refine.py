@@ -151,7 +151,15 @@ def _anneal(
 
 class TreeAnnealing(Pathfinder):
     """Simulated-annealing tree refinement
-    (``tree_annealing.rs``; greedy init + rotation SA)."""
+    (``tree_annealing.rs``; greedy init + rotation SA).
+
+    >>> from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+    >>> tn = CompositeTensor([LeafTensor([0, 1], [4, 4]),
+    ...     LeafTensor([1, 2], [4, 4]), LeafTensor([2, 0], [4, 4])])
+    >>> result = TreeAnnealing(iterations=5, seed=1).find_path(tn)
+    >>> len(result.replace_path().toplevel), result.flops > 0
+    (2, True)
+    """
 
     def __init__(
         self,

@@ -91,7 +91,19 @@ def balance_partitions(
     settings: GeneticSettings | None = None,
     max_time: float | None = None,
 ) -> tuple[list[int], float]:
-    """Evolve the partitioning; returns (best chromosome, best score)."""
+    """Evolve the partitioning; returns (best chromosome, best score).
+
+    >>> import random
+    >>> from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+    >>> tn = CompositeTensor([LeafTensor([0, 1], [2, 2]),
+    ...     LeafTensor([1, 2], [2, 2]), LeafTensor([2, 3], [2, 2]),
+    ...     LeafTensor([3, 0], [2, 2])])
+    >>> best, score = balance_partitions(
+    ...     tn, [0, 0, 1, 1], 2, random.Random(0),
+    ...     settings=GeneticSettings(population_size=4, max_generations=2))
+    >>> len(best), score > 0
+    (4, True)
+    """
     import time
 
     settings = settings or GeneticSettings()

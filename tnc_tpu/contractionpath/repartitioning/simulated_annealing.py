@@ -157,6 +157,21 @@ class OptModel:
 
 @dataclass
 class NaivePartitioningModel(OptModel):
+    """Move-one-leaf trial model scored by naive serial cost.
+
+    >>> import random
+    >>> from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+    >>> tn = CompositeTensor([LeafTensor([0, 1], [2, 2]),
+    ...     LeafTensor([1, 2], [2, 2]), LeafTensor([2, 3], [2, 2]),
+    ...     LeafTensor([3, 0], [2, 2])])
+    >>> model = NaivePartitioningModel(tn, 2)
+    >>> best, score = balance_partitions(
+    ...     model, model.initial_solution([0, 0, 1, 1]),
+    ...     random.Random(0), max_time=0.5, n_trials=4, n_workers=0)
+    >>> len(best), score > 0
+    (4, True)
+    """
+
     tensor: CompositeTensor
     num_partitions: int
     communication_scheme: CommunicationScheme = CommunicationScheme.GREEDY

@@ -183,6 +183,85 @@ def sliced_flops(
     return replayer.flops(set(slicing.legs)) * slicing.num_slices
 
 
+def find_parallel_slicing(
+    inputs: Sequence[LeafTensor],
+    replace_path: Sequence[tuple[int, int]],
+    n_devices: int,
+    target_size: float | None = None,
+    max_extra_legs: int = 8,
+) -> Slicing | None:
+    """A slicing suitable for **slice-parallel** SPMD execution
+    (:func:`tnc_tpu.parallel.distributed_sliced_contraction`): at least
+    ``n_devices`` slices, count divisible by ``n_devices``, and — when
+    ``target_size`` is given — peak intermediate size within it.
+
+    Memory slicing picks legs by peak reduction (:func:`find_slicing`);
+    the extra legs sliced purely for parallelism are picked to minimize
+    the total sliced flops (the overhead the mesh must amortize).
+    Returns ``None`` if no divisible slicing exists within
+    ``max_extra_legs`` extra legs — the caller falls back to partition
+    parallelism.
+
+    >>> from tnc_tpu.tensornetwork.tensor import LeafTensor
+    >>> ts = [LeafTensor.from_const([0, 1], 4), LeafTensor.from_const([1, 2], 4),
+    ...       LeafTensor.from_const([2, 0], 4)]   # closed triangle
+    >>> s = find_parallel_slicing(ts, [(0, 1), (0, 2)], 4)
+    >>> s.num_slices % 4 == 0 and s.num_slices >= 4
+    True
+    """
+    dims: dict[int, int] = {}
+    open_legs: set[int] = set()
+    for t in inputs:
+        for leg, dim in t.edges():
+            dims[leg] = dim
+            if leg in open_legs:
+                open_legs.discard(leg)
+            else:
+                open_legs.add(leg)
+
+    removed: set[int] = set()
+    if target_size is not None:
+        base = find_slicing(
+            inputs, replace_path, target_size, max_slices=1 << 40
+        )
+        removed = set(base.legs)
+
+    replayer = _make_replayer(inputs, replace_path)
+
+    def count(legs: set[int]) -> int:
+        n = 1
+        for leg in legs:
+            n *= dims[leg]
+        return n
+
+    extra = 0
+    while not (
+        count(removed) >= n_devices and count(removed) % n_devices == 0
+    ):
+        if extra >= max_extra_legs:
+            return None
+        candidates = [
+            leg
+            for leg in dims
+            if leg not in removed and leg not in open_legs and dims[leg] > 1
+        ]
+        if not candidates:
+            return None
+        # minimize total sliced flops after adding the leg
+        best = min(
+            candidates,
+            key=lambda leg: (
+                replayer.flops(removed | {leg}) * count(removed | {leg}),
+                leg,
+            ),
+        )
+        removed.add(best)
+        extra += 1
+
+    ordered = sorted(removed)
+    return Slicing(tuple(ordered), tuple(dims[l] for l in ordered))
+
+
 def flat_replace_path(path_: ContractionPath) -> list[tuple[int, int]]:
     """Toplevel of a simple replace path (slicing operates on flat paths)."""
     if path_.nested:

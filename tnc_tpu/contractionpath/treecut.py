@@ -47,12 +47,23 @@ import random
 from dataclasses import dataclass
 from typing import Sequence
 
+from tnc_tpu.contractionpath.contraction_path import (
+    ContractionPath,
+    ssa_replace_ordering,
+)
 from tnc_tpu.contractionpath.contraction_tree import ContractionTree
 from tnc_tpu.contractionpath.paths.tree_refine import (
     _apply_rotation,
     _rotation_candidates,
 )
 from tnc_tpu.tensornetwork.tensor import LeafTensor
+
+
+def _to_replace(ssa_pairs, num_inputs: int) -> list[tuple[int, int]]:
+    """SSA → replace-left via the canonical converter."""
+    return ssa_replace_ordering(
+        ContractionPath.simple(list(ssa_pairs)), num_inputs
+    ).toplevel
 
 
 @dataclass
@@ -142,14 +153,7 @@ def plan_treecut(
         # path (replace-format), both estimates the tree total
         tree = ContractionTree.from_ssa_path(inputs, ssa_pairs)
         total = tree.total_cost()[0]
-        position: dict[int, int] = {}
-        replace: list[tuple[int, int]] = []
-        for s, (t0, t1) in enumerate(ssa_pairs):
-            r0 = position.get(t0, t0)
-            r1 = position.get(t1, t1)
-            position[n + s] = r0
-            replace.append((r0, r1))
-        return TreecutPlan([0] * n, [replace], total, total)
+        return TreecutPlan([0] * n, [_to_replace(ssa_pairs, n)], total, total)
     if n <= k:
         # every tensor its own single-leaf block: no local steps, the
         # whole tree is fan-in
@@ -247,15 +251,6 @@ def plan_treecut(
             stack2.append((i, True))
             stack2.append((nd.right, False))
             stack2.append((nd.left, False))
-        # ssa -> replace-left over the block
-        position: dict[int, int] = {}
-        replace: list[tuple[int, int]] = []
-        nb = len(leaves)
-        for s, (t0, t1) in enumerate(ssa):
-            r0 = position.get(t0, t0)
-            r1 = position.get(t1, t1)
-            position[nb + s] = r0
-            replace.append((r0, r1))
-        local_paths.append(replace)
+        local_paths.append(_to_replace(ssa, len(leaves)))
 
     return TreecutPlan(assignment, local_paths, critical, serial)

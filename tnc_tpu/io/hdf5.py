@@ -17,6 +17,21 @@ TENSORS_GROUP = "tensors"
 OUTPUT_TENSOR_NAME = "-1"
 
 
+def roundtrip_example():
+    """Store/load a tensor through the reference HDF5 schema.
+
+    >>> import tempfile, os, numpy as np
+    >>> from tnc_tpu.tensornetwork.tensor import LeafTensor
+    >>> from tnc_tpu.tensornetwork.tensordata import TensorData
+    >>> path = os.path.join(tempfile.mkdtemp(), "t.h5")
+    >>> t = LeafTensor([0, 1], [2, 2],
+    ...     TensorData.matrix(np.eye(2, dtype=np.complex128)))
+    >>> store_data(path, 0, t)
+    >>> np.allclose(load_data(path, 0), np.eye(2))
+    True
+    """
+
+
 def load_data(path: str, tensor_id: int) -> np.ndarray:
     """Load a single tensor's data (``hdf5.rs:26-38`` load_data)."""
     import h5py

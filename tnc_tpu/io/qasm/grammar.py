@@ -9,6 +9,17 @@ so the importer can reject them with a clear error
 (``qasm_importer.rs:10-11``).
 """
 
+# >>> doctest: the grammar parses a minimal program (see module tests)
+def parse_example():
+    """
+    >>> import lark
+    >>> parser = lark.Lark(QASM2_GRAMMAR, parser="lalr")
+    >>> tree = parser.parse('OPENQASM 2.0; qreg q[2]; CX q[0], q[1];')
+    >>> [st.data for st in tree.children]
+    [Token('RULE', 'version'), Token('RULE', 'statement'), Token('RULE', 'statement')]
+    """
+
+
 QASM2_GRAMMAR = r"""
 start: version? statement*
 

@@ -5,6 +5,19 @@ OpenQASM 2.0 (embedded the same way the reference embeds it,
 ``include_resolver.rs:16``).
 """
 
+def qelib1_example():
+    """The embedded standard header defines the usual gate set.
+
+    >>> "gate h a" in QELIB1 and "gate cx c,t" in QELIB1
+    True
+    >>> from tnc_tpu.io.qasm import import_qasm
+    >>> c = import_qasm(
+    ...     'OPENQASM 2.0;\\ninclude "qelib1.inc";\\nqreg q[1];\\nh q[0];')
+    >>> len(c.tensor_network)   # |0> ket + the h gate tensor
+    2
+    """
+
+
 QELIB1 = r"""
 // Quantum Experience (QE) Standard Header
 // file: qelib1.inc

@@ -65,6 +65,21 @@ def contraction_value_and_grad(
     The gradient runs through the same whole-path program the forward
     pass uses — no parameter-shift re-contractions. Donation is off (the
     reverse sweep needs the primals).
+
+    >>> from tnc_tpu.builders.circuit_builder import Circuit
+    >>> from tnc_tpu.tensornetwork.tensordata import TensorData
+    >>> from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+    >>> c = Circuit(); reg = c.allocate_register(3)
+    >>> c.append_gate(TensorData.gate("h"), [reg.qubit(0)])
+    >>> for i in range(2):
+    ...     c.append_gate(TensorData.gate("cx"), [reg.qubit(i), reg.qubit(i + 1)])
+    >>> tn, _ = c.into_amplitude_network("111")
+    >>> path = Greedy(OptMethod.GREEDY).find_path(tn).replace_path()
+    >>> value, grads = contraction_value_and_grad(tn, path, wrt=[0])
+    >>> abs(complex(value.reshape(-1)[0]) - 2 ** -0.5) < 1e-6
+    True
+    >>> grads[0].shape   # cotangent shaped like leaf 0
+    (2,)
     """
     import jax
     import jax.numpy as jnp

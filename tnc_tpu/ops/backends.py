@@ -301,6 +301,22 @@ class NumpyBackend(Backend):
 class JaxBackend(Backend):
     """jit-compiled whole-path execution on the default JAX device.
 
+    >>> import numpy as np
+    >>> from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+    >>> from tnc_tpu.tensornetwork.tensordata import TensorData
+    >>> from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+    >>> from tnc_tpu.ops.program import build_program, flat_leaf_tensors
+    >>> tn = CompositeTensor([
+    ...     LeafTensor([0], [2], TensorData.matrix(np.array([1.0, 2.0]))),
+    ...     LeafTensor([0], [2], TensorData.matrix(np.array([3.0, 4.0])))])
+    >>> path = Greedy(OptMethod.GREEDY).find_path(tn).replace_path()
+    >>> program = build_program(tn, path)
+    >>> arrays = [l.data.into_data() for l in flat_leaf_tensors(tn)]
+    >>> complex(JaxBackend(dtype="complex64").execute(program, arrays))
+    (11+0j)
+    >>> complex(NumpyBackend().execute(program, arrays))
+    (11+0j)
+
     Off-CPU the backend automatically switches to split-complex mode
     (tensors as (real, imag) float pairs, Gauss 3-matmul contractions) —
     the TPU runtime has no complex dtypes (see

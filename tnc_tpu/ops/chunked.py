@@ -54,6 +54,21 @@ def split_program(
     A slot is alive at step ``i`` if it will still be *read* at some step
     >= ``i`` (or it is the result slot). Pass-through slots that a chunk
     neither reads nor writes stay host-side and never enter the jit.
+
+    >>> from tnc_tpu.builders.circuit_builder import Circuit
+    >>> from tnc_tpu.tensornetwork.tensordata import TensorData
+    >>> from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+    >>> c = Circuit(); reg = c.allocate_register(3)
+    >>> c.append_gate(TensorData.gate("h"), [reg.qubit(0)])
+    >>> for i in range(2):
+    ...     c.append_gate(TensorData.gate("cx"), [reg.qubit(i), reg.qubit(i + 1)])
+    >>> tn, _ = c.into_amplitude_network("111")
+    >>> path = Greedy(OptMethod.GREEDY).find_path(tn).replace_path()
+    >>> from tnc_tpu.ops.program import build_program
+    >>> program = build_program(tn, path)
+    >>> chunks = split_program(program, 3)
+    >>> len(chunks), sum(len(ch.steps) for ch in chunks) == len(program.steps)
+    (3, True)
     """
     steps = program.steps
     n = len(steps)

@@ -45,7 +45,13 @@ def _tile(dim: int, cap: int, floor: int) -> int | None:
 
 
 def eligible(k: int, m: int, n: int) -> bool:
-    """Can the fused kernel run this (K,M)x(K,N) problem profitably?"""
+    """Can the fused kernel run this (K,M)x(K,N) problem profitably?
+
+    >>> eligible(512, 1024, 1024)   # big power-of-two problem: yes
+    True
+    >>> eligible(4, 4, 4)           # under MIN_FLOPS and tile floors
+    False
+    """
     if 2 * k * m * n < MIN_FLOPS:
         return False
     return (

@@ -582,6 +582,19 @@ def build_program(tn: CompositeTensor, contract_path: ContractionPath) -> Contra
     slots and their nested paths are inlined before the toplevel pairs,
     preserving the reference's contract-children-first order
     (``contraction.rs:42-49``).
+
+    >>> from tnc_tpu.builders.circuit_builder import Circuit
+    >>> from tnc_tpu.tensornetwork.tensordata import TensorData
+    >>> from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+    >>> c = Circuit(); reg = c.allocate_register(3)
+    >>> c.append_gate(TensorData.gate("h"), [reg.qubit(0)])
+    >>> for i in range(2):
+    ...     c.append_gate(TensorData.gate("cx"), [reg.qubit(i), reg.qubit(i + 1)])
+    >>> tn, _ = c.into_amplitude_network("111")
+    >>> path = Greedy(OptMethod.GREEDY).find_path(tn).replace_path()
+    >>> program = build_program(tn, path)
+    >>> program.num_inputs, len(program.steps), program.result_shape
+    (9, 8, ())
     """
     flat_slots: list[LeafTensor] = []
     # (lhs_slot, rhs_slot, lhs_legs, rhs_legs) per step, for the

@@ -100,6 +100,14 @@ def kahan_add(s, c, x):
     1e-5 parity target (VERDICT r3 #2). XLA does not reassociate
     floating-point adds by default, so the compensation survives jit
     (verified by tests/test_kahan.py under jax.jit).
+
+    >>> import numpy as np
+    >>> s = c = np.float32(1.0)
+    >>> c = np.float32(0.0)
+    >>> for _ in range(100):          # plain f32 sum would stay at 1.0
+    ...     s, c = kahan_add(s, c, np.float32(1e-8))
+    >>> 9e-07 < float(s + c) - 1.0 < 1.1e-06
+    True
     """
     y = x + c
     t = s + y

@@ -50,6 +50,15 @@ def complex_mult_env() -> str:
 
 
 def split_array(array: np.ndarray, dtype: str = "float32") -> tuple[np.ndarray, np.ndarray]:
+    """Complex array -> contiguous (real, imag) float pair.
+
+    >>> import numpy as np
+    >>> re, im = split_array(np.array([1 + 2j, 3 - 4j]))
+    >>> re.tolist(), im.tolist()
+    ([1.0, 3.0], [2.0, -4.0])
+    >>> np.allclose(combine_array(re, im), [1 + 2j, 3 - 4j])
+    True
+    """
     array = np.asarray(array)
     return (
         np.ascontiguousarray(array.real, dtype=dtype),

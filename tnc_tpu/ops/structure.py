@@ -33,7 +33,16 @@ def classify_array(arr, tol: float = 1e-12) -> str:
     """Structure class of a (gate-like) tensor, viewed as a matrix over
     its balanced in/out split. Odd-rank or unbalanced tensors (vectors,
     rectangular maps) classify as 'dense' — a contraction against them
-    is never one of the cheap special cases."""
+    is never one of the cheap special cases.
+
+    >>> import numpy as np
+    >>> classify_array(np.diag([1.0, 2.0]))
+    'diagonal'
+    >>> classify_array(np.array([[0.0, 1.0], [1.0, 0.0]]))  # X gate
+    'permutation_scaled'
+    >>> classify_array(np.ones((2, 2)) / 2)
+    'dense'
+    """
     a = np.asarray(arr)
     if a.ndim < 2 or a.ndim % 2 != 0:
         return "dense"

@@ -455,7 +455,20 @@ def flatten_partitioned_path(
 ) -> tuple[list[LeafTensor], list[tuple[int, int]]]:
     """Inline a partitioned path into one flat replace-left path over the
     global leaf list (children in index order, as `flat_leaf_tensors`
-    orders them) — the form the slicing planner consumes."""
+    orders them) — the form the slicing planner consumes.
+
+    >>> import random
+    >>> from tnc_tpu.contractionpath.repartitioning import compute_solution
+    >>> from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+    >>> tn = CompositeTensor([LeafTensor([0, 1], [2, 2]),
+    ...     LeafTensor([1, 2], [2, 2]), LeafTensor([2, 3], [2, 2]),
+    ...     LeafTensor([3, 0], [2, 2])])
+    >>> ptn, ppath, _, _ = compute_solution(tn, [0, 0, 1, 1],
+    ...     rng=random.Random(0))
+    >>> leaves, pairs = flatten_partitioned_path(ptn, ppath)
+    >>> len(leaves), len(pairs)   # 4 leaves, fully contracted
+    (4, 3)
+    """
     flat_leaves: list[LeafTensor] = []
     start: dict[int, int] = {}
     children = list(tn.tensors)
@@ -544,7 +557,12 @@ def plan_global_slicing(flat_leaves, flat_pairs, target_size: float):
 
     while True:
         try:
-            return find_slicing(flat_leaves, flat_pairs, target_size)
+            # deep-slicing instances (Sycamore-53 m20: peak 2^54 from a
+            # 2^28 target) legitimately need >2^24 slices; the cap only
+            # guards runaway loops, one leg per iteration
+            return find_slicing(
+                flat_leaves, flat_pairs, target_size, max_slices=1 << 40
+            )
         except ValueError:
             if target_size > 2.0**62:
                 raise

@@ -215,7 +215,16 @@ def bisect(
     target_fraction: float = 0.5,
     coarsen_to: int = 80,
 ) -> list[int]:
-    """Multilevel 2-way partition of ``hg``; returns block ids (0/1)."""
+    """Multilevel 2-way partition of ``hg``; returns block ids (0/1).
+
+    >>> import random
+    >>> from tnc_tpu.tensornetwork.tensor import LeafTensor
+    >>> from tnc_tpu.partitioning.hypergraph import hypergraph_from_tensors
+    >>> ring = [LeafTensor([i, (i + 1) % 6], [2, 2]) for i in range(6)]
+    >>> blocks = bisect(hypergraph_from_tensors(ring), rng=random.Random(0))
+    >>> sorted(set(blocks)), len(blocks)
+    ([0, 1], 6)
+    """
     if rng is None:
         rng = random.Random(42)
     if hg.num_vertices <= 1:

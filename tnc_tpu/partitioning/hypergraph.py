@@ -76,6 +76,12 @@ def hypergraph_from_tensors(
     Legs appearing in a single tensor (open legs) produce no hyperedge.
     With ``unit_vertex_weights`` False, vertex weight = log2(tensor size),
     so balance constrains memory rather than tensor count.
+
+    >>> from tnc_tpu.tensornetwork.tensor import LeafTensor
+    >>> hg = hypergraph_from_tensors([LeafTensor([0, 1], [2, 2]),
+    ...     LeafTensor([1, 2], [2, 2]), LeafTensor([2, 3], [2, 2])])
+    >>> hg.num_vertices, len(hg.edge_pins)   # legs 1 and 2 are shared
+    (3, 2)
     """
     leaves = [
         t.external_tensor() if isinstance(t, CompositeTensor) else t for t in tensors

@@ -138,6 +138,20 @@ def boundary_mps_contract(
     tunnel pathology in docs/running_on_tpu.md — pin
     ``jax.config.update("jax_platforms", "cpu")`` process-wide first,
     as everywhere else in this stack.)
+
+    >>> import numpy as np
+    >>> from tnc_tpu.builders.peps import peps
+    >>> rng = np.random.default_rng(7)
+    >>> tn = attach_random_data(peps(3, 3, 2, 2, 1), rng)
+    >>> from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+    >>> from tnc_tpu.tensornetwork.contraction import contract_tensor_network
+    >>> path = Greedy(OptMethod.GREEDY).find_path(tn).replace_path()
+    >>> want = complex(contract_tensor_network(tn, path,
+    ...     backend="numpy").data.into_data().reshape(-1)[0])
+    >>> grid = collapse_peps_sandwich(tn, 3, 3, 1)
+    >>> got = boundary_mps_contract(grid, chi=4096)  # chi >= exact rank
+    >>> abs(got - want) <= 1e-8 * max(1.0, abs(want))
+    True
     """
     rows = len(grid)
     if rows < 2 or any(len(r) != len(grid[0]) for r in grid):

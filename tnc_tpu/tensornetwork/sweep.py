@@ -83,6 +83,18 @@ def amplitude_sweep(
     ``circuit`` is consumed (finalizer semantics, like every
     ``into_*_network``). All bitstrings must be fully determined (no
     ``*`` wildcards) and of equal length.
+
+    >>> import math
+    >>> from tnc_tpu.builders.circuit_builder import Circuit
+    >>> from tnc_tpu.tensornetwork.tensordata import TensorData
+    >>> c = Circuit(); reg = c.allocate_register(3)
+    >>> c.append_gate(TensorData.gate("h"), [reg.qubit(0)])
+    >>> for i in range(2):
+    ...     c.append_gate(TensorData.gate("cx"), [reg.qubit(i), reg.qubit(i + 1)])
+    >>> amps = amplitude_sweep(c, ["000", "111", "010"])
+    >>> [round(abs(a), 6) for a in amps] == [
+    ...     round(1 / math.sqrt(2), 6), round(1 / math.sqrt(2), 6), 0.0]
+    True
     """
     if not bitstrings:
         return np.zeros((0,), dtype=np.complex128)

@@ -71,6 +71,15 @@ class TensorData:
 
     @classmethod
     def gate(cls, name: str, angles: tuple[float, ...] = (), adjoint: bool = False) -> "TensorData":
+        """Lazy named-gate payload (materialized via the gate library).
+
+        >>> import numpy as np
+        >>> TensorData.gate("h").into_data().shape
+        (2, 2)
+        >>> x = TensorData.gate("x")
+        >>> np.allclose(x.adjoint().into_data(), x.into_data())  # X is Hermitian
+        True
+        """
         return cls(DataKind.GATE, (name, tuple(angles), adjoint))
 
     @classmethod

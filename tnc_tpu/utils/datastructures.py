@@ -9,6 +9,17 @@ from __future__ import annotations
 
 
 class UnionFind:
+    """Path-halving union-find (``utils/datastructures.rs``).
+
+    >>> uf = UnionFind(4)
+    >>> uf.union(0, 1), uf.union(2, 3), uf.union(1, 2)
+    (True, True, True)
+    >>> uf.union(0, 3)   # already one set
+    False
+    >>> uf.find(0) == uf.find(3)
+    True
+    """
+
     __slots__ = ("parent", "rank")
 
     def __init__(self, n: int) -> None:

@@ -47,7 +47,12 @@ def pin_platform_from_env() -> None:
 
 
 def configure_from_env() -> None:
-    """Attach a stderr handler at ``TNC_TPU_LOG``'s level, if set."""
+    """Attach a stderr handler at ``TNC_TPU_LOG``'s level, if set.
+
+    >>> import os
+    >>> os.environ.pop("TNC_TPU_LOG", None) and None
+    >>> configure_from_env()   # unset: no handler attached, no error
+    """
     level_name = os.environ.get("TNC_TPU_LOG")
     if not level_name:
         return
