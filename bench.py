@@ -767,6 +767,7 @@ def _rank_solution(solution, hbm):
     slice count comes from the SAME planner the executor runs
     (``plan_global_slicing``) — on the mesh the per-slice fixed cost
     dominates the flop term (measured round 4)."""
+    from tnc_tpu.contractionpath.slicing import _make_replayer
     from tnc_tpu.parallel.partitioned import (
         flatten_partitioned_path,
         global_slicing_target,
@@ -775,7 +776,15 @@ def _rank_solution(solution, hbm):
 
     ptn, ppath, par, _ser = solution
     leaves, pairs = flatten_partitioned_path(ptn, ppath)
-    slicing = plan_global_slicing(leaves, pairs, global_slicing_target(hbm))
+    target = global_slicing_target(hbm)
+    slicing = plan_global_slicing(leaves, pairs, target)
+    peak, _ = _make_replayer(leaves, pairs).sizes(set(slicing.legs))
+    if peak > target:
+        # plan_global_slicing relaxed past the budget: the plan cannot
+        # execute on the modeled device (measured r5: the 53q SA plan
+        # relaxed to 2^42 elements and OOM'd at a 2.2 TB allocation) —
+        # rank it unplaceable so a feasible strategy wins
+        return (float("inf"), float("inf")), slicing
     return (slicing.num_slices, par), slicing
 
 
@@ -1145,7 +1154,7 @@ def bench_sycamore_m20_partitioned():
     # budget is part of the key: ranks computed under different budgets
     # are not comparable (slice counts depend on the slicing target)
     pkey = cache_key(
-        "config5-partition-v4",
+        "config5-partition-v5",
         f"sycamore-{qubits}-m{depth}-hbm{hbm}",
         seed,
         k,
@@ -1266,14 +1275,46 @@ def bench_sycamore_m20_partitioned():
         try:
             from tnc_tpu.parallel.partitioned import global_slicing_target
 
-            replace_pairs = _ssa_to_replace(serial_ssa)
             # same budget model as the partitioned pipeline (padded
             # split-complex working set), so the strategies rank under
             # one memory story
             target_elems = global_slicing_target(hbm)
-            psl = find_parallel_slicing(
-                list(tn.tensors), replace_pairs, k, target_size=target_elems
-            )
+            # slice-and-reconfigure re-paths under the sliced size
+            # model — measured r5: greedy slicing of the UNCHANGED path
+            # costs 355x overhead at 30q where reconfigure pays 1.9x
+            replace_pairs = None
+            psl = None
+            try:
+                from tnc_tpu.contractionpath.slicing import (
+                    slice_and_reconfigure,
+                )
+
+                rec_pairs, rec_sl = slice_and_reconfigure(
+                    list(tn.tensors), serial_ssa, target_elems,
+                    max_slices=1 << 40,
+                )
+                if rec_sl.num_slices >= k and rec_sl.num_slices % k == 0:
+                    replace_pairs, psl = rec_pairs, rec_sl
+                else:
+                    # keep the re-pathed plan; only add divisibility legs
+                    psl = find_parallel_slicing(
+                        list(tn.tensors), rec_pairs, k,
+                        target_size=target_elems,
+                    )
+                    if psl is not None:
+                        replace_pairs = rec_pairs
+            except Exception as e:  # noqa: BLE001 — reconfigure is optional
+                log(
+                    f"[bench] slice-and-reconfigure unavailable: "
+                    f"{type(e).__name__}: {e}"
+                )
+            if psl is None:
+                # last resort: greedy slicing of the unchanged serial path
+                replace_pairs = _ssa_to_replace(serial_ssa)
+                psl = find_parallel_slicing(
+                    list(tn.tensors), replace_pairs, k,
+                    target_size=target_elems,
+                )
             if psl is not None:
                 tot = sliced_flops(list(tn.tensors), replace_pairs, psl)
                 sp_rank = (psl.num_slices // k, tot / k)
@@ -1294,8 +1335,8 @@ def bench_sycamore_m20_partitioned():
                         "total_flops": tot,
                         "report": {
                             "slice_overhead": round(tot / serial_flops, 3),
-                            "speedup_vs_best_serial": round(
-                                serial_flops / (tot / k), 2
+                            "speedup_vs_best_serial": float(
+                                f"{serial_flops / (tot / k):.3g}"
                             ),
                         },
                     }
@@ -1361,7 +1402,7 @@ def bench_sycamore_m20_partitioned():
         # ratio serial/critical is definitionally k for slice-parallel;
         # it is still recorded as plan_parallel_speedup with that
         # caveat in the field name's docs.)
-        vs_serial = serial_flops / max(critical_of_plan, 1)
+        vs_serial = float(f"{serial_flops / max(critical_of_plan, 1):.3g}")
         extra = {
             "strategy": "sliced-parallel",
             "global_slices": psl.num_slices,
@@ -1485,7 +1526,11 @@ def _run_config(config: str) -> dict:
         "metric": metric,
         "value": round(tpu_s, 4) if tpu_s >= 0.001 else float(f"{tpu_s:.3g}"),
         "unit": "s",
-        "vs_baseline": round(vs_baseline, 2),
+        "vs_baseline": (
+            round(vs_baseline, 2)
+            if vs_baseline >= 0.01
+            else float(f"{vs_baseline:.3g}")
+        ),
         "device": f"{device.platform}:{device.device_kind}",
     }
     record.update(extra)
