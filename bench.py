@@ -767,7 +767,7 @@ def _rank_solution(solution, hbm):
     slice count comes from the SAME planner the executor runs
     (``plan_global_slicing``) — on the mesh the per-slice fixed cost
     dominates the flop term (measured round 4)."""
-    from tnc_tpu.contractionpath.slicing import _make_replayer
+    from tnc_tpu.contractionpath.slicing import sliced_peak
     from tnc_tpu.parallel.partitioned import (
         flatten_partitioned_path,
         global_slicing_target,
@@ -778,8 +778,7 @@ def _rank_solution(solution, hbm):
     leaves, pairs = flatten_partitioned_path(ptn, ppath)
     target = global_slicing_target(hbm)
     slicing = plan_global_slicing(leaves, pairs, target)
-    peak, _ = _make_replayer(leaves, pairs).sizes(set(slicing.legs))
-    if peak > target:
+    if sliced_peak(leaves, pairs, slicing) > target:
         # plan_global_slicing relaxed past the budget: the plan cannot
         # execute on the modeled device (measured r5: the 53q SA plan
         # relaxed to 2^42 elements and OOM'd at a 2.2 TB allocation) —
@@ -1296,17 +1295,18 @@ def bench_sycamore_m20_partitioned():
                 if rec_sl.num_slices >= k and rec_sl.num_slices % k == 0:
                     replace_pairs, psl = rec_pairs, rec_sl
                 else:
-                    # keep the re-pathed plan; only add divisibility legs
+                    # keep the re-pathed plan AND its slicing; only add
+                    # divisibility legs on top of it
                     psl = find_parallel_slicing(
-                        list(tn.tensors), rec_pairs, k,
-                        target_size=target_elems,
+                        list(tn.tensors), rec_pairs, k, base=rec_sl
                     )
                     if psl is not None:
                         replace_pairs = rec_pairs
             except Exception as e:  # noqa: BLE001 — reconfigure is optional
                 log(
-                    f"[bench] slice-and-reconfigure unavailable: "
-                    f"{type(e).__name__}: {e}"
+                    f"[bench] reconfigured slice-parallel plan failed "
+                    f"({type(e).__name__}: {e}); falling back to the "
+                    f"serial path's greedy slicing"
                 )
             if psl is None:
                 # last resort: greedy slicing of the unchanged serial path

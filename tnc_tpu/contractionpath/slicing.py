@@ -183,21 +183,44 @@ def sliced_flops(
     return replayer.flops(set(slicing.legs)) * slicing.num_slices
 
 
+def sliced_peak(
+    inputs: Sequence[LeafTensor],
+    replace_path: Sequence[tuple[int, int]],
+    slicing: Slicing,
+) -> float:
+    """Peak step size (elements, out+in1+in2) of the path with
+    ``slicing.legs`` removed — the memory the executor actually pays
+    per slice.
+
+    >>> from tnc_tpu.tensornetwork.tensor import LeafTensor
+    >>> ts = [LeafTensor.from_const([0, 1], 4), LeafTensor.from_const([1, 2], 4),
+    ...       LeafTensor.from_const([2, 0], 4)]
+    >>> s = find_slicing(ts, [(0, 1), (0, 2)], target_size=12)
+    >>> sliced_peak(ts, [(0, 1), (0, 2)], s) <= 12.0
+    True
+    """
+    peak, _ = _make_replayer(inputs, replace_path).sizes(set(slicing.legs))
+    return peak
+
+
 def find_parallel_slicing(
     inputs: Sequence[LeafTensor],
     replace_path: Sequence[tuple[int, int]],
     n_devices: int,
     target_size: float | None = None,
     max_extra_legs: int = 8,
+    base: Slicing | None = None,
 ) -> Slicing | None:
     """A slicing suitable for **slice-parallel** SPMD execution
     (:func:`tnc_tpu.parallel.distributed_sliced_contraction`): at least
     ``n_devices`` slices, count divisible by ``n_devices``, and — when
     ``target_size`` is given — peak intermediate size within it.
 
-    Memory slicing picks legs by peak reduction (:func:`find_slicing`);
-    the extra legs sliced purely for parallelism are picked to minimize
-    the total sliced flops (the overhead the mesh must amortize).
+    Memory slicing picks legs by peak reduction (:func:`find_slicing`),
+    or comes in as ``base`` (e.g. a :func:`slice_and_reconfigure`
+    result to extend with divisibility legs only); the extra legs
+    sliced purely for parallelism are picked to minimize the total
+    sliced flops (the overhead the mesh must amortize).
     Returns ``None`` if no divisible slicing exists within
     ``max_extra_legs`` extra legs — the caller falls back to partition
     parallelism.
@@ -219,12 +242,13 @@ def find_parallel_slicing(
             else:
                 open_legs.add(leg)
 
-    removed: set[int] = set()
-    if target_size is not None:
-        base = find_slicing(
-            inputs, replace_path, target_size, max_slices=1 << 40
+    removed: set[int] = set(base.legs) if base is not None else set()
+    if base is None and target_size is not None:
+        removed = set(
+            find_slicing(
+                inputs, replace_path, target_size, max_slices=1 << 40
+            ).legs
         )
-        removed = set(base.legs)
 
     replayer = _make_replayer(inputs, replace_path)
 

@@ -8,6 +8,8 @@ holds an output tensor and is skipped when loading a network.
 
 from __future__ import annotations
 
+import contextlib
+
 import numpy as np
 
 from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
@@ -15,6 +17,47 @@ from tnc_tpu.tensornetwork.tensordata import TensorData
 
 TENSORS_GROUP = "tensors"
 OUTPUT_TENSOR_NAME = "-1"
+
+
+def memory_file(name: str | None = None):
+    """An in-memory core-backed HDF5 file (no disk IO) — the reference's
+    test-fixture style (``hdf5.rs:119-124``, ``FileAccessProperties``
+    with a core driver and no backing store). Pass the returned handle
+    anywhere a path is accepted.
+
+    >>> import numpy as np
+    >>> from tnc_tpu.tensornetwork.tensor import LeafTensor
+    >>> from tnc_tpu.tensornetwork.tensordata import TensorData
+    >>> f = memory_file()
+    >>> t = LeafTensor([0, 1], [2, 2],
+    ...     TensorData.matrix(np.eye(2, dtype=np.complex128)))
+    >>> store_data(f, 0, t)
+    >>> np.allclose(load_data(f, 0), np.eye(2))
+    True
+    >>> f.close()
+    """
+    import uuid
+
+    import h5py
+
+    # HDF5 tracks open files by name even for the core driver, so a
+    # fixed default would make a second concurrent in-memory file fail
+    if name is None:
+        name = f"tnc-mem-{uuid.uuid4().hex}.h5"
+    return h5py.File(name, "w", driver="core", backing_store=False)
+
+
+@contextlib.contextmanager
+def _open(src, mode: str):
+    """Accept a path (opened/closed here) or an already-open h5py.File
+    (left open for the caller)."""
+    import h5py
+
+    if isinstance(src, h5py.File):
+        yield src
+    else:
+        with h5py.File(src, mode) as f:
+            yield f
 
 
 def roundtrip_example():
@@ -32,11 +75,10 @@ def roundtrip_example():
     """
 
 
-def load_data(path: str, tensor_id: int) -> np.ndarray:
-    """Load a single tensor's data (``hdf5.rs:26-38`` load_data)."""
-    import h5py
-
-    with h5py.File(path, "r") as f:
+def load_data(path, tensor_id: int) -> np.ndarray:
+    """Load a single tensor's data (``hdf5.rs:26-38`` load_data).
+    ``path`` may be a filename or an open ``h5py.File``."""
+    with _open(path, "r") as f:
         dataset = f[TENSORS_GROUP][str(tensor_id)]
         return np.asarray(dataset[()], dtype=np.complex128)
 
@@ -46,12 +88,16 @@ def load_tensor(path: str, lazy: bool = True) -> CompositeTensor:
 
     With ``lazy`` (default), leaf data stays a FILE reference and is
     materialized at contraction time, matching the reference's lazy
-    ``TensorData::File``.
+    ``TensorData::File``. ``path`` may be a filename or an open
+    ``h5py.File``; in-memory files have no filename for a lazy
+    reference to point at, so they always load eagerly.
     """
     import h5py
 
+    if isinstance(path, h5py.File):
+        lazy = False  # nothing durable for a FILE reference to resolve
     tensors: list[LeafTensor] = []
-    with h5py.File(path, "r") as f:
+    with _open(path, "r") as f:
         group = f[TENSORS_GROUP]
         for name in sorted(group, key=lambda s: int(s)):
             if name == OUTPUT_TENSOR_NAME:
@@ -72,12 +118,11 @@ def load_tensor(path: str, lazy: bool = True) -> CompositeTensor:
     return CompositeTensor(tensors)
 
 
-def store_data(path: str, tensor_id: int, tensor: LeafTensor) -> None:
-    """Store a single tensor (``hdf5.rs:52-67`` store_data)."""
-    import h5py
-
+def store_data(path, tensor_id: int, tensor: LeafTensor) -> None:
+    """Store a single tensor (``hdf5.rs:52-67`` store_data).
+    ``path`` may be a filename or an open ``h5py.File``."""
     data = tensor.data.into_data()
-    with h5py.File(path, "a") as f:
+    with _open(path, "a") as f:
         group = f.require_group(TENSORS_GROUP)
         name = str(tensor_id)
         if name in group:
