@@ -1,0 +1,107 @@
+"""Choosing a parallelism strategy: partitioning vs tree-cut vs slices.
+
+Round-5 addition, beyond the reference (whose only distributed shape is
+MPI partitioning, ``tnc/src/mpi/communication.rs``): the same network
+can be parallelized three ways, and which one wins is an empirical
+question the planner should answer per instance — not doctrine.
+
+1. SA-rebalanced hypergraph partitioning (the reference's shape);
+2. tree-cut partitioning: contiguous frontier of one good serial tree,
+   local paths preserved (``tnc_tpu.contractionpath.treecut``);
+3. slice-parallel SPMD: every device runs a share of the slices of the
+   SAME serial plan, one psum combines
+   (``tnc_tpu.parallel.sliced_parallel``).
+
+Run (8-device virtual CPU mesh):
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  TNC_TPU_PLATFORM=cpu python examples/strategy_selection.py
+"""
+
+import sys
+from pathlib import Path
+
+try:
+    import tnc_tpu  # noqa: F401
+except ModuleNotFoundError:  # running from a source checkout
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import random as pyrandom
+
+import numpy as np
+
+from tnc_tpu.builders.connectivity import ConnectivityLayout
+from tnc_tpu.builders.random_circuit import random_circuit
+from tnc_tpu.contractionpath.contraction_path import ContractionPath
+from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+from tnc_tpu.contractionpath.repartitioning import (
+    compute_solution,
+    compute_solution_with_paths,
+)
+from tnc_tpu.contractionpath.communication_schemes import CommunicationScheme
+from tnc_tpu.contractionpath.slicing import (
+    find_parallel_slicing,
+    sliced_flops,
+)
+from tnc_tpu.contractionpath.treecut import plan_treecut
+from tnc_tpu.parallel import distributed_sliced_contraction, make_mesh
+from tnc_tpu.tensornetwork.contraction import contract_tensor_network
+from tnc_tpu.tensornetwork.simplify import simplify_network
+
+
+def main() -> None:
+    import jax
+
+    k = min(8, len(jax.devices()))
+    rng = np.random.default_rng(7)
+    tn = simplify_network(
+        random_circuit(
+            18, 12, 0.5, 0.5, rng, ConnectivityLayout.SYCAMORE,
+            bitstring="0" * 18,
+        )
+    )
+    serial = Greedy(OptMethod.GREEDY).find_path(tn)
+    print(f"network: {len(tn.tensors)} cores, serial plan {serial.flops:.3g} flops")
+
+    # 1. hypergraph partitioning (min-cut + greedy local paths)
+    from tnc_tpu.tensornetwork.partitioning import find_partitioning
+
+    assignment = find_partitioning(tn, k)
+    _, _, par1, ser1 = compute_solution(
+        tn, assignment, rng=pyrandom.Random(0)
+    )
+    print(f"partitioned : critical {par1:.3g}  (vs serial plan "
+          f"{serial.flops / par1:.2f}x)")
+
+    # 2. tree-cut: frontier of the serial tree, local paths preserved
+    tc = plan_treecut(list(tn.tensors), serial.ssa_path.toplevel, k, steps=2000)
+    _, _, par2, ser2 = compute_solution_with_paths(
+        tn, tc.assignment, tc.local_paths,
+        communication_scheme=CommunicationScheme.WEIGHTED_BRANCH_BOUND,
+        rng=pyrandom.Random(0),
+    )
+    print(f"tree-cut    : critical {par2:.3g}  (vs serial plan "
+          f"{serial.flops / par2:.2f}x)")
+
+    # 3. slice-parallel: k-divisible slices of the serial plan
+    replace = serial.replace_path()
+    psl = find_parallel_slicing(list(tn.tensors), replace.toplevel, k)
+    tot = sliced_flops(list(tn.tensors), replace.toplevel, psl)
+    print(f"slice-SPMD  : critical {tot / k:.3g}  (overhead "
+          f"{tot / serial.flops:.2f}x, vs serial plan "
+          f"{serial.flops / (tot / k):.2f}x)")
+
+    # execute the slice-parallel plan on the mesh and check it
+    mesh = make_mesh(k)
+    out = distributed_sliced_contraction(tn, replace, psl, mesh=mesh)
+    got = complex(np.asarray(out.data.into_data()).reshape(-1)[0])
+    want = complex(
+        contract_tensor_network(tn, replace, backend="numpy").data.into_data()
+    )
+    err = abs(got - want) / max(1.0, abs(want))
+    print(f"mesh run over {k} devices: amplitude {got:.6g} "
+          f"(parity {err:.2e})")
+    assert err <= 1e-5
+
+
+if __name__ == "__main__":
+    main()

@@ -199,8 +199,7 @@ def sliced_peak(
     >>> sliced_peak(ts, [(0, 1), (0, 2)], s) <= 12.0
     True
     """
-    peak, _ = _make_replayer(inputs, replace_path).sizes(set(slicing.legs))
-    return peak
+    return _make_replayer(inputs, replace_path).peak(set(slicing.legs))
 
 
 def find_parallel_slicing(
@@ -242,6 +241,11 @@ def find_parallel_slicing(
             else:
                 open_legs.add(leg)
 
+    if base is not None and target_size is not None:
+        # precedence would be ambiguous: base was planned against its
+        # own budget, and silently skipping the target check here would
+        # void the docstring's peak guarantee
+        raise ValueError("pass either base or target_size, not both")
     removed: set[int] = set(base.legs) if base is not None else set()
     if base is None and target_size is not None:
         removed = set(

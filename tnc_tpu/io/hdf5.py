@@ -83,7 +83,7 @@ def load_data(path, tensor_id: int) -> np.ndarray:
         return np.asarray(dataset[()], dtype=np.complex128)
 
 
-def load_tensor(path: str, lazy: bool = True) -> CompositeTensor:
+def load_tensor(path, lazy: bool = True) -> CompositeTensor:
     """Load a whole tensor network (``hdf5.rs:40-50`` load_tensor).
 
     With ``lazy`` (default), leaf data stays a FILE reference and is
