@@ -96,7 +96,6 @@ def test_rank_solution_gates_budget_missing_plans():
 
 
 def test_spmd_fn_cache_reuses_executable():
-    from tnc_tpu.contractionpath.contraction_path import ContractionPath
     from tnc_tpu.parallel.sliced_parallel import (
         _SPMD_FN_CACHE,
         distributed_sliced_contraction,

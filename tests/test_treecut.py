@@ -11,7 +11,6 @@ the serial total.
 import random as pyrandom
 
 import numpy as np
-import pytest
 
 from tnc_tpu.builders.connectivity import ConnectivityLayout
 from tnc_tpu.builders.random_circuit import random_circuit
