@@ -1246,7 +1246,9 @@ def bench_sycamore_m20_partitioned():
         try:
             tc = plan_treecut(
                 list(tn.tensors), serial_ssa, k,
-                steps=_env_int("BENCH_TREECUT_STEPS", 4000), seed=seed,
+                steps=_env_int("BENCH_TREECUT_STEPS", 20000),
+                patience=_env_int("BENCH_TREECUT_PATIENCE", 4000),
+                seed=seed,
             )
             tc_sol = compute_solution_with_paths(
                 tn, tc.assignment, tc.local_paths,
