@@ -777,7 +777,9 @@ def _rank_solution(solution, hbm):
     ptn, ppath, par, _ser = solution
     leaves, pairs = flatten_partitioned_path(ptn, ppath)
     target = global_slicing_target(hbm)
-    slicing = plan_global_slicing(leaves, pairs, target)
+    # deep ranking cap: recognize budget-infeasible plans instead of
+    # relaxing silently (executors keep the default executable cap)
+    slicing = plan_global_slicing(leaves, pairs, target, max_slices=1 << 40)
     if sliced_peak(leaves, pairs, slicing) > target:
         # plan_global_slicing relaxed past the budget: the plan cannot
         # execute on the modeled device (measured r5: the 53q SA plan
@@ -1431,7 +1433,7 @@ def bench_sycamore_m20_partitioned():
     t0 = time.monotonic()
     run, slicing, _meta = partitioned_sliced_executor(
         ptn, ppath, devices=devices[:k], split_complex=split_complex,
-        hbm_bytes=hbm,
+        hbm_bytes=hbm, plan_max_slices=1 << 40,
     )
     setup_s = time.monotonic() - t0
     log(

@@ -546,22 +546,29 @@ def global_slicing_target(hbm_bytes: float) -> float:
     return max(float(hbm_bytes) / 64.0, 4.0)
 
 
-def plan_global_slicing(flat_leaves, flat_pairs, target_size: float):
+def plan_global_slicing(
+    flat_leaves, flat_pairs, target_size: float, max_slices: int = 1 << 24
+):
     """Find the global slicing for a flattened partitioned path at
     ``target_size`` elements, relaxing the target 4x at a time when it
-    needs more slices than the planner's cap (the per-slice footprint
+    needs more slices than ``max_slices`` (the per-slice footprint
     then overshoots the budget — best effort; the caller sees the
     slicing and can re-plan). Host-only: benchmark plan ranking calls
-    this without touching devices."""
+    this without touching devices.
+
+    ``max_slices`` defaults to the executable regime (2^24 sequential
+    rounds is already far beyond any practical run); PLAN RANKING may
+    pass a deep cap (2^40) so budget-infeasible candidates are
+    recognized rather than silently relaxed — an executor must never
+    inherit that cap, or a degenerate tiny-peak network turns into a
+    billion-iteration slice loop (measured round 5: the multichip
+    dryrun's 36-element network)."""
     from tnc_tpu.contractionpath.slicing import find_slicing
 
     while True:
         try:
-            # deep-slicing instances (Sycamore-53 m20: peak 2^54 from a
-            # 2^28 target) legitimately need >2^24 slices; the cap only
-            # guards runaway loops, one leg per iteration
             return find_slicing(
-                flat_leaves, flat_pairs, target_size, max_slices=1 << 40
+                flat_leaves, flat_pairs, target_size, max_slices=max_slices
             )
         except ValueError:
             if target_size > 2.0**62:
@@ -582,12 +589,19 @@ def partitioned_sliced_executor(
     precision: str | None = "float32",
     hbm_bytes: int | None = None,
     target_size: float | None = None,
+    plan_max_slices: int = 1 << 24,
 ):
     """Compile the partitioned × globally-sliced pipeline once and return
     ``(run, slicing, final_meta)`` where ``run(max_slices=None)`` executes
     the slice loop (partial sum when capped) and returns the accumulated
     host array — compiled executables are reused across calls (the
-    benchmark warms up with one slice, then times a subset)."""
+    benchmark warms up with one slice, then times a subset).
+
+    ``plan_max_slices``: forwarded to :func:`plan_global_slicing` — the
+    benchmark passes its deep ranking cap (2^40) so the slicing the
+    executor compiles is the SAME one the strategy rank scored (probe
+    subsets keep deep slice sets affordable); interactive callers keep
+    the executable default."""
     import jax
     import jax.numpy as jnp
 
@@ -614,7 +628,9 @@ def partitioned_sliced_executor(
         if hbm_bytes is None:
             hbm_bytes = device_hbm_bytes(devices[0])
         target_size = global_slicing_target(hbm_bytes)
-    slicing = plan_global_slicing(flat_leaves, flat_pairs, target_size)
+    slicing = plan_global_slicing(
+        flat_leaves, flat_pairs, target_size, max_slices=plan_max_slices
+    )
     logger.debug(
         "global slicing: %d legs, %d slices (target %g elems)",
         len(slicing.legs),
