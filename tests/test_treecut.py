@@ -115,3 +115,27 @@ def test_determinism():
     b = plan_treecut(list(tn.tensors), result.ssa_path.toplevel, 4, steps=400, seed=9)
     assert a.assignment == b.assignment
     assert a.critical_estimate == b.critical_estimate
+
+
+def test_tree_toplevel_fanin_is_exact():
+    """The emitted top-region fan-in reproduces the serial amplitude
+    when passed as the communication path."""
+    tn, result = _instance()
+    plan = plan_treecut(
+        list(tn.tensors), result.ssa_path.toplevel, 4, steps=500, seed=5
+    )
+    assert len(plan.toplevel) == len(set(plan.assignment)) - 1
+    ptn, ppath, par, ser = compute_solution_with_paths(
+        tn, plan.assignment, plan.local_paths,
+        rng=pyrandom.Random(0), communication_path=plan.toplevel,
+    )
+    got = complex(
+        contract_tensor_network(ptn, ppath, backend="numpy").data.into_data()
+    )
+    want = complex(
+        contract_tensor_network(
+            tn, result.replace_path(), backend="numpy"
+        ).data.into_data()
+    )
+    assert abs(got - want) <= 1e-8 * max(1.0, abs(want))
+    assert par <= ser

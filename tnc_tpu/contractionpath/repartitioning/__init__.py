@@ -63,6 +63,7 @@ def compute_solution_with_paths(
     local_paths: Sequence[Sequence[tuple[int, int]]],
     communication_scheme: CommunicationScheme = CommunicationScheme.GREEDY,
     rng: random.Random | None = None,
+    communication_path: Sequence[tuple[int, int]] | None = None,
 ) -> tuple[CompositeTensor, ContractionPath, float, float]:
     """Like :func:`compute_solution`, but reuses caller-maintained local
     paths instead of re-running Greedy on every partition.
@@ -74,6 +75,12 @@ def compute_solution_with_paths(
     blocks are dropped and blocks ordered by id, exactly as
     :func:`~tnc_tpu.tensornetwork.partitioning.partition_tensor_network`
     does.
+
+    ``communication_path``: a caller-supplied replace-format fan-in
+    over COMPACTED block positions (blocks sorted by id after dropping
+    empties — identical to raw ids only for dense assignments, which
+    tree-cut plans guarantee) — skips the scheme. Indices are validated
+    against the compacted block count.
     """
     blocks: dict[int, list] = {}
     for t, b in zip(tensor.tensors, partitioning):
@@ -93,9 +100,20 @@ def compute_solution_with_paths(
         local_cost, _ = contract_path_cost(child.tensors, local, True)
         latency_map[idx] = local_cost
 
-    communication_path = communication_scheme.communication_path(
-        children_tensors, latency_map, rng
-    )
+    if communication_path is None:
+        communication_path = communication_scheme.communication_path(
+            children_tensors, latency_map, rng
+        )
+    else:
+        communication_path = list(communication_path)
+        k = len(children_tensors)
+        limit = k + len(communication_path)  # replace-format slot space
+        for a, b in communication_path:
+            if not (0 <= a < limit and 0 <= b < limit):
+                raise ValueError(
+                    f"communication_path index ({a}, {b}) outside the "
+                    f"compacted block space of {k} blocks"
+                )
     tensor_costs = [latency_map[i] for i in range(len(children_tensors))]
     (parallel_cost, sum_cost), _ = communication_path_op_costs(
         children_tensors, communication_path, True, tensor_costs

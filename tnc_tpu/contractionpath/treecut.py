@@ -79,6 +79,10 @@ class TreecutPlan:
     tensors in original input order (the
     :func:`~tnc_tpu.contractionpath.repartitioning.compute_solution_with_paths`
     contract).
+    ``toplevel``: the serial tree's top region as a replace-format
+    fan-in over block indices — a latency-aware communication schedule
+    by construction (pass to ``compute_solution_with_paths``'s
+    ``communication_path``).
     ``critical_estimate`` / ``serial_estimate``: the tree cost model's
     critical-path and total flops (naive op counts, same units as
     ``ContractionTree.total_cost``).
@@ -86,12 +90,38 @@ class TreecutPlan:
 
     assignment: list[int]
     local_paths: list[list[tuple[int, int]]]
+    toplevel: list[tuple[int, int]]
     critical_estimate: float
     serial_estimate: float
 
     @property
     def speedup_estimate(self) -> float:
         return self.serial_estimate / max(self.critical_estimate, 1.0)
+
+
+def _subtree_ssa(tree, top, base_of, num_bases):
+    """Post-order SSA pairs over the region below ``top``, stopping at
+    nodes present in ``base_of`` (their values are the SSA base ids);
+    returns replace-format pairs over ``num_bases`` inputs."""
+    ssa_of: dict[int, int] = {}
+    next_id = num_bases
+    ssa: list[tuple[int, int]] = []
+    stack = [(top, False)]
+    while stack:
+        i, expanded = stack.pop()
+        if i in base_of:
+            ssa_of[i] = base_of[i]
+            continue
+        nd = tree.nodes[i]
+        if expanded:
+            ssa.append((ssa_of[nd.left], ssa_of[nd.right]))
+            ssa_of[i] = next_id
+            next_id += 1
+            continue
+        stack.append((i, True))
+        stack.append((nd.right, False))
+        stack.append((nd.left, False))
+    return _to_replace(ssa, num_bases)
 
 
 def _frontier_critical(
@@ -158,7 +188,9 @@ def plan_treecut(
         # path (replace-format), both estimates the tree total
         tree = ContractionTree.from_ssa_path(inputs, ssa_pairs)
         total = tree.total_cost()[0]
-        return TreecutPlan([0] * n, [_to_replace(ssa_pairs, n)], total, total)
+        return TreecutPlan(
+            [0] * n, [_to_replace(ssa_pairs, n)], [], total, total
+        )
     if n <= k:
         # every tensor its own single-leaf block: no local steps, the
         # whole tree is fan-in
@@ -167,6 +199,7 @@ def plan_treecut(
         return TreecutPlan(
             list(range(n)),
             [[] for _ in range(n)],
+            _to_replace(ssa_pairs, n),
             max(critical, 1.0),
             max(tree.total_cost()[0], 1.0),
         )
@@ -236,25 +269,10 @@ def plan_treecut(
         top = pieces[by_block[b]]
         leaves = sorted(i for i, pp in piece_of.items() if pp == by_block[b])
         pos = {leaf: j for j, leaf in enumerate(leaves)}
-        # post-order ssa emission restricted to the subtree
-        ssa_of: dict[int, int] = {}
-        next_id = len(leaves)
-        ssa: list[tuple[int, int]] = []
-        stack2 = [(top, False)]
-        while stack2:
-            i, expanded = stack2.pop()
-            nd = tree.nodes[i]
-            if nd.is_leaf:
-                ssa_of[i] = pos[i]
-                continue
-            if expanded:
-                ssa.append((ssa_of[nd.left], ssa_of[nd.right]))
-                ssa_of[i] = next_id
-                next_id += 1
-                continue
-            stack2.append((i, True))
-            stack2.append((nd.right, False))
-            stack2.append((nd.left, False))
-        local_paths.append(_to_replace(ssa, len(leaves)))
+        local_paths.append(_subtree_ssa(tree, top, pos, len(leaves)))
 
-    return TreecutPlan(assignment, local_paths, critical, serial)
+    # the top region as a fan-in over pieces, then block indices
+    piece_block = {pieces[pi]: remap[pi] for pi in remap}
+    toplevel = _subtree_ssa(tree, tree.root, piece_block, len(remap))
+
+    return TreecutPlan(assignment, local_paths, toplevel, critical, serial)
