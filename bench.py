@@ -38,6 +38,9 @@ Env knobs:
   BENCH_PIPELINE_CALLS (32; small configs — dispatches enqueued per
     timed region, blocked once: steady-state per-eval time),
   BENCH_EXEC chunked|loop, BENCH_BATCH (8), BENCH_PROBE_SLICES (64),
+  BENCH_HOIST (1; slice-invariant stem hoisting — prelude once, residual
+    per slice), BENCH_HOIST_AB (1; probe-subset A/B hoisted vs naive
+    when the stem is non-trivial),
   BENCH_LOOP_UNROLL (1; loop strategy only — unrolled-scan slice loop),
   BENCH_FULL_SECONDS (900; run all slices if projected under this),
   BENCH_TRACE =1 to capture a profiler trace (off otherwise: the axon
@@ -393,6 +396,7 @@ def bench_sycamore_amplitude():
     precision = os.environ.get("BENCH_PRECISION") or _tuned_default(
         "precision", "float32", ("float32", "high", "default")
     )
+    hoist_on = os.environ.get("BENCH_HOIST", "1") != "0"
     backend = JaxBackend(
         dtype="complex64",
         sliced_strategy=strategy,
@@ -400,10 +404,50 @@ def bench_sycamore_amplitude():
         chunk_steps=_env_int("BENCH_CHUNK_STEPS", 48),
         precision=precision,
         loop_unroll=_env_int("BENCH_LOOP_UNROLL", 1),
+        hoist=hoist_on,
     )
     log(
         f"[bench] executor: {strategy} "
-        f"(complex_mult={complex_mult}, precision={precision})"
+        f"(complex_mult={complex_mult}, precision={precision}, "
+        f"hoist={hoist_on})"
+    )
+
+    # -- hoist flop accounting (host-only; catches hoist-pass regressions
+    # without TPU hardware). Two INDEPENDENT implementations must agree:
+    # the planner's metadata-level split (StemAccountant marks variant
+    # steps over the leg-replay) and the compiled-program split
+    # (hoist_sliced_program marks variant steps over the actual
+    # SlicedProgram; hoist_step_flops sums its dot shapes). Both count
+    # the same k*m*n per step, so a step misclassified by the hoist
+    # pass shifts cost between the two sides of exactly one of them and
+    # breaks the agreement.
+    from tnc_tpu.contractionpath.slicing import hoisted_sliced_flops
+    from tnc_tpu.ops.hoist import hoist_step_flops
+
+    inv_flops, res_flops, hoisted_total = hoisted_sliced_flops(
+        inputs, replace.toplevel, slicing
+    )
+    per_slice_flops = total_flops / max(slicing.num_slices, 1)
+    step_inv, step_res = hoist_step_flops(sp)
+    scale = max(per_slice_flops, 1.0)
+    if (
+        abs(step_inv - inv_flops) > 1e-6 * scale
+        or abs((step_inv + step_res) - per_slice_flops) > 1e-6 * scale
+        or res_flops > per_slice_flops * (1 + 1e-9)
+    ):
+        raise BenchCheckError(
+            "hoist flop accounting disagrees: compiled split "
+            f"(inv {step_inv:.6e}, res {step_res:.6e}) vs planner split "
+            f"(inv {inv_flops:.6e}, res {res_flops:.6e}, per-slice "
+            f"{per_slice_flops:.6e}) — hoist pass or StemAccountant "
+            "regressed"
+        )
+    stem_fraction = inv_flops / max(per_slice_flops, 1e-30)
+    log(
+        f"[bench] hoist stem: invariant {inv_flops:.3e} flops "
+        f"({stem_fraction:.1%} of per-slice), hoisted total "
+        f"{hoisted_total:.3e} vs naive {total_flops:.3e} "
+        f"({hoisted_total / max(total_flops, 1e-30):.3f}x)"
     )
 
     subset_npz = os.environ.get("BENCH_SUBSET_NPZ")
@@ -434,6 +478,14 @@ def bench_sycamore_amplitude():
         "num_slices": slicing.num_slices,
         "complex_mult": complex_mult,
         "precision": precision,
+        "hoist": hoist_on,
+        "invariant_flops": float(f"{inv_flops:.4e}"),
+        # residual fraction: per-slice flops the loop still pays after
+        # hoisting, as a share of the naive per-slice flops
+        "residual_flops_fraction": round(
+            res_flops / max(per_slice_flops, 1e-30), 4
+        ),
+        "hoisted_total_flops": float(f"{hoisted_total:.4e}"),
     }
     num = slicing.num_slices
 
@@ -453,6 +505,31 @@ def bench_sycamore_amplitude():
     projected = per_slice * num
     log(f"[bench] {per_slice*1000:.2f} ms/slice -> projected full {projected:.1f}s")
 
+    # -- A/B: hoisted vs naive sliced execution on the same probe subset --
+    # (cheap: probe-sized timed regions; the prelude re-runs per probe
+    # call, so the hoisted number is conservative for the full run)
+    if (
+        hoist_on
+        and inv_flops > 0
+        and slicing.num_slices > 1  # 1-slice plans bypass the slice loop
+        and os.environ.get("BENCH_HOIST_AB", "1") != "0"
+    ):
+        naive_probe_s, _ = _time_backend(
+            lambda: backend.execute_sliced(
+                sp, arrays, max_slices=probe, host=False, hoist=False
+            ),
+            reps,
+        )
+        extra["probe_s_hoisted"] = round(probe_s, 4)
+        extra["probe_s_naive"] = round(naive_probe_s, 4)
+        if probe_s > 0:
+            extra["hoist_probe_speedup"] = round(naive_probe_s / probe_s, 3)
+        log(
+            f"[bench] hoist A/B ({probe} slices): hoisted {probe_s:.3f}s "
+            f"vs naive {naive_probe_s:.3f}s "
+            f"({naive_probe_s / max(probe_s, 1e-9):.2f}x)"
+        )
+
     forced_subset = bool(_env_int("BENCH_MAX_SLICES", 0))
     full_limit = float(os.environ.get("BENCH_FULL_SECONDS", "900"))
     if not forced_subset and probe < num and projected <= full_limit:
@@ -464,6 +541,14 @@ def bench_sycamore_amplitude():
         tpu_s = projected
         if probe < num:
             extra["extrapolated_from_slices"] = probe
+            if hoist_on and inv_flops > 0:
+                # the probe pays the one-time prelude once per timed
+                # call, so linear extrapolation re-counts it num/probe
+                # times: the projected wall-clock is an UPPER bound
+                # (and the derived MFU a lower bound). Marked, not
+                # modeled away — no unmeasured subtraction enters a
+                # published number.
+                extra["projection_includes_prelude_per_probe"] = True
             log(f"[bench] extrapolated full wall-clock: {tpu_s:.1f}s")
 
     # optional profiler trace (BENCH_TRACE=1 only — on the axon tunnel
@@ -509,7 +594,10 @@ def bench_sycamore_amplitude():
         log(f"[bench] amplitude (partial sum ok): {amplitude}")
 
     # -- achieved throughput / MFU -----------------------------------------
-    achieved = total_flops / tpu_s if tpu_s > 0 else 0.0
+    # flops actually executed: hoisted runs skip the invariant stem on
+    # all but one pass, so crediting the naive total would inflate MFU
+    work_flops = hoisted_total if (hoist_on and inv_flops > 0) else total_flops
+    achieved = work_flops / tpu_s if tpu_s > 0 else 0.0
     extra["tflops"] = round(achieved / 1e12, 3)
     peak = _device_peak_flops(jax.devices()[0])
     if peak:
@@ -1693,6 +1781,10 @@ def main() -> None:
                 {"BENCH_EXEC": "chunked" if cur_exec == "loop" else "loop"},
             ),
         ]
+        if os.environ.get("BENCH_HOIST", "1") != "0":
+            # a hoist-specific compile/runtime failure shouldn't cost
+            # the hardware window: one stage retries with the naive loop
+            ladder.append(("hoist=0", {"BENCH_HOIST": "0"}))
     ladder.append(("cpu", {"BENCH_FORCE_CPU": "1"}))
 
     for stage, overrides in ladder:

@@ -35,6 +35,7 @@ TESTS=$CMULT_TESTS run_cell "cmult-gauss"  TNC_TPU_COMPLEX_MULT=gauss
 TESTS=$CMULT_TESTS run_cell "cmult-fused"  TNC_TPU_COMPLEX_MULT=fused
 TESTS=$SINGLE_TESTS run_cell "1-device" \
   XLA_FLAGS=--xla_force_host_platform_device_count=1
-TESTS=$CMULT_TESTS run_cell "8-device-naive" TNC_TPU_COMPLEX_MULT=naive
+TESTS=$CMULT_TESTS run_cell "8-device-naive" TNC_TPU_COMPLEX_MULT=naive \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 echo "MATRIX PASSED (5 cells)"

@@ -91,7 +91,17 @@ SURFACE = {
         "find_slicing",
         "find_parallel_slicing",
         "sliced_flops",
+        "hoisted_sliced_flops",
+        "StemAccountant",
         "slice_and_reconfigure",
+    ],
+    "tnc_tpu.ops.hoist": [
+        "HoistedProgram",
+        "PreludeStep",
+        "hoist_sliced_program",
+        "run_prelude",
+        "run_prelude_steps",
+        "hoist_step_flops",
     ],
     "tnc_tpu.contractionpath.treecut": [
         "TreecutPlan",

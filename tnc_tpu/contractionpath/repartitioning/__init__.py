@@ -79,8 +79,9 @@ def compute_solution_with_paths(
     ``communication_path``: a caller-supplied replace-format fan-in
     over COMPACTED block positions (blocks sorted by id after dropping
     empties — identical to raw ids only for dense assignments, which
-    tree-cut plans guarantee) — skips the scheme. Indices are validated
-    against the compacted block count.
+    tree-cut plans guarantee) — skips the scheme. The path is validated
+    fully: exactly ``k-1`` pairs forming a replace-left sequence over
+    the ``k`` compacted blocks, every referenced slot still live.
     """
     blocks: dict[int, list] = {}
     for t, b in zip(tensor.tensors, partitioning):
@@ -107,13 +108,35 @@ def compute_solution_with_paths(
     else:
         communication_path = list(communication_path)
         k = len(children_tensors)
-        limit = k + len(communication_path)  # replace-format slot space
+        # full replace-left validation: the fan-in must contract k blocks
+        # down to one, so it is exactly k-1 pairs over live compacted
+        # block positions (the result replaces slot ``a``; slot ``b`` is
+        # consumed). Bounds checks alone let a stale plan reference a
+        # consumed slot and silently contract garbage.
+        if len(communication_path) != k - 1:
+            raise ValueError(
+                f"communication_path has {len(communication_path)} pairs; "
+                f"a fan-in over {k} compacted blocks needs exactly {k - 1}"
+            )
+        live = set(range(k))
         for a, b in communication_path:
-            if not (0 <= a < limit and 0 <= b < limit):
+            if not (0 <= a < k and 0 <= b < k):
                 raise ValueError(
                     f"communication_path index ({a}, {b}) outside the "
                     f"compacted block space of {k} blocks"
                 )
+            if a == b:
+                raise ValueError(
+                    f"communication_path pair ({a}, {b}) contracts a slot "
+                    "with itself"
+                )
+            if a not in live or b not in live:
+                dead = a if a not in live else b
+                raise ValueError(
+                    f"communication_path pair ({a}, {b}) references slot "
+                    f"{dead}, already consumed by an earlier pair"
+                )
+            live.discard(b)
     tensor_costs = [latency_map[i] for i in range(len(children_tensors))]
     (parallel_cost, sum_cost), _ = communication_path_op_costs(
         children_tensors, communication_path, True, tensor_costs

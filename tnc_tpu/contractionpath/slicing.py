@@ -13,6 +13,14 @@ axis).
 The slice-leg selection is the standard greedy heuristic (as used by
 cotengra's SliceFinder): repeatedly slice the leg that most reduces the
 predicted peak intermediate size, until the peak fits the target.
+
+Cost model: the executors hoist the slice-invariant stem — steps whose
+operands depend on no sliced leg run once, not once per slice
+(:mod:`tnc_tpu.ops.hoist`) — so candidate slice sets are scored by
+``invariant_flops + num_slices * residual_flops`` rather than
+``num_slices * total_flops`` (:class:`StemAccountant`,
+:func:`hoisted_sliced_flops`). Leg selection therefore actively prefers
+slicings that keep a large hoistable stem.
 """
 
 from __future__ import annotations
@@ -109,6 +117,104 @@ def _replay_sizes(
                     leg_peak[leg] = step
         tensors[i] = out
     return peak, leg_peak
+
+
+class StemAccountant:
+    """Hoist-aware flop accounting for candidate slice sets.
+
+    One full-dims replay of the path precomputes, per step, its naive op
+    cost and the set of legs contributed by the leaves in its subtree.
+    A step is *variant* under a removal set R iff its contributed-leg
+    set intersects R (a value computed from a sliced leaf stays
+    per-slice even after the sliced leg is contracted away); invariant
+    steps never touch a removed leg, so their cost is independent of R.
+    ``invariant_flops(R)`` is then an O(steps) mask-and-sum per query —
+    cheap enough for the planner's per-candidate scoring loops, on top
+    of the (native) replayer's total-flops query.
+    """
+
+    def __init__(
+        self,
+        inputs: Sequence[LeafTensor],
+        replace_path: Sequence[tuple[int, int]],
+    ):
+        import numpy as np
+
+        tensors = [t.copy() for t in inputs]
+        contrib: list[frozenset[int]] = [
+            frozenset(t.legs) for t in inputs
+        ]
+        costs: list[float] = []
+        step_legs: list[frozenset[int]] = []
+        for i, j in replace_path:
+            costs.append((tensors[i] | tensors[j]).size())
+            merged = contrib[i] | contrib[j]
+            step_legs.append(merged)
+            tensors[i] = tensors[i] ^ tensors[j]
+            contrib[i] = merged
+        self._costs = np.asarray(costs, dtype=np.float64)
+        self.total_flops = float(self._costs.sum())
+        n = len(costs)
+        self._leg_steps: dict[int, "np.ndarray"] = {}
+        for idx, legs in enumerate(step_legs):
+            for leg in legs:
+                mask = self._leg_steps.get(leg)
+                if mask is None:
+                    mask = np.zeros(n, dtype=bool)
+                    self._leg_steps[leg] = mask
+                mask[idx] = True
+
+    def invariant_flops(self, removed) -> float:
+        """Flops of the steps that stay slice-invariant with ``removed``
+        legs sliced — paid once under hoisted execution."""
+        import numpy as np
+
+        variant = None
+        for leg in removed:
+            mask = self._leg_steps.get(leg)
+            if mask is None:
+                continue
+            variant = mask.copy() if variant is None else (variant | mask)
+        if variant is None:
+            return self.total_flops
+        return float(self._costs[~variant].sum())
+
+    def hoisted_cost(
+        self, removed, per_slice_flops: float, num_slices: int
+    ) -> float:
+        """``invariant + num_slices * residual`` given the replayer's
+        per-slice total ``per_slice_flops`` for the same removal set."""
+        inv = self.invariant_flops(removed)
+        residual = max(per_slice_flops - inv, 0.0)
+        return inv + float(num_slices) * residual
+
+
+def hoisted_sliced_flops(
+    inputs: Sequence[LeafTensor],
+    replace_path: Sequence[tuple[int, int]],
+    slicing: Slicing,
+) -> tuple[float, float, float]:
+    """(invariant_flops, per-slice residual_flops, hoisted total cost)
+    of a sliced path under stem-hoisting execution. The naive executor
+    pays ``num_slices * (invariant + residual)`` =
+    :func:`sliced_flops`; the hoisted one ``invariant + num_slices *
+    residual``.
+
+    >>> from tnc_tpu.tensornetwork.tensor import LeafTensor
+    >>> ts = [LeafTensor.from_const([0, 1], 4), LeafTensor.from_const([1, 2], 4),
+    ...       LeafTensor.from_const([2, 3], 4), LeafTensor.from_const([3, 0], 4)]
+    >>> path = [(0, 3), (0, 1), (0, 2)]   # (0, 3) touches no sliced leg
+    >>> s = Slicing((2,), (4,))
+    >>> inv, res, total = hoisted_sliced_flops(ts, path, s)
+    >>> inv > 0 and total < sliced_flops(ts, path, s)
+    True
+    """
+    removed = set(slicing.legs)
+    acct = StemAccountant(inputs, replace_path)
+    inv = acct.invariant_flops(removed)
+    per_slice = _make_replayer(inputs, replace_path).flops(removed)
+    residual = max(per_slice - inv, 0.0)
+    return inv, residual, inv + slicing.num_slices * residual
 
 
 def find_slicing(
@@ -255,6 +361,7 @@ def find_parallel_slicing(
         )
 
     replayer = _make_replayer(inputs, replace_path)
+    acct: StemAccountant | None = None  # built lazily (first extra leg)
 
     def count(legs: set[int]) -> int:
         n = 1
@@ -275,11 +382,19 @@ def find_parallel_slicing(
         ]
         if not candidates:
             return None
-        # minimize total sliced flops after adding the leg
+        # minimize total sliced flops under hoisted execution
+        # (invariant stem paid once, residual per slice) after adding
+        # the leg
+        if acct is None:
+            acct = StemAccountant(inputs, replace_path)
         best = min(
             candidates,
             key=lambda leg: (
-                replayer.flops(removed | {leg}) * count(removed | {leg}),
+                acct.hoisted_cost(
+                    removed | {leg},
+                    replayer.flops(removed | {leg}),
+                    count(removed | {leg}),
+                ),
                 leg,
             ),
         )
@@ -385,11 +500,23 @@ def slice_and_reconfigure(
                 f"No sliceable legs left but peak {peak:.3e} > "
                 f"target {target_size:.3e}"
             )
+        # score candidates by (post-slice peak, hoisted total cost):
+        # the executors run the slice-invariant stem once, so a trial's
+        # flops component is invariant + num_slices * residual, which
+        # prefers legs that keep a large hoistable stem over legs that
+        # drag the whole program into the per-slice loop
+        acct = StemAccountant(inputs, replace)
         best_leg = -1
         best_key: tuple[float, float] | None = None
         for leg in candidates[:max_leg_candidates]:
             trial = removed | {leg}
-            key = replayer.peak_and_flops(trial)
+            trial_peak, trial_flops = replayer.peak_and_flops(trial)
+            key = (
+                trial_peak,
+                acct.hoisted_cost(
+                    trial, trial_flops, num_slices * dims[leg]
+                ),
+            )
             if best_key is None or key < best_key:
                 best_key = key
                 best_leg = leg

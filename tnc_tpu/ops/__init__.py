@@ -5,3 +5,7 @@ from tnc_tpu.ops.backends import (  # noqa: F401
     NumpyBackend,
     get_backend,
 )
+from tnc_tpu.ops.hoist import (  # noqa: F401
+    HoistedProgram,
+    hoist_sliced_program,
+)

@@ -39,7 +39,12 @@ class Backend:
         raise NotImplementedError
 
     def execute_sliced(
-        self, sp, arrays: Sequence[Any], max_slices: int | None = None, host: bool = True
+        self,
+        sp,
+        arrays: Sequence[Any],
+        max_slices: int | None = None,
+        host: bool = True,
+        hoist: bool | None = None,
     ):
         raise NotImplementedError
 
@@ -282,16 +287,23 @@ class NumpyBackend(Backend):
         return np.asarray(out).reshape(program.result_shape)
 
     def execute_sliced(
-        self, sp, arrays: Sequence[Any], max_slices: int | None = None, host: bool = True
+        self,
+        sp,
+        arrays: Sequence[Any],
+        max_slices: int | None = None,
+        host: bool = True,
+        hoist: bool | None = None,
     ) -> np.ndarray:
         """``host=False`` mirrors the device backends' contract as far
         as it applies here (data is already host-resident): the result
         comes back in **stored** (merged) shape instead of
-        ``result_shape``."""
+        ``result_shape``. ``hoist`` defaults to off — the naive loop
+        is the oracle the hoisted executors are tested against."""
         from tnc_tpu.ops.sliced import execute_sliced_numpy
 
         out = execute_sliced_numpy(
-            sp, arrays, dtype=self.dtype, max_slices=max_slices
+            sp, arrays, dtype=self.dtype, max_slices=max_slices,
+            hoist=bool(hoist),
         )
         if not host:
             return out.reshape(sp.program.stored_result_shape)
@@ -337,6 +349,7 @@ class JaxBackend(Backend):
         slice_batch: int = 8,
         chunk_steps: int = 64,
         loop_unroll: int = 1,
+        hoist: bool = True,
     ):
         """``sliced_strategy``: 'chunked' (default) splits the program
         into slice-batched chunks (K small compiles, batched matmuls,
@@ -346,7 +359,13 @@ class JaxBackend(Backend):
         the straight-line chunked code runs the same steps ~150× faster
         than the while-loop body — XLA pessimizes loop bodies — so
         'loop' is only worth it when dispatch latency dominates (very
-        small per-slice programs)."""
+        small per-slice programs).
+
+        ``hoist`` (default True): execute the slice-invariant stem once
+        per call and loop only the residual program (see
+        :mod:`tnc_tpu.ops.hoist`); degrades to the naive loop when every
+        step depends on a sliced leg. Per-call overrides via
+        ``execute_sliced(..., hoist=...)``."""
         import jax
 
         self._jax = jax
@@ -364,6 +383,7 @@ class JaxBackend(Backend):
         self.slice_batch = slice_batch
         self.chunk_steps = chunk_steps
         self.loop_unroll = loop_unroll
+        self.hoist = hoist
         self._cache: dict[tuple, Any] = {}
 
     def _compiled(self, program: ContractionProgram):
@@ -386,17 +406,26 @@ class JaxBackend(Backend):
         return self._compiled(program)(buffers)
 
     def execute_sliced(
-        self, sp, arrays: Sequence[Any], max_slices: int | None = None, host: bool = True
+        self,
+        sp,
+        arrays: Sequence[Any],
+        max_slices: int | None = None,
+        host: bool = True,
+        hoist: bool | None = None,
     ):
         """Run a sliced program; the slice loop executes on device.
         ``max_slices`` caps the loop (partial sum — benchmark subsets).
         ``host=False`` keeps the result on device in stored shape (a
         (real, imag) pair in split mode) — no device→host transfer, the
         benchmark-timing contract (tunneled backends degrade dispatch
-        permanently after the first D2H; see TPU_EVIDENCE_r03.md)."""
+        permanently after the first D2H; see TPU_EVIDENCE_r03.md).
+        ``hoist`` overrides the backend default (slice-invariant stem
+        executed once, residual looped — :mod:`tnc_tpu.ops.hoist`)."""
 
         from tnc_tpu.ops.sliced import make_jax_sliced_fn
 
+        if hoist is None:
+            hoist = self.hoist
         if sp.slicing.num_slices == 1:
             if not host:  # device-resident, stored shape — no D2H
                 return self.execute_on_device(sp.program, arrays)
@@ -416,6 +445,7 @@ class JaxBackend(Backend):
                 device=self.device,
                 max_slices=max_slices,
                 host=host,
+                hoist=hoist,
             )
 
         from tnc_tpu.ops.split_complex import complex_mult_env
@@ -427,6 +457,7 @@ class JaxBackend(Backend):
             self.split_complex,
             max_slices,
             self.loop_unroll,
+            hoist,
             lanemix_env(),
             complex_mult_env() if self.split_complex else None,
         )
@@ -438,6 +469,7 @@ class JaxBackend(Backend):
                 precision=self.precision,
                 num_slices=max_slices,
                 unroll=self.loop_unroll,
+                hoist=hoist,
             )
             self._cache[key] = fn
         buffers = self._device_buffers(arrays)

@@ -125,6 +125,62 @@ _PLAN_CACHE: "OrderedDict[tuple, tuple]" = OrderedDict()
 _PLAN_CACHE_MAX = 64
 _PLAN_CACHE_LOCK = threading.Lock()
 
+# jitted prelude executables (slice-invariant stem, run once per
+# execution before the chunked slice loop), cached like the plans
+_PRELUDE_CACHE: "OrderedDict[tuple, Any]" = OrderedDict()
+_PRELUDE_CACHE_MAX = 64
+
+
+def _prelude_fn(hp, split_complex: bool, precision):
+    """jitted ``fn(prelude_input_buffers) -> cached outputs`` for a
+    :class:`~tnc_tpu.ops.hoist.HoistedProgram` — one dispatch computes
+    every invariant intermediate the residual program reads."""
+    import jax
+    import jax.numpy as jnp
+
+    from tnc_tpu.ops.backends import lanemix_env
+    from tnc_tpu.ops.split_complex import complex_mult_env
+
+    key = (
+        hp.signature(),
+        split_complex,
+        precision,
+        lanemix_env(),
+        complex_mult_env() if split_complex else None,
+    )
+    with _PLAN_CACHE_LOCK:
+        fn = _PRELUDE_CACHE.get(key)
+        if fn is not None:
+            _PRELUDE_CACHE.move_to_end(key)
+            return fn
+
+    from tnc_tpu.ops.hoist import run_prelude_steps
+
+    def run(pins):
+        return tuple(
+            run_prelude_steps(jnp, hp, pins, split_complex, precision)
+        )
+
+    fn = jax.jit(run)
+    with _PLAN_CACHE_LOCK:
+        _PRELUDE_CACHE[key] = fn
+        while len(_PRELUDE_CACHE) > _PRELUDE_CACHE_MAX:
+            _PRELUDE_CACHE.popitem(last=False)
+    return fn
+
+
+def _hoisted_inputs(hp, device_full, split_complex: bool, precision):
+    """Run the prelude on device (one jitted dispatch) and assemble the
+    residual program's input buffer list from pass-through leaves and
+    the freshly cached intermediates."""
+    pins = tuple(device_full[orig] for _, orig in hp.prelude_inputs)
+    cached = _prelude_fn(hp, split_complex, precision)(pins)
+    out = []
+    it = iter(cached)
+    for kind, ref in hp.residual_sources:
+        out.append(device_full[ref] if kind == "leaf" else next(it))
+    return out
+
 
 def _compiled_plan(
     sp: SlicedProgram,
@@ -303,6 +359,7 @@ def execute_sliced_batched_jax(
     enforce_budget: bool = True,
     max_slices: int | None = None,
     host: bool = True,
+    hoist: bool = False,
 ):
     """Run a sliced program as chunked, slice-batched jitted calls.
 
@@ -336,6 +393,7 @@ def execute_sliced_batched_jax(
         device=device,
         enforce_budget=enforce_budget,
         max_slices=max_slices,
+        hoist=hoist,
     )
     if not host:
         return acc
@@ -357,14 +415,41 @@ def run_sliced_chunked_placed(
     device=None,
     enforce_budget: bool = True,
     max_slices: int | None = None,
+    hoist: bool = False,
 ):
     """Chunked slice-batched execution over already-placed device
     buffers; returns the device-resident accumulator in stored shape
     (a (real, imag) pair in split mode). The distributed local phase
     uses this directly — each partition's buffers are committed to its
     own device, so every dispatch follows the data (one chunked runner
-    per device, running concurrently under async dispatch)."""
+    per device, running concurrently under async dispatch).
+
+    ``hoist=True`` computes the slice-invariant stem once (one extra
+    jitted dispatch, outputs stay device-resident) and runs the chunked
+    slice loop over the residual program only."""
     import jax.numpy as jnp
+
+    if hoist:
+        from tnc_tpu.ops.hoist import hoist_sliced_program
+
+        hp = hoist_sliced_program(sp)
+        if not hp.is_noop:
+            res_inputs = _hoisted_inputs(
+                hp, list(device_full), split_complex, precision
+            )
+            return run_sliced_chunked_placed(
+                hp.residual,
+                res_inputs,
+                batch=batch,
+                chunk_steps=chunk_steps,
+                split_complex=split_complex,
+                precision=precision,
+                dtype=dtype,
+                device=device,
+                enforce_budget=enforce_budget,
+                max_slices=max_slices,
+                hoist=False,
+            )
 
     num = sp.slicing.num_slices
     if num <= 1:

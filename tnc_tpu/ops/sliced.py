@@ -10,6 +10,17 @@ TPU mapping: all slices share one compiled program; the JAX backend runs
 the *entire* slice loop on device as a ``lax.fori_loop`` whose body
 indexes the (resident-in-HBM) full inputs, runs the contraction steps,
 and accumulates — no host round-trips between slices.
+
+Slice-invariant stem hoisting (``hoist=True``): steps whose operands
+depend on no sliced leg are bit-identical across slices. The hoist pass
+(:mod:`tnc_tpu.ops.hoist`) splits the program into an invariant
+**prelude** executed once and a per-slice **residual** program whose
+extra input slots are the prelude's cached intermediates; on device the
+prelude runs before the ``fori_loop``/``scan`` and its outputs stay
+resident in HBM as loop constants. Execution cost drops from
+``num_slices * total_flops`` to ``invariant_flops + num_slices *
+residual_flops``; the slicing planner scores candidate slice sets with
+the same formula (:mod:`tnc_tpu.contractionpath.slicing`).
 """
 
 from __future__ import annotations
@@ -143,13 +154,24 @@ def execute_sliced_numpy(
     arrays: Sequence[np.ndarray],
     dtype=np.complex128,
     max_slices: int | None = None,
+    hoist: bool = False,
 ) -> np.ndarray:
     """CPU oracle: python loop over slices, sum of program results.
 
     ``max_slices`` caps the loop (partial sum) — used by benchmark
-    baselines that extrapolate from a slice subset.
+    baselines that extrapolate from a slice subset. ``hoist=True``
+    computes the slice-invariant stem once and loops only the residual
+    program (numerically identical — the same step kernels run in the
+    same order, just not once per slice).
     """
     full = [np.asarray(a, dtype=dtype) for a in arrays]
+    if hoist:
+        from tnc_tpu.ops.hoist import hoist_sliced_program, run_prelude
+
+        hp = hoist_sliced_program(sp)
+        if not hp.is_noop:
+            full = run_prelude(np, hp, full)
+            sp = hp.residual
     acc = np.zeros(sp.program.stored_result_shape, dtype=dtype)
     num = sp.slicing.num_slices
     if max_slices is not None:
@@ -193,6 +215,7 @@ def sliced_partials_numpy(
     dtype=np.complex128,
     slice_ids: Sequence[int] | None = None,
     workers: int | None = None,
+    hoist: bool = False,
 ) -> np.ndarray:
     """Per-slice CPU-oracle results, stacked ``(n,) + result_shape``.
 
@@ -203,7 +226,9 @@ def sliced_partials_numpy(
     runs serially. Returning *per-slice* results (not the sum) lets the
     benchmark cache the oracle on disk and serve any prefix-sum parity
     sample later without redoing minutes-per-slice numpy work
-    (VERDICT r3 weak #3)."""
+    (VERDICT r3 weak #3). ``hoist=True`` runs the invariant stem once
+    in this process and ships only the residual program (plus cached
+    intermediates) to the pool workers."""
     import concurrent.futures
     import multiprocessing
     import pickle
@@ -215,6 +240,13 @@ def sliced_partials_numpy(
         else list(range(sp.slicing.num_slices))
     )
     full = [np.asarray(a, dtype=dtype) for a in arrays]
+    if hoist:
+        from tnc_tpu.ops.hoist import hoist_sliced_program, run_prelude
+
+        hp = hoist_sliced_program(sp)
+        if not hp.is_noop:
+            full = [np.asarray(a) for a in run_prelude(np, hp, full)]
+            sp = hp.residual
     if workers is None:
         workers = min(os.cpu_count() or 1, len(ids))
     parts: list[np.ndarray] | None = None
@@ -248,6 +280,7 @@ def execute_sliced_numpy_parallel(
     dtype=np.complex128,
     max_slices: int | None = None,
     workers: int | None = None,
+    hoist: bool = False,
 ) -> np.ndarray:
     """Sum of :func:`sliced_partials_numpy` over the first ``max_slices``
     slices — the process-parallel analogue of
@@ -256,7 +289,8 @@ def execute_sliced_numpy_parallel(
     if max_slices is not None:
         num = max(1, min(num, max_slices))
     parts = sliced_partials_numpy(
-        sp, arrays, dtype=dtype, slice_ids=range(num), workers=workers
+        sp, arrays, dtype=dtype, slice_ids=range(num), workers=workers,
+        hoist=hoist,
     )
     return np.sum(parts, axis=0, dtype=dtype)
 
@@ -267,6 +301,7 @@ def make_jax_sliced_fn(
     precision: str | None = None,
     num_slices: int | None = None,
     unroll: int = 1,
+    hoist: bool = False,
 ):
     """Build a jittable ``fn(full_buffers) -> result`` running the whole
     slice loop on device. In split mode, buffers and result are
@@ -279,10 +314,24 @@ def make_jax_sliced_fn(
     step groups instead — zero host dispatches per slice, chunked-class
     code inside the loop (scan handles any ``num % unroll`` remainder
     natively). Compile time grows with the unroll factor.
+
+    ``hoist=True`` traces the slice-invariant prelude *before* the loop
+    (:mod:`tnc_tpu.ops.hoist`): its outputs become loop constants — XLA
+    keeps them resident in HBM — and only the residual steps run per
+    iteration.
     """
     import jax
     import jax.numpy as jnp
     from jax import lax
+
+    hp = None
+    if hoist:
+        from tnc_tpu.ops.hoist import hoist_sliced_program
+
+        cand = hoist_sliced_program(sp)
+        if not cand.is_noop:
+            hp = cand
+    loop_sp = hp.residual if hp is not None else sp
 
     dims = sp.slicing.dims
     num = sp.slicing.num_slices
@@ -301,16 +350,16 @@ def make_jax_sliced_fn(
     if split_complex:
         from tnc_tpu.ops.split_complex import run_steps_split
 
-        def one_slice(full_buffers, s):
+        def one_slice(loop_buffers, s):
             indices = decompose(s)
             buffers = [
                 (
                     index_buffer(jnp, re, info, indices),
                     index_buffer(jnp, im, info, indices),
                 )
-                for (re, im), info in zip(full_buffers, sp.slot_slices)
+                for (re, im), info in zip(loop_buffers, loop_sp.slot_slices)
             ]
-            return run_steps_split(jnp, sp.program, buffers, precision)
+            return run_steps_split(jnp, loop_sp.program, buffers, precision)
 
         def add(acc, contrib):
             (sr, cr), (si, ci) = acc
@@ -332,12 +381,12 @@ def make_jax_sliced_fn(
 
     else:
 
-        def one_slice(full_buffers, s):
+        def one_slice(loop_buffers, s):
             buffers = [
                 index_buffer(jnp, arr, info, decompose(s))
-                for arr, info in zip(full_buffers, sp.slot_slices)
+                for arr, info in zip(loop_buffers, loop_sp.slot_slices)
             ]
-            return _run_steps(jnp, sp.program, list(buffers))
+            return _run_steps(jnp, loop_sp.program, list(buffers))
 
         def add(acc, contrib):
             return kahan_add(acc[0], acc[1], contrib)
@@ -353,19 +402,33 @@ def make_jax_sliced_fn(
         def finish(acc):
             return acc[0] + acc[1]
 
+    def prepare(full_buffers):
+        """Original buffers → loop buffers (prelude traced pre-loop)."""
+        if hp is None:
+            return full_buffers
+        from tnc_tpu.ops.hoist import run_prelude
+
+        return run_prelude(
+            jnp, hp, list(full_buffers), split_complex, precision
+        )
+
     if unroll <= 1:
 
         def fn(full_buffers):
+            loop_buffers = prepare(full_buffers)
+
             def body(s, acc):
-                return add(acc, one_slice(full_buffers, s))
+                return add(acc, one_slice(loop_buffers, s))
 
             return finish(lax.fori_loop(0, num, body, zeros(full_buffers)))
 
     else:
 
         def fn(full_buffers):
+            loop_buffers = prepare(full_buffers)
+
             def body(acc, s):
-                return add(acc, one_slice(full_buffers, s)), None
+                return add(acc, one_slice(loop_buffers, s)), None
 
             acc, _ = lax.scan(
                 body, zeros(full_buffers), jnp.arange(num), unroll=unroll

@@ -282,11 +282,14 @@ def local_contract_partitions(
     dtype: str = "complex64",
     slice_batch: int = 8,
     chunk_steps: int = 64,
+    hoist: bool = False,
 ) -> list[Any]:
     """Dispatch every partition's compiled program to its device. Async
     dispatch → all devices run concurrently (the per-rank local phase).
     ``max_slices`` caps sliced partitions' loops (benchmark subset mode —
     the partial sums are NOT the correct partition tensors).
+    ``hoist=True`` runs each sliced partition's slice-invariant stem
+    once before its slice loop (:mod:`tnc_tpu.ops.hoist`).
 
     Sliced partitions run through the chunked executor by default (the
     on-device ``fori_loop`` is ~150× slower on real TPUs,
@@ -326,6 +329,7 @@ def local_contract_partitions(
                         dtype=dtype,
                         device=_dev,
                         max_slices=max_slices,
+                        hoist=hoist,
                     )
 
                 return run
@@ -334,6 +338,7 @@ def local_contract_partitions(
                 split_complex=split_complex,
                 precision=precision,
                 num_slices=max_slices,
+                hoist=hoist,
             )
         return jit_program(program, split_complex, precision)
 
@@ -395,6 +400,7 @@ def distributed_partitioned_contraction(
     local_sliced_strategy: str = "chunked",
     slice_batch: int = 8,
     chunk_steps: int = 64,
+    hoist: bool = False,
 ) -> LeafTensor:
     """Contract a partitioned network with one partition per device.
 
@@ -407,7 +413,8 @@ def distributed_partitioned_contraction(
     ``local_sliced_strategy``/``slice_batch``/``chunk_steps`` select the
     executor for those locally sliced partitions ('chunked' — the fast
     path on real TPUs — or 'loop', one dispatch per partition, fine on
-    virtual CPU meshes).
+    virtual CPU meshes); ``hoist=True`` additionally runs each sliced
+    partition's slice-invariant stem once (:mod:`tnc_tpu.ops.hoist`).
     """
     import jax
 
@@ -434,6 +441,7 @@ def distributed_partitioned_contraction(
         dtype=dtype,
         slice_batch=slice_batch,
         chunk_steps=chunk_steps,
+        hoist=hoist,
     )
     final, meta = intermediate_reduce(
         comm, contract_path.toplevel, results, split_complex, precision
