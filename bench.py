@@ -53,7 +53,13 @@ Env knobs:
   BENCH_STAGE_TIMEOUT (1500 + 2*BENCH_FULL_SECONDS; per retry stage),
   BENCH_SA_SECONDS (60) / BENCH_SA_ROUNDS (partitioned configs; SA budget),
   BENCH_PARTITIONS (8) / BENCH_HBM_BYTES (16 GiB; config-5 modeled
-    per-device budget — part of the partitioning-ratchet cache key)
+    per-device budget — part of the partitioning-ratchet cache key),
+  BENCH_OBS (1; tnc_tpu.obs span/metric recording — the per-phase
+    "phases" breakdown in the JSON record and the Chrome-trace export;
+    0 disables both),
+  BENCH_TRACE_JSON (bench_trace.json next to this file; where the
+    Chrome-trace/Perfetto timeline of the run is written — load it in
+    ui.perfetto.dev; docs/observability.md)
 
 Executor/precision/target defaults may also come from the hardware-
 promoted marker .cache/best_config.json (see _tuned_default); env wins.
@@ -207,18 +213,27 @@ def _time_backend(run, reps):
     blocks on readiness WITHOUT a device→host transfer: on tunneled
     backends the first D2H permanently degrades dispatch ~400×
     (TPU_EVIDENCE_r03.md), so every timed region must stay on device.
+
+    Every region is also recorded as an obs span (``bench.warmup`` /
+    ``bench.timed_run`` — the span INCLUDES the readiness block, so the
+    exported timeline covers the real device wall time, not just the
+    async dispatch).
     """
     import jax
 
+    from tnc_tpu import obs
+
     t0 = time.monotonic()
-    out = run()
-    jax.block_until_ready(out)
+    with obs.span("bench.warmup"):
+        out = run()
+        jax.block_until_ready(out)
     log(f"[bench] warmup (incl. compile): {time.monotonic() - t0:.2f}s")
     times = []
     for _ in range(reps):
         t0 = time.monotonic()
-        out = run()
-        jax.block_until_ready(out)
+        with obs.span("bench.timed_run"):
+            out = run()
+            jax.block_until_ready(out)
         times.append(time.monotonic() - t0)
     log(f"[bench] runs: {[round(t, 4) for t in times]}")
     return float(np.median(times)), out
@@ -234,18 +249,22 @@ def _time_pipelined(bound, reps, calls=None):
     such timed regions; returns (per_eval_s, calls, last_out)."""
     import jax
 
+    from tnc_tpu import obs
+
     if calls is None:
         calls = _env_int("BENCH_PIPELINE_CALLS", 32)
     t0 = time.monotonic()
-    out = bound()
-    jax.block_until_ready(out)
+    with obs.span("bench.warmup"):
+        out = bound()
+        jax.block_until_ready(out)
     log(f"[bench] warmup (incl. compile): {time.monotonic() - t0:.2f}s")
     times = []
     for _ in range(reps):
         t0 = time.monotonic()
-        for _ in range(calls):
-            out = bound()
-        jax.block_until_ready(out)
+        with obs.span("bench.timed_run", pipeline_calls=calls):
+            for _ in range(calls):
+                out = bound()
+            jax.block_until_ready(out)
         times.append((time.monotonic() - t0) / calls)
     log(f"[bench] pipelined per-eval (x{calls}): "
         f"{[round(t * 1e3, 4) for t in times]} ms")
@@ -256,11 +275,14 @@ def _time_numpy(run, reps):
     """CPU-oracle counterpart of :func:`_time_pipelined`: same
     steady-state contract (arrays already in memory, repeated
     evaluation), median per-eval over ``reps`` regions."""
+    from tnc_tpu import obs
+
     run()  # warmup: allocator + BLAS thread pools
     times = []
     for _ in range(reps):
         t0 = time.monotonic()
-        run()
+        with obs.span("bench.cpu_baseline"):
+            run()
         times.append(time.monotonic() - t0)
     return float(np.median(times))
 
@@ -355,7 +377,10 @@ def bench_sycamore_amplitude():
         cache.store_obj(key, (path_flops, path_size, replace_pairs, slicing))
         log(f"[bench] plan cached as {key}")
 
-    sp = build_sliced_program(tn, replace, slicing)
+    from tnc_tpu import obs
+
+    with obs.span("bench.build_program", slices=slicing.num_slices):
+        sp = build_sliced_program(tn, replace, slicing)
     arrays = [leaf.data.into_data() for leaf in flat_leaf_tensors(tn)]
 
     if os.environ.get("BENCH_PREWARM") == "1":
@@ -497,10 +522,13 @@ def bench_sycamore_amplitude():
     probe = _env_int("BENCH_MAX_SLICES", 0) or _env_int("BENCH_PROBE_SLICES", 64)
     probe = max(1, min(probe, num))
     log(f"[bench] probe: timing {probe}/{num} slices")
-    probe_s, amp = _time_backend(
-        lambda: backend.execute_sliced(sp, arrays, max_slices=probe, host=False),
-        reps,
-    )
+    with obs.span("bench.probe", slices=probe):
+        probe_s, amp = _time_backend(
+            lambda: backend.execute_sliced(
+                sp, arrays, max_slices=probe, host=False
+            ),
+            reps,
+        )
     per_slice = probe_s / probe
     projected = per_slice * num
     log(f"[bench] {per_slice*1000:.2f} ms/slice -> projected full {projected:.1f}s")
@@ -514,12 +542,13 @@ def bench_sycamore_amplitude():
         and slicing.num_slices > 1  # 1-slice plans bypass the slice loop
         and os.environ.get("BENCH_HOIST_AB", "1") != "0"
     ):
-        naive_probe_s, _ = _time_backend(
-            lambda: backend.execute_sliced(
-                sp, arrays, max_slices=probe, host=False, hoist=False
-            ),
-            reps,
-        )
+        with obs.span("bench.hoist_ab_naive", slices=probe):
+            naive_probe_s, _ = _time_backend(
+                lambda: backend.execute_sliced(
+                    sp, arrays, max_slices=probe, host=False, hoist=False
+                ),
+                reps,
+            )
         extra["probe_s_hoisted"] = round(probe_s, 4)
         extra["probe_s_naive"] = round(naive_probe_s, 4)
         if probe_s > 0:
@@ -534,9 +563,10 @@ def bench_sycamore_amplitude():
     full_limit = float(os.environ.get("BENCH_FULL_SECONDS", "900"))
     if not forced_subset and probe < num and projected <= full_limit:
         # cheap enough: run and time ALL slices (the honest number)
-        tpu_s, amp = _time_backend(
-            lambda: backend.execute_sliced(sp, arrays, host=False), reps
-        )
+        with obs.span("bench.full_run", slices=num):
+            tpu_s, amp = _time_backend(
+                lambda: backend.execute_sliced(sp, arrays, host=False), reps
+            )
     else:
         tpu_s = projected
         if probe < num:
@@ -587,10 +617,13 @@ def bench_sycamore_amplitude():
     else:
         # CPU path (or explicit BENCH_INLINE_FETCH=1): fetch and run the
         # subset in-process, the pre-r4 behavior.
-        amplitude = complex(_fetch_device_result(backend, amp).reshape(-1)[0])
-        got_partial = np.asarray(
-            backend.execute_sliced(sp, arrays, max_slices=n_sub)
-        ).astype(np.complex128)
+        with obs.span("bench.parity_fetch", slices=n_sub):
+            amplitude = complex(
+                _fetch_device_result(backend, amp).reshape(-1)[0]
+            )
+            got_partial = np.asarray(
+                backend.execute_sliced(sp, arrays, max_slices=n_sub)
+            ).astype(np.complex128)
         log(f"[bench] amplitude (partial sum ok): {amplitude}")
 
     # -- achieved throughput / MFU -----------------------------------------
@@ -629,14 +662,15 @@ def bench_sycamore_amplitude():
     # is minutes/slice of deterministic host numpy, so its per-slice
     # results and the serial baseline timing are cached keyed by the
     # plan (BENCH_PREWARM=1 computes them tunnel-independently).
-    oracle = _oracle_artifact(
-        cache, key, sp, arrays,
-        # parity-skipped stages still need the serial CPU baseline for
-        # vs_baseline, but must not pay minutes-per-slice of complex128
-        # numpy for per-slice oracle results nothing will compare
-        0 if parity_skip_reason is not None else n_sub,
-        max(1, min(cpu_slices, slicing.num_slices)),
-    )
+    with obs.span("bench.oracle", parity_slices=n_sub):
+        oracle = _oracle_artifact(
+            cache, key, sp, arrays,
+            # parity-skipped stages still need the serial CPU baseline for
+            # vs_baseline, but must not pay minutes-per-slice of complex128
+            # numpy for per-slice oracle results nothing will compare
+            0 if parity_skip_reason is not None else n_sub,
+            max(1, min(cpu_slices, slicing.num_slices)),
+        )
     if parity_skip_reason is None:
         want_partial = np.sum(
             oracle["per_slice"][:n_sub], axis=0, dtype=np.complex128
@@ -1623,10 +1657,19 @@ def _enable_compile_cache() -> None:
 def _run_config(config: str) -> dict:
     import jax
 
+    from tnc_tpu import obs
+
     _enable_compile_cache()
     device = jax.devices()[0]
     log(f"[bench] device: {device.platform} ({device.device_kind})")
-    out = CONFIGS[config]()
+    # bench always records spans/metrics (BENCH_OBS=0 opts out): the
+    # per-phase breakdown and the Perfetto timeline replace the old
+    # ad-hoc perf_counter bookkeeping. A fresh registry per config run
+    # keeps the breakdown attributable to THIS run.
+    if os.environ.get("BENCH_OBS", "1") != "0":
+        obs.configure(enabled=True, registry=obs.MetricsRegistry())
+    with obs.span("bench.config", config=config):
+        out = CONFIGS[config]()
     metric, tpu_s, vs_baseline = out[0], out[1], out[2]
     extra = out[3] if len(out) > 3 else {}
     record = {
@@ -1641,7 +1684,56 @@ def _run_config(config: str) -> dict:
         "device": f"{device.platform}:{device.device_kind}",
     }
     record.update(extra)
+    if obs.enabled():
+        _attach_obs_breakdown(record, obs)
     return record
+
+
+def _attach_obs_breakdown(record: dict, obs) -> None:
+    """Per-phase wall-time breakdown (from the obs registry, the reads
+    that replaced the old ad-hoc timing) + the Chrome-trace export.
+    Best-effort: a reporting failure must never break the run."""
+    try:
+        # span depth is per-thread (worker-thread spans start at 0), so
+        # pin the breakdown to the coordinating thread — the one that
+        # ran the bench.config wrapper — or phase totals would double-
+        # count the per-partition worker spans nested under them
+        cfg = [
+            r for r in obs.get_registry().span_records()
+            if r.name == "bench.config"
+        ]
+        stats = obs.get_registry().span_stats(
+            max_depth=1, tid=cfg[-1].tid if cfg else None
+        )
+        phases = {
+            name: round(s["total_s"], 4)
+            for name, s in sorted(stats.items())
+            if name != "bench.config"
+        }
+        if phases:
+            record["phases"] = phases
+        counters = obs.get_registry().snapshot()["counters"]
+        for key in ("jit_cache.hit", "jit_cache.miss"):
+            if key in counters:
+                record.setdefault("jit_cache", {})[
+                    key.split(".")[1]
+                ] = int(counters[key])
+        trace_out = (
+            os.environ.get("BENCH_TRACE_JSON")
+            or obs.trace_path()
+            or os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "bench_trace.json",
+            )
+        )
+        obs.export_chrome_trace(trace_out)
+        record["trace_path"] = trace_out
+        rows = obs.trace_summary(obs.load_trace_events(trace_out))
+        log("[bench] per-stage trace summary "
+            f"(full timeline: {trace_out}, load in ui.perfetto.dev):")
+        log(obs.format_summary_table(rows))
+    except Exception as e:  # noqa: BLE001 — observability is best-effort
+        log(f"[bench] obs breakdown unavailable: {type(e).__name__}: {e}")
 
 
 def main() -> None:

@@ -21,6 +21,15 @@ python scripts/coverage_gate.py tests/ -q
 echo "== configuration matrix (cargo-hack analogue) =="
 bash scripts/matrix.sh
 
+echo "== trace tooling (obs export -> summarize round trip) =="
+TNC_TPU_TRACE=1 TNC_TPU_PLATFORM=cpu python - <<'PY'
+import tnc_tpu.obs as obs
+with obs.span("check.smoke") as sp:
+    sp.add(flops=1)
+obs.export_chrome_trace("/tmp/tnc_tpu_check_trace.json")
+PY
+python scripts/trace_summarize.py /tmp/tnc_tpu_check_trace.json > /dev/null
+
 echo "== examples =="
 # TNC_TPU_PLATFORM pins JAX to CPU via jax.config (env vars alone can be
 # overridden by interpreter startup hooks that pre-wire an accelerator);

@@ -26,6 +26,19 @@ if REPO not in sys.path:  # running as `python scripts/coverage_gate.py`
 
 TOOL = sys.monitoring.COVERAGE_ID
 
+# Subpackages the report must include (guards against a package being
+# silently dropped from the walk — e.g. the obs tracing layer, whose
+# disabled path is exactly the kind of code a gate would never notice
+# missing):
+REQUIRED_SUBPACKAGES = (
+    "benchmark",
+    "contractionpath",
+    "obs",
+    "ops",
+    "parallel",
+    "tensornetwork",
+)
+
 executed: set[tuple[str, int]] = set()
 
 
@@ -99,6 +112,17 @@ def main() -> int:
                     f"\nmissing in {os.path.relpath(path, REPO)}: "
                     f"{sorted(lines - hit)}"
                 )
+
+    seen_pkgs = {rel.split(os.sep)[1] for rel, _, _ in per_file
+                 if len(rel.split(os.sep)) > 2}
+    missing_pkgs = [p for p in REQUIRED_SUBPACKAGES if p not in seen_pkgs]
+    if missing_pkgs:
+        print(
+            f"coverage gate: subpackages missing from the report: "
+            f"{missing_pkgs}",
+            file=sys.stderr,
+        )
+        return 1
 
     pct = 100.0 * total_hit / total_exec if total_exec else 100.0
     print(f"\ncoverage: {total_hit}/{total_exec} lines = {pct:.1f}% "

@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""Per-stage time/flops table from an exported Chrome trace.
+
+Reads a Chrome-trace JSON produced by ``tnc_tpu.obs.export_chrome_trace``
+(``bench.py`` writes one per run — ``BENCH_TRACE_JSON``; any app sets
+``TNC_TPU_TRACE=<path>.json`` for an atexit export) and prints one row
+per span name: call count, total wall time, time share, and the summed
+span counters (flops, slices, dispatches, ...).
+
+Usage:
+    python scripts/trace_summarize.py bench_trace.json
+    python scripts/trace_summarize.py --top 10 bench_trace.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Per-stage summary of a tnc_tpu Chrome trace"
+    )
+    parser.add_argument("trace", help="Chrome-trace JSON file")
+    parser.add_argument(
+        "--top", type=int, default=0,
+        help="show only the N most expensive stages (default: all)",
+    )
+    args = parser.parse_args(argv)
+
+    from tnc_tpu.obs.export import (
+        format_summary_table,
+        load_trace_events,
+        trace_summary,
+    )
+
+    rows = trace_summary(load_trace_events(args.trace))
+    if not rows:
+        print("no spans in trace", file=sys.stderr)
+        return 1
+    if args.top > 0:
+        rows = rows[: args.top]
+    print(format_summary_table(rows))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -4,6 +4,14 @@ Mirror of the reference's flexi_logger setup — JSON records, a log file
 discriminated per MPI rank, Info+ duplicated to stderr
 (``benchmark/src/utils.rs:12-24``). Here the discriminant is the jax
 process index (multi-host) or the PID.
+
+Contract (matching ``utils/logging_config.py``): :func:`setup_logging`
+is **idempotent and additive** — calling it twice attaches nothing
+twice, and handlers the application installed on the ``tnc_tpu`` logger
+are left alone (records keep flowing to them). :class:`JsonFormatter`
+serializes ``extra=`` structured fields, so metric records emitted by
+:func:`tnc_tpu.obs.emit_metrics` land in the JSONL sink with their
+payload intact.
 """
 
 from __future__ import annotations
@@ -14,6 +22,13 @@ import os
 import sys
 from pathlib import Path
 
+# Attributes every LogRecord carries (plus formatter-injected ones);
+# anything else on a record came in via ``extra=`` and belongs in the
+# JSON payload.
+_RESERVED = set(
+    logging.LogRecord("", 0, "", 0, "", (), None).__dict__
+) | {"message", "asctime", "taskName"}
+
 
 class JsonFormatter(logging.Formatter):
     def format(self, record: logging.LogRecord) -> str:
@@ -23,22 +38,39 @@ class JsonFormatter(logging.Formatter):
             "logger": record.name,
             "msg": record.getMessage(),
         }
+        for key, value in record.__dict__.items():
+            if key in _RESERVED or key.startswith("_") or key in payload:
+                continue
+            payload[key] = value
         if record.exc_info:
             payload["exc"] = self.formatException(record.exc_info)
-        return json.dumps(payload)
+        return json.dumps(payload, default=str)
 
 
 def setup_logging(log_dir: str | Path | None = None, level=logging.INFO) -> None:
     """Configure the ``tnc_tpu`` logger tree: JSON file per process plus
-    human-readable stderr."""
+    human-readable stderr. Idempotent (re-runs replace only the handlers
+    this function installed) and additive (application handlers stay)."""
     root = logging.getLogger("tnc_tpu")
     root.setLevel(level)
-    root.handlers.clear()
+    # replace only LIBRARY-installed handlers: this function's own
+    # (_tnc_tpu_bench) and the TNC_TPU_LOG import-time stderr handler
+    # (_tnc_tpu_env, utils/logging_config.py) — the latter would
+    # duplicate every record on stderr next to the one installed below.
+    # Application handlers are left alone.
+    for handler in [
+        h for h in root.handlers
+        if getattr(h, "_tnc_tpu_bench", False)
+        or getattr(h, "_tnc_tpu_env", False)
+    ]:
+        root.removeHandler(handler)
+        handler.close()
 
     stream = logging.StreamHandler(sys.stderr)
     stream.setFormatter(
         logging.Formatter("[%(asctime)s %(levelname)s %(name)s] %(message)s")
     )
+    stream._tnc_tpu_bench = True  # type: ignore[attr-defined]
     root.addHandler(stream)
 
     if log_dir is not None:
@@ -52,4 +84,5 @@ def setup_logging(log_dir: str | Path | None = None, level=logging.INFO) -> None
         path.mkdir(parents=True, exist_ok=True)
         fh = logging.FileHandler(path / f"benchmark_{discriminant}.jsonl")
         fh.setFormatter(JsonFormatter())
+        fh._tnc_tpu_bench = True  # type: ignore[attr-defined]
         root.addHandler(fh)

@@ -28,6 +28,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Sequence
 
+from tnc_tpu import obs
 from tnc_tpu.contractionpath.contraction_path import ContractionPath
 from tnc_tpu.tensornetwork.tensor import LeafTensor
 
@@ -217,6 +218,7 @@ def hoisted_sliced_flops(
     return inv, residual, inv + slicing.num_slices * residual
 
 
+@obs.traced("plan.find_slicing")
 def find_slicing(
     inputs: Sequence[LeafTensor],
     replace_path: Sequence[tuple[int, int]],
@@ -308,6 +310,7 @@ def sliced_peak(
     return _make_replayer(inputs, replace_path).peak(set(slicing.legs))
 
 
+@obs.traced("plan.find_parallel_slicing")
 def find_parallel_slicing(
     inputs: Sequence[LeafTensor],
     replace_path: Sequence[tuple[int, int]],
@@ -412,6 +415,7 @@ def flat_replace_path(path_: ContractionPath) -> list[tuple[int, int]]:
     return list(path_.toplevel)
 
 
+@obs.traced("plan.slice_and_reconfigure")
 def slice_and_reconfigure(
     inputs: Sequence[LeafTensor],
     ssa_path: Sequence[tuple[int, int]],

@@ -1,0 +1,36 @@
+"""tnc_tpu.obs — env-gated pipeline tracing + metrics.
+
+``TNC_TPU_TRACE`` gates everything: unset → every API here is a
+near-zero-cost no-op; ``1`` → spans/counters record in-process;
+``TNC_TPU_TRACE=<path>.json`` → additionally auto-export a
+Chrome-trace/Perfetto timeline at interpreter exit. See
+``docs/observability.md``.
+"""
+
+from tnc_tpu.obs.core import (  # noqa: F401
+    MetricsRegistry,
+    NULL_SPAN,
+    Span,
+    SpanRecord,
+    configure,
+    counter_add,
+    enabled,
+    gauge_set,
+    get_registry,
+    maybe_jax_profiler_trace,
+    observe,
+    refresh_from_env,
+    reset,
+    span,
+    trace_path,
+    traced,
+)
+from tnc_tpu.obs.export import (  # noqa: F401
+    chrome_trace_events,
+    emit_metrics,
+    export_chrome_trace,
+    export_jsonl,
+    format_summary_table,
+    load_trace_events,
+    trace_summary,
+)

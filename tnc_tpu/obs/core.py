@@ -1,0 +1,498 @@
+"""Env-gated tracing + metrics core: spans, counters, gauges, histograms.
+
+The reference logs structured records at every pipeline stage (compile,
+partition, scatter, contract, fan-in — ``benchmark/src/utils.rs``,
+``mpi/communication.rs:132``); this module is the reproduction's
+equivalent answer to "where did the time/flops/bytes go", designed for
+the TPU pipeline:
+
+- :func:`span` — a context manager recording wall time, nesting depth,
+  process and thread id, and attached counters for one pipeline stage
+  (``with obs.span("compile", steps=254): ...``). Completed spans land
+  in the process-local :class:`MetricsRegistry` and export as a
+  Chrome-trace/Perfetto timeline (:mod:`tnc_tpu.obs.export`).
+- :func:`counter_add` / :func:`gauge_set` / :func:`observe` — named
+  metrics with optional labels, aggregated in the same registry.
+
+Everything is **disabled unless ``TNC_TPU_TRACE`` is set** (or
+:func:`configure` is called): the disabled fast path is one module-level
+bool check and returns a shared no-op span, so instrumented executors
+pay nothing measurable in production runs (pinned by
+``tests/test_obs.py::test_disabled_span_overhead``).
+
+``TNC_TPU_TRACE`` values: unset/``0`` → off; ``1``/``true`` → record
+in-process; any other value → record *and* auto-export a Chrome-trace
+JSON to that path at interpreter exit. ``TNC_TPU_TRACE_JAX=<dir>``
+additionally wraps the distributed executors in ``jax.profiler.trace``
+(:func:`maybe_jax_profiler_trace`).
+
+>>> import tnc_tpu.obs as obs
+>>> _ = obs.configure(enabled=True, registry=MetricsRegistry())
+>>> with obs.span("compile", steps=3) as sp:
+...     _ = sp.add(flops=100)
+...     with obs.span("execute"):
+...         pass
+>>> recs = obs.get_registry().span_records()
+>>> [(r.name, r.depth) for r in recs]
+[('execute', 1), ('compile', 0)]
+>>> obs.get_registry().counters()[('compile.flops', ())]
+100.0
+>>> _ = obs.configure(enabled=False)
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+_TRUTHY = ("1", "true", "yes", "on")
+
+# Cap on retained span records: a runaway per-slice loop must not grow
+# memory without bound; past the cap, spans are counted but dropped.
+_MAX_SPANS_DEFAULT = 200_000
+
+
+@dataclass(frozen=True)
+class SpanRecord:
+    """One completed (or still-open at export time) span."""
+
+    name: str
+    start_ns: int  # relative to the registry epoch
+    dur_ns: int
+    pid: int
+    tid: int
+    thread_name: str
+    depth: int
+    args: dict = field(default_factory=dict)
+
+
+class MetricsRegistry:
+    """Process-local metric + span store. Thread-safe; one module-level
+    instance serves the whole process (:func:`get_registry`), tests may
+    swap in a fresh one via :func:`configure`.
+
+    >>> reg = MetricsRegistry()
+    >>> reg.counter_add("slices", 4)
+    >>> reg.counter_add("slices", 2)
+    >>> reg.counter_add("cache", 1, kind="hit")
+    >>> reg.counters()[("slices", ())]
+    6.0
+    >>> reg.gauge_set("hbm_peak_bytes", 2.0**29)
+    >>> reg.observe("step_ms", 1.5); reg.observe("step_ms", 2.5)
+    >>> h = reg.histograms()[("step_ms", ())]
+    >>> (h["count"], h["sum"], h["min"], h["max"])
+    (2, 4.0, 1.5, 2.5)
+    """
+
+    def __init__(self, max_spans: int | None = None) -> None:
+        self._lock = threading.Lock()
+        self._counters: dict[tuple, float] = {}
+        self._gauges: dict[tuple, float] = {}
+        self._hists: dict[tuple, dict] = {}
+        self._spans: list[SpanRecord] = []
+        self._active: dict[int, "Span"] = {}
+        self._dropped = 0
+        if max_spans is None:
+            max_spans = int(
+                os.environ.get("TNC_TPU_TRACE_MAX_SPANS", _MAX_SPANS_DEFAULT)
+            )
+        self._max_spans = max_spans
+        self.epoch_ns = time.perf_counter_ns()
+
+    # -- metrics ---------------------------------------------------------
+    @staticmethod
+    def _key(name: str, labels: dict) -> tuple:
+        return (name, tuple(sorted(labels.items())))
+
+    def counter_add(self, name: str, value: float = 1.0, **labels) -> None:
+        key = self._key(name, labels)
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0.0) + float(value)
+
+    def gauge_set(self, name: str, value: float, **labels) -> None:
+        with self._lock:
+            self._gauges[self._key(name, labels)] = float(value)
+
+    def observe(self, name: str, value: float, **labels) -> None:
+        key = self._key(name, labels)
+        value = float(value)
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                h = {"count": 0, "sum": 0.0, "min": value, "max": value}
+                self._hists[key] = h
+            h["count"] += 1
+            h["sum"] += value
+            h["min"] = min(h["min"], value)
+            h["max"] = max(h["max"], value)
+
+    def counters(self) -> dict[tuple, float]:
+        with self._lock:
+            return dict(self._counters)
+
+    def gauges(self) -> dict[tuple, float]:
+        with self._lock:
+            return dict(self._gauges)
+
+    def histograms(self) -> dict[tuple, dict]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._hists.items()}
+
+    # -- spans -----------------------------------------------------------
+    def _span_opened(self, sp: "Span") -> None:
+        with self._lock:
+            self._active[id(sp)] = sp
+
+    def _span_closed(self, sp: "Span", rec: SpanRecord) -> None:
+        with self._lock:
+            self._active.pop(id(sp), None)
+            if len(self._spans) >= self._max_spans:
+                self._dropped += 1
+                return
+            self._spans.append(rec)
+
+    def span_records(self, include_open: bool = False) -> list[SpanRecord]:
+        """Completed spans (chronological by end time). With
+        ``include_open``, still-running spans are appended with their
+        duration measured up to now — so a whole-run wrapper span shows
+        up in a trace exported from inside it."""
+        now = time.perf_counter_ns()
+        with self._lock:
+            recs = list(self._spans)
+            if include_open:
+                recs.extend(sp._record(now) for sp in self._active.values())
+        return recs
+
+    def dropped_spans(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def span_stats(
+        self, max_depth: int | None = None, tid: int | None = None
+    ) -> dict[str, dict]:
+        """Aggregate wall time per span name: ``{name: {count, total_s,
+        min_s, max_s}}``. ``max_depth`` keeps only spans at or above a
+        nesting level (``0`` = top-level phases only), so a per-phase
+        breakdown does not double-count nested child spans. Depth is
+        **per thread** (a worker-thread span starts at 0), so breakdowns
+        over multi-threaded runs should also pin ``tid`` to the
+        coordinating thread."""
+        out: dict[str, dict] = {}
+        for rec in self.span_records():
+            if max_depth is not None and rec.depth > max_depth:
+                continue
+            if tid is not None and rec.tid != tid:
+                continue
+            s = out.get(rec.name)
+            dur = rec.dur_ns / 1e9
+            if s is None:
+                out[rec.name] = {
+                    "count": 1, "total_s": dur, "min_s": dur, "max_s": dur
+                }
+            else:
+                s["count"] += 1
+                s["total_s"] += dur
+                s["min_s"] = min(s["min_s"], dur)
+                s["max_s"] = max(s["max_s"], dur)
+        return out
+
+    def snapshot(self) -> dict:
+        """Plain-data snapshot of every metric (JSON-ready; labels as
+        ``name{k=v}`` strings)."""
+
+        def fmt(key: tuple) -> str:
+            name, labels = key
+            if not labels:
+                return name
+            inner = ",".join(f"{k}={v}" for k, v in labels)
+            return f"{name}{{{inner}}}"
+
+        return {
+            "counters": {fmt(k): v for k, v in self.counters().items()},
+            "gauges": {fmt(k): v for k, v in self.gauges().items()},
+            "histograms": {fmt(k): v for k, v in self.histograms().items()},
+            "dropped_spans": self.dropped_spans(),
+        }
+
+
+class _NullSpan:
+    """Shared no-op span: the whole disabled-path cost of ``with
+    obs.span(...)`` is returning this singleton."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        return False
+
+    def set(self, **args: Any) -> "_NullSpan":
+        return self
+
+    def add(self, **counters: Any) -> "_NullSpan":
+        return self
+
+
+NULL_SPAN = _NullSpan()
+
+_TLS = threading.local()
+
+
+def _stack() -> list:
+    st = getattr(_TLS, "stack", None)
+    if st is None:
+        st = _TLS.stack = []
+    return st
+
+
+class Span:
+    """A live span. Use via :func:`span`; not constructed directly."""
+
+    __slots__ = ("name", "args", "_reg", "_start_ns", "_depth", "_tid",
+                 "_tname")
+
+    def __init__(self, name: str, registry: MetricsRegistry, args: dict):
+        self.name = name
+        self.args = args
+        self._reg = registry
+
+    def set(self, **args: Any) -> "Span":
+        """Attach/overwrite span attributes (shown in the trace)."""
+        self.args.update(args)
+        return self
+
+    def add(self, **counters: Any) -> "Span":
+        """Accumulate numeric counters onto the span *and* the registry
+        (as ``<span name>.<counter>``): flops, bytes moved, slices
+        executed, cache hits, modeled HBM peaks..."""
+        for key, value in counters.items():
+            self.args[key] = self.args.get(key, 0) + value
+            self._reg.counter_add(f"{self.name}.{key}", value)
+        return self
+
+    def __enter__(self) -> "Span":
+        st = _stack()
+        self._depth = len(st)
+        st.append(self)
+        th = threading.current_thread()
+        self._tid = th.ident or 0
+        self._tname = th.name
+        self._reg._span_opened(self)
+        self._start_ns = time.perf_counter_ns()
+        return self
+
+    def _record(self, end_ns: int) -> SpanRecord:
+        return SpanRecord(
+            name=self.name,
+            start_ns=self._start_ns - self._reg.epoch_ns,
+            dur_ns=max(end_ns - self._start_ns, 0),
+            pid=os.getpid(),
+            tid=self._tid,
+            thread_name=self._tname,
+            depth=self._depth,
+            args=dict(self.args),
+        )
+
+    def __exit__(self, *exc: Any) -> bool:
+        end_ns = time.perf_counter_ns()
+        st = _stack()
+        if st and st[-1] is self:
+            st.pop()
+        elif self in st:  # out-of-order exit: drop up to this span
+            del st[st.index(self):]
+        self._reg._span_closed(self, self._record(end_ns))
+        return False
+
+
+# -- module-level state + API ------------------------------------------
+
+_ENABLED = False
+_TRACE_PATH: str | None = None
+_REGISTRY = MetricsRegistry()
+_ATEXIT_REGISTERED = False
+
+
+def enabled() -> bool:
+    """Is recording on? The one check every instrumented call site pays."""
+    return _ENABLED
+
+
+def get_registry() -> MetricsRegistry:
+    return _REGISTRY
+
+
+def trace_path() -> str | None:
+    """Chrome-trace auto-export path (from ``TNC_TPU_TRACE=<path>`` or
+    ``configure(trace_path=...)``), or None."""
+    return _TRACE_PATH
+
+
+def configure(
+    enabled: bool | None = None,
+    trace_path: str | None = None,
+    registry: MetricsRegistry | None = None,
+) -> MetricsRegistry:
+    """Programmatic override of the env gate (bench/tests). Returns the
+    active registry. ``trace_path`` arms the atexit Chrome-trace export."""
+    global _ENABLED, _TRACE_PATH, _REGISTRY
+    if registry is not None:
+        _REGISTRY = registry
+    if enabled is not None:
+        _ENABLED = bool(enabled)
+    if trace_path is not None:
+        _TRACE_PATH = trace_path
+        _register_atexit()
+    return _REGISTRY
+
+
+def reset() -> MetricsRegistry:
+    """Swap in a fresh registry (keeps the enabled flag). For tests and
+    for benchmarks that want a clean per-phase breakdown."""
+    return configure(registry=MetricsRegistry())
+
+
+def refresh_from_env() -> bool:
+    """Re-read ``TNC_TPU_TRACE`` (import-time default; call after
+    changing the env mid-process). Returns the new enabled state."""
+    global _ENABLED, _TRACE_PATH
+    raw = os.environ.get("TNC_TPU_TRACE", "").strip()
+    if not raw or raw == "0" or raw.lower() in ("false", "off", "no"):
+        _ENABLED = False
+        return False
+    _ENABLED = True
+    if raw.lower() not in _TRUTHY:
+        _TRACE_PATH = raw
+        _register_atexit()
+    return True
+
+
+def _register_atexit() -> None:
+    global _ATEXIT_REGISTERED
+    if _ATEXIT_REGISTERED:
+        return
+    _ATEXIT_REGISTERED = True
+    import atexit
+
+    def _dump() -> None:
+        if _TRACE_PATH and (_REGISTRY.span_records() or _REGISTRY.counters()):
+            from tnc_tpu.obs.export import export_chrome_trace
+
+            try:
+                export_chrome_trace(_TRACE_PATH, _REGISTRY)
+            except OSError:  # pragma: no cover - unwritable path at exit
+                pass
+
+    atexit.register(_dump)
+
+
+def span(name: str, **args: Any):
+    """Open a span for one pipeline stage. No-op singleton when disabled.
+
+    Keyword arguments become span attributes; use :meth:`Span.add` for
+    counters that should also aggregate process-wide."""
+    if not _ENABLED:
+        return NULL_SPAN
+    return Span(name, _REGISTRY, args)
+
+
+def traced(name: str, **static_args: Any):
+    """Decorator form of :func:`span` for whole-function stages (the
+    planning entry points). Disabled path: one bool check.
+
+    >>> @traced("plan.demo", kind="test")
+    ... def plan():
+    ...     return 7
+    >>> plan()   # disabled by default: plain call
+    7
+    """
+
+    def deco(fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args: Any, **kwargs: Any):
+            if not _ENABLED:
+                return fn(*args, **kwargs)
+            with Span(name, _REGISTRY, dict(static_args)):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def counter_add(name: str, value: float = 1.0, **labels) -> None:
+    if _ENABLED:
+        _REGISTRY.counter_add(name, value, **labels)
+
+
+def gauge_set(name: str, value: float, **labels) -> None:
+    if _ENABLED:
+        _REGISTRY.gauge_set(name, value, **labels)
+
+
+def observe(name: str, value: float, **labels) -> None:
+    if _ENABLED:
+        _REGISTRY.observe(name, value, **labels)
+
+
+_JAX_TRACE_ACTIVE = False
+
+
+class _JaxTraceCtx:
+    """Context manager wrapping ``jax.profiler.trace`` when
+    ``TNC_TPU_TRACE_JAX=<dir>`` is set; identity otherwise. Never nests
+    (the profiler raises on reentry) and degrades to a no-op if the
+    backend's profiler is unavailable (tunneled backends wedge —
+    TPU_EVIDENCE_r04.md)."""
+
+    __slots__ = ("_ctx",)
+
+    def __enter__(self):
+        global _JAX_TRACE_ACTIVE
+        self._ctx = None
+        trace_dir = os.environ.get("TNC_TPU_TRACE_JAX")
+        if not trace_dir or _JAX_TRACE_ACTIVE:
+            return self
+        try:
+            import jax
+
+            self._ctx = jax.profiler.trace(trace_dir)
+            self._ctx.__enter__()
+            _JAX_TRACE_ACTIVE = True
+        except Exception:  # noqa: BLE001 - profiler support is optional
+            self._ctx = None
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        global _JAX_TRACE_ACTIVE
+        if self._ctx is not None:
+            _JAX_TRACE_ACTIVE = False
+            try:
+                self._ctx.__exit__(*exc)
+            except Exception:  # noqa: BLE001 - see __enter__
+                pass
+        return False
+
+
+def maybe_jax_profiler_trace() -> _JaxTraceCtx:
+    """The one knob for device-level profiling of the distributed
+    executors: a context manager that activates ``jax.profiler.trace``
+    into ``$TNC_TPU_TRACE_JAX`` when that env var names a directory and
+    is a transparent no-op otherwise.
+
+    >>> import os
+    >>> os.environ.pop("TNC_TPU_TRACE_JAX", None) and None
+    >>> with maybe_jax_profiler_trace():  # unset: pure no-op, no jax import
+    ...     x = 1
+    >>> x
+    1
+    """
+    return _JaxTraceCtx()
+
+
+refresh_from_env()

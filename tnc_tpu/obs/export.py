@@ -1,0 +1,207 @@
+"""Exporters for the obs registry: Chrome-trace/Perfetto JSON, JSONL,
+the benchmark JSON log sink, and the per-stage summary table.
+
+The Chrome trace format is the least-common-denominator timeline schema
+(``ui.perfetto.dev`` and ``chrome://tracing`` both load it): a
+``traceEvents`` list where every slice is a balanced ``B``/``E`` pair
+carrying ``name``/``ts``/``pid``/``tid`` (timestamps in microseconds).
+One exported file renders the whole pipeline — planning, partitioning,
+slicing, hoisted prelude vs per-slice residual, chunked dispatches, SPMD
+shard phases, fan-in — as one timeline.
+
+>>> import tnc_tpu.obs as obs
+>>> from tnc_tpu.obs.core import MetricsRegistry
+>>> reg = obs.configure(enabled=True, registry=MetricsRegistry())
+>>> with obs.span("sliced.prelude") as sp:
+...     _ = sp.add(flops=64)
+>>> events = chrome_trace_events(reg)
+>>> [e["ph"] for e in events if e["name"] == "sliced.prelude"]
+['B', 'E']
+>>> rows = trace_summary(events)
+>>> rows[0]["name"], rows[0]["count"], rows[0]["flops"]
+('sliced.prelude', 1, 64.0)
+>>> _ = obs.configure(enabled=False)
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, Iterable
+
+from tnc_tpu.obs.core import MetricsRegistry, get_registry
+
+
+def chrome_trace_events(
+    registry: MetricsRegistry | None = None,
+    include_open: bool = True,
+) -> list[dict]:
+    """Registry spans → Chrome-trace event dicts (``B``/``E`` pairs plus
+    process/thread ``M`` metadata), sorted by timestamp."""
+    reg = registry if registry is not None else get_registry()
+    events: list[dict] = []
+    threads: dict[tuple[int, int], str] = {}
+    for rec in reg.span_records(include_open=include_open):
+        threads.setdefault((rec.pid, rec.tid), rec.thread_name)
+        ts = rec.start_ns / 1e3  # Chrome trace timestamps are in µs
+        common = {"name": rec.name, "cat": rec.name.split(".", 1)[0],
+                  "pid": rec.pid, "tid": rec.tid}
+        args = {k: _jsonable(v) for k, v in rec.args.items()}
+        args["depth"] = rec.depth
+        events.append({**common, "ph": "B", "ts": ts, "args": args})
+        events.append({**common, "ph": "E", "ts": ts + rec.dur_ns / 1e3})
+    # B before E at equal ts (zero-duration spans) keeps pairs balanced
+    events.sort(key=lambda e: (e["ts"], 0 if e["ph"] != "E" else 1))
+    meta = [
+        {"name": "thread_name", "ph": "M", "ts": 0.0, "pid": pid, "tid": tid,
+         "args": {"name": tname}}
+        for (pid, tid), tname in sorted(threads.items())
+    ]
+    return meta + events
+
+
+def _jsonable(v: Any) -> Any:
+    return v if isinstance(v, (str, int, float, bool, type(None))) else str(v)
+
+
+def export_chrome_trace(
+    path: str, registry: MetricsRegistry | None = None
+) -> str:
+    """Write the registry as a Chrome-trace JSON file loadable in
+    ``ui.perfetto.dev``; counters/gauges ride along under ``otherData``.
+    Returns ``path``."""
+    reg = registry if registry is not None else get_registry()
+    doc = {
+        "traceEvents": chrome_trace_events(reg),
+        "displayTimeUnit": "ms",
+        "otherData": reg.snapshot(),
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh)
+    return path
+
+
+def export_jsonl(path: str, registry: MetricsRegistry | None = None) -> str:
+    """Write every span and metric as one JSON object per line (the
+    flexi_logger-style record stream; round-trips through
+    ``json.loads`` per line). Returns ``path``."""
+    reg = registry if registry is not None else get_registry()
+    with open(path, "w", encoding="utf-8") as fh:
+        for rec in reg.span_records():
+            fh.write(json.dumps({
+                "type": "span", "name": rec.name,
+                "start_s": rec.start_ns / 1e9, "dur_s": rec.dur_ns / 1e9,
+                "pid": rec.pid, "tid": rec.tid, "depth": rec.depth,
+                "args": {k: _jsonable(v) for k, v in rec.args.items()},
+            }) + "\n")
+        snap = reg.snapshot()
+        for kind in ("counters", "gauges"):
+            for name, value in snap[kind].items():
+                fh.write(json.dumps(
+                    {"type": kind[:-1], "name": name, "value": value}
+                ) + "\n")
+        for name, h in snap["histograms"].items():
+            fh.write(json.dumps(
+                {"type": "histogram", "name": name, **h}
+            ) + "\n")
+    return path
+
+
+def emit_metrics(
+    logger: logging.Logger | None = None,
+    registry: MetricsRegistry | None = None,
+) -> int:
+    """Log every metric as a structured record through the std logging
+    tree, so :class:`tnc_tpu.benchmark.logging_util.JsonFormatter` (which
+    serializes ``extra=`` fields) lands them in the per-process JSONL
+    sink. Returns the number of records emitted."""
+    reg = registry if registry is not None else get_registry()
+    lg = logger if logger is not None else logging.getLogger("tnc_tpu.obs")
+    n = 0
+    snap = reg.snapshot()
+    for kind in ("counters", "gauges"):
+        for name, value in snap[kind].items():
+            lg.info(
+                "metric", extra={"metric_type": kind[:-1], "metric": name,
+                                 "value": value},
+            )
+            n += 1
+    for name, h in snap["histograms"].items():
+        lg.info(
+            "metric", extra={"metric_type": "histogram", "metric": name, **h},
+        )
+        n += 1
+    for name, stats in reg.span_stats().items():
+        lg.info(
+            "metric", extra={"metric_type": "span", "metric": name, **stats},
+        )
+        n += 1
+    return n
+
+
+def load_trace_events(path: str) -> list[dict]:
+    """Read back a Chrome-trace JSON (either the ``{"traceEvents": []}``
+    object or a bare event array)."""
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    return doc["traceEvents"] if isinstance(doc, dict) else doc
+
+
+def trace_summary(events: Iterable[dict]) -> list[dict]:
+    """Per-stage aggregate over Chrome-trace events: for every span name,
+    the call count, total wall time, and the summed numeric counters the
+    spans carried (flops, bytes, slices, ...). Rows are sorted by total
+    time, descending. Only top-level occurrences of a name are summed
+    when the same name nests inside itself."""
+    open_spans: dict[tuple[int, int], list[tuple[str, float, dict]]] = {}
+    agg: dict[str, dict] = {}
+    for ev in events:
+        ph = ev.get("ph")
+        if ph not in ("B", "E"):
+            continue
+        key = (ev.get("pid", 0), ev.get("tid", 0))
+        stack = open_spans.setdefault(key, [])
+        if ph == "B":
+            stack.append((ev["name"], ev["ts"], ev.get("args", {})))
+            continue
+        if not stack or stack[-1][0] != ev["name"]:  # unbalanced: skip
+            continue
+        name, ts0, args = stack.pop()
+        if any(frame[0] == name for frame in stack):
+            continue  # self-nested: the outer occurrence will count it
+        row = agg.setdefault(
+            name, {"name": name, "count": 0, "total_ms": 0.0}
+        )
+        row["count"] += 1
+        row["total_ms"] += (ev["ts"] - ts0) / 1e3
+        for k, v in args.items():
+            if k != "depth" and isinstance(v, (int, float)):
+                row[k] = row.get(k, 0.0) + float(v)
+    return sorted(agg.values(), key=lambda r: -r["total_ms"])
+
+
+def format_summary_table(rows: list[dict]) -> str:
+    """Render :func:`trace_summary` rows as an aligned text table with a
+    time-share column (used by ``scripts/trace_summarize.py`` and the
+    bench driver's stderr report)."""
+    total = sum(r["total_ms"] for r in rows) or 1.0
+    extra_cols: list[str] = []
+    for r in rows:
+        for k in r:
+            if k not in ("name", "count", "total_ms") and k not in extra_cols:
+                extra_cols.append(k)
+    head = (
+        f"{'stage':<36} {'count':>7} {'total_ms':>12} {'share':>7}"
+        + "".join(f" {c:>12}" for c in extra_cols)
+    )
+    lines = [head, "-" * len(head)]
+    for r in rows:
+        line = (
+            f"{r['name']:<36} {r['count']:>7} {r['total_ms']:>12.2f} "
+            f"{r['total_ms'] / total:>6.1%}"
+        )
+        for c in extra_cols:
+            v = r.get(c)
+            line += f" {v:>12.3g}" if isinstance(v, (int, float)) else " " * 13
+        lines.append(line)
+    return "\n".join(lines)

@@ -27,6 +27,7 @@ from typing import Any, Sequence
 
 import numpy as np
 
+from tnc_tpu import obs
 from tnc_tpu.ops.program import ContractionProgram
 
 logger = logging.getLogger(__name__)
@@ -200,6 +201,7 @@ def jit_program(
         fn = _PROGRAM_JIT_CACHE.get(key)
         if fn is not None:
             _PROGRAM_JIT_CACHE.move_to_end(key)
+    obs.counter_add("jit_cache.hit" if fn is not None else "jit_cache.miss")
     if fn is None:
         logger.debug(
             "jit: tracing program (%d steps, split_complex=%s)",
@@ -227,6 +229,8 @@ def jit_program(
             ]
             run = jax.vmap(run, in_axes=(axes,))
         jitted = jax.jit(run, donate_argnums=(0,) if donate else ())
+        n_steps = len(program.steps)
+        first_call = [True]  # compile-vs-execute split for the trace
 
         def fn(buffers, _jitted=jitted):
             with warnings.catch_warnings():
@@ -235,7 +239,19 @@ def jit_program(
                 warnings.filterwarnings(
                     "ignore", message="Some donated buffers were not usable"
                 )
-                return _jitted(buffers)
+                if not obs.enabled():
+                    first_call[0] = False
+                    return _jitted(buffers)
+                # first call of a traced program pays the XLA compile
+                # (jax.jit is lazy); later calls are dispatch-only
+                name = (
+                    "backend.compile+dispatch"
+                    if first_call[0]
+                    else "backend.dispatch"
+                )
+                first_call[0] = False
+                with obs.span(name, steps=n_steps):
+                    return _jitted(buffers)
 
         with _PROGRAM_JIT_CACHE_LOCK:
             _PROGRAM_JIT_CACHE[key] = fn
@@ -256,23 +272,24 @@ def place_buffers(
     import jax
     import jax.numpy as jnp
 
-    if split_complex:
-        from tnc_tpu.ops.split_complex import split_array
+    with obs.span("backend.place_buffers", n=len(arrays)):
+        if split_complex:
+            from tnc_tpu.ops.split_complex import split_array
 
-        part_dtype = "float64" if "128" in str(dtype) else "float32"
-        out = []
-        for a in arrays:
-            re, im = split_array(a, part_dtype)
-            out.append(
-                (
-                    jax.device_put(jnp.asarray(re), device),
-                    jax.device_put(jnp.asarray(im), device),
+            part_dtype = "float64" if "128" in str(dtype) else "float32"
+            out = []
+            for a in arrays:
+                re, im = split_array(a, part_dtype)
+                out.append(
+                    (
+                        jax.device_put(jnp.asarray(re), device),
+                        jax.device_put(jnp.asarray(im), device),
+                    )
                 )
-            )
-        return out
-    return [
-        jax.device_put(jnp.asarray(a, dtype=dtype), device) for a in arrays
-    ]
+            return out
+        return [
+            jax.device_put(jnp.asarray(a, dtype=dtype), device) for a in arrays
+        ]
 
 
 class NumpyBackend(Backend):
@@ -426,6 +443,9 @@ class JaxBackend(Backend):
 
         if hoist is None:
             hoist = self.hoist
+        obs.counter_add(
+            "backend.execute_sliced_calls", strategy=self.sliced_strategy
+        )
         if sp.slicing.num_slices == 1:
             if not host:  # device-resident, stored shape — no D2H
                 return self.execute_on_device(sp.program, arrays)

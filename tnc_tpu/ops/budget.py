@@ -24,6 +24,8 @@ import math
 import os
 from dataclasses import dataclass
 
+from tnc_tpu import obs
+
 logger = logging.getLogger(__name__)
 
 _LANE = 128
@@ -159,6 +161,16 @@ def clamp_slice_batch(
     fixed = est.peak_bytes - est.bytes_per_batch_unit  # leaf/tile floor
     fit = max(1, (budget - fixed) // est.bytes_per_batch_unit)
     clamped = max(1, min(requested_batch, fit))
+    if obs.enabled():
+        # modeled peak of the batch the executor will actually run — the
+        # trace-side record of the budget decision
+        obs.gauge_set(
+            "hbm.modeled_peak_bytes",
+            fixed + clamped * est.bytes_per_batch_unit,
+        )
+        obs.gauge_set("hbm.budget_bytes", budget)
+        if clamped < requested_batch:
+            obs.counter_add("hbm.batch_clamped")
     if clamped < requested_batch:
         logger.info(
             "HBM budget: slice batch clamped %d -> %d "

@@ -34,8 +34,9 @@ from typing import Any, Sequence
 
 import numpy as np
 
+from tnc_tpu import obs
 from tnc_tpu.ops.backends import apply_step, place_buffers
-from tnc_tpu.ops.program import ContractionProgram, PairStep
+from tnc_tpu.ops.program import ContractionProgram, PairStep, steps_flops
 from tnc_tpu.ops.sliced import SlicedProgram, index_buffer, kahan_add
 
 
@@ -208,7 +209,9 @@ def _compiled_plan(
         hit = _PLAN_CACHE.get(key)
         if hit is not None:
             _PLAN_CACHE.move_to_end(key)
+            obs.counter_add("chunk_plan_cache.hit")
             return hit
+    obs.counter_add("chunk_plan_cache.miss")
 
     chunks = split_program(sp.program, chunk_steps)
     num_inputs = sp.program.num_inputs
@@ -434,9 +437,18 @@ def run_sliced_chunked_placed(
 
         hp = hoist_sliced_program(sp)
         if not hp.is_noop:
-            res_inputs = _hoisted_inputs(
-                hp, list(device_full), split_complex, precision
-            )
+            with obs.span(
+                "sliced.prelude",
+                steps=len(hp.prelude_steps),
+                executor="chunked",
+            ) as osp:
+                res_inputs = _hoisted_inputs(
+                    hp, list(device_full), split_complex, precision
+                )
+                if obs.enabled():
+                    osp.add(flops=steps_flops(
+                        ps.step for ps in hp.prelude_steps
+                    ))
             return run_sliced_chunked_placed(
                 hp.residual,
                 res_inputs,
@@ -531,24 +543,34 @@ def run_sliced_chunked_placed(
         acc = (zeros(dtype), zeros(dtype))
 
     last_ci = len(chunks) - 1
-    for start in range(0, num, batch):
-        idx = place(all_indices[start : start + batch])
-        # leaf in_slots receive the FULL buffers; each chunk's jit does
-        # its own per-row gather and the last one folds the reduction —
-        # exactly one dispatch per chunk per batch
-        state = dict(enumerate(device_full))
-        for ci, (chunk, fn) in enumerate(zip(chunks, chunk_fns)):
-            ins = tuple(state[s] for s in chunk.in_slots)
-            if ci == last_ci:
-                acc = fn(ins, idx, acc)
-            else:
-                outs = fn(ins, idx)
-                for slot, buf in zip(chunk.out_slots, outs):
-                    state[slot] = buf
-                for step in chunk.steps:
-                    state.pop(step.rhs, None)
-    # fold the compensation in (two tiny dispatches, untimed-scale cost)
-    if split_complex:
-        (sr, cr), (si, ci) = acc
-        return (sr + cr, si + ci)
-    return acc[0] + acc[1]
+    with obs.span(
+        "sliced.residual", executor="chunked", batch=batch,
+        chunks=len(chunks),
+    ) as osp:
+        for start in range(0, num, batch):
+            idx = place(all_indices[start : start + batch])
+            # leaf in_slots receive the FULL buffers; each chunk's jit does
+            # its own per-row gather and the last one folds the reduction —
+            # exactly one dispatch per chunk per batch
+            state = dict(enumerate(device_full))
+            for ci, (chunk, fn) in enumerate(zip(chunks, chunk_fns)):
+                ins = tuple(state[s] for s in chunk.in_slots)
+                if ci == last_ci:
+                    acc = fn(ins, idx, acc)
+                else:
+                    outs = fn(ins, idx)
+                    for slot, buf in zip(chunk.out_slots, outs):
+                        state[slot] = buf
+                    for step in chunk.steps:
+                        state.pop(step.rhs, None)
+        if obs.enabled():
+            osp.add(
+                slices=num,
+                dispatches=len(chunks) * -(-num // batch),
+                flops=num * steps_flops(sp.program.steps),
+            )
+        # fold the compensation in (two tiny dispatches, untimed-scale cost)
+        if split_complex:
+            (sr, cr), (si, ci) = acc
+            return (sr + cr, si + ci)
+        return acc[0] + acc[1]

@@ -29,12 +29,11 @@ selection actively prefers slicings that keep a large hoistable stem.
 
 from __future__ import annotations
 
-import math
 from dataclasses import dataclass, replace
 from functools import lru_cache
 from typing import Any, Sequence
 
-from tnc_tpu.ops.program import ContractionProgram, PairStep
+from tnc_tpu.ops.program import ContractionProgram, PairStep, steps_flops
 from tnc_tpu.ops.sliced import SlicedProgram
 
 
@@ -311,17 +310,7 @@ def hoist_step_flops(sp: SlicedProgram) -> tuple[float, float]:
     * residual``; the naive executor pays ``num_slices * (invariant +
     residual)``."""
     hp = hoist_sliced_program(sp)
-
-    def flops(steps) -> float:
-        total = 0.0
-        for st in steps:
-            k = st.a_dot[0] if st.a_cfirst else st.a_dot[-1]
-            m = math.prod(st.a_dot) // max(k, 1)
-            n_ = math.prod(st.b_dot) // max(k, 1)
-            total += float(k) * float(m) * float(n_)
-        return total
-
     return (
-        flops(ps.step for ps in hp.prelude_steps),
-        flops(hp.residual.program.steps),
+        steps_flops(ps.step for ps in hp.prelude_steps),
+        steps_flops(hp.residual.program.steps),
     )

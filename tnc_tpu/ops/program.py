@@ -52,6 +52,29 @@ _STAGED_PAD_FACTOR = 4.0  # naive materialization tolerated up to this
 _LANEMIX_MAX_W = 65536
 
 
+def steps_flops(steps) -> float:
+    """Naive multiply-add count of a step sequence (``k * m * n`` per
+    dot) — the shared formula under the hoist accounting
+    (:func:`tnc_tpu.ops.hoist.hoist_step_flops`) and the obs span flop
+    counters, so measured and predicted costs are comparable.
+
+    >>> from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+    >>> from tnc_tpu.contractionpath.contraction_path import ContractionPath
+    >>> tn = CompositeTensor([LeafTensor.from_const([0, 1], 4),
+    ...                       LeafTensor.from_const([1, 2], 4)])
+    >>> program = build_program(tn, ContractionPath.simple([(0, 1)]))
+    >>> steps_flops(program.steps)   # one (4,4) @ (4,4) dot
+    64.0
+    """
+    total = 0.0
+    for st in steps:
+        k = st.a_dot[0] if st.a_cfirst else st.a_dot[-1]
+        m = math.prod(st.a_dot) // max(k, 1)
+        n = math.prod(st.b_dot) // max(k, 1)
+        total += float(k) * float(m) * float(n)
+    return total
+
+
 def _padded_elems(shape) -> float:
     """Tile-padded element count; single source of truth in
     :func:`tnc_tpu.ops.budget.padded_elems` (minor dim pads to 128; XLA

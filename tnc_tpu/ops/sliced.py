@@ -31,9 +31,10 @@ from typing import Sequence
 
 import numpy as np
 
+from tnc_tpu import obs
 from tnc_tpu.contractionpath.contraction_path import ContractionPath
 from tnc_tpu.contractionpath.slicing import Slicing
-from tnc_tpu.ops.program import ContractionProgram, build_program
+from tnc_tpu.ops.program import ContractionProgram, build_program, steps_flops
 from tnc_tpu.ops.backends import _run_steps
 from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
 
@@ -170,19 +171,29 @@ def execute_sliced_numpy(
 
         hp = hoist_sliced_program(sp)
         if not hp.is_noop:
-            full = run_prelude(np, hp, full)
+            with obs.span(
+                "sliced.prelude", steps=len(hp.prelude_steps), executor="numpy"
+            ) as osp:
+                full = run_prelude(np, hp, full)
+                if obs.enabled():
+                    osp.add(flops=steps_flops(
+                        ps.step for ps in hp.prelude_steps
+                    ))
             sp = hp.residual
     acc = np.zeros(sp.program.stored_result_shape, dtype=dtype)
     num = sp.slicing.num_slices
     if max_slices is not None:
         num = min(num, max_slices)
-    for s in range(num):
-        indices = _slice_indices(sp.slicing, s)
-        buffers = [
-            index_buffer(np, arr, info, indices)
-            for arr, info in zip(full, sp.slot_slices)
-        ]
-        acc = acc + _run_steps(np, sp.program, buffers)
+    with obs.span("sliced.residual", executor="numpy") as osp:
+        for s in range(num):
+            indices = _slice_indices(sp.slicing, s)
+            buffers = [
+                index_buffer(np, arr, info, indices)
+                for arr, info in zip(full, sp.slot_slices)
+            ]
+            acc = acc + _run_steps(np, sp.program, buffers)
+        if obs.enabled():
+            osp.add(slices=num, flops=num * steps_flops(sp.program.steps))
     return acc.reshape(sp.program.result_shape)
 
 
@@ -435,4 +446,23 @@ def make_jax_sliced_fn(
             )
             return finish(acc)
 
-    return jax.jit(fn)
+    jitted = jax.jit(fn)
+    hoisted = hp is not None
+    # prelude + loop live inside ONE jitted dispatch here, so a single
+    # span covers both; its flop counter is the hoisted total (prelude
+    # once + residual per slice)
+    total_flops = num * steps_flops(loop_sp.program.steps)
+    if hp is not None:
+        total_flops += steps_flops(ps.step for ps in hp.prelude_steps)
+
+    def run(full_buffers, _jitted=jitted):
+        if not obs.enabled():
+            return _jitted(full_buffers)
+        with obs.span(
+            "sliced.loop", hoisted=hoisted, executor="loop"
+        ) as osp:
+            out = _jitted(full_buffers)
+            osp.add(slices=num, flops=total_flops)
+            return out
+
+    return run

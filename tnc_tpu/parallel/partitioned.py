@@ -42,6 +42,7 @@ import numpy as np
 
 logger = logging.getLogger(__name__)
 
+from tnc_tpu import obs
 from tnc_tpu.contractionpath.contraction_path import ContractionPath
 from tnc_tpu.ops.backends import jit_program, place_buffers
 from tnc_tpu.ops.program import (
@@ -239,34 +240,39 @@ def scatter_partitions(
     programs: list[Any] = []
     metas: list[LeafTensor] = []
     buffers: list[list[Any]] = []
-    for i, child in enumerate(children):
-        sp = None
-        if hbm_bytes is not None:
-            sp = _slice_partition(child, contract_path.nested[i], hbm_bytes)
-        if sp is not None:
-            programs.append(sp)
-            program = sp.program
-        else:
-            program = build_program(child, contract_path.nested[i])
-            programs.append(program)
-        metas.append(
-            LeafTensor(list(program.result_legs), list(program.result_shape))
-        )
-        buffers.append(
-            place_buffers(
-                _leaf_arrays(child), dtype, split_complex,
-                devices[mapping.device(i)],
+    with obs.span("partitioned.scatter", partitions=k):
+        for i, child in enumerate(children):
+            sp = None
+            if hbm_bytes is not None:
+                sp = _slice_partition(
+                    child, contract_path.nested[i], hbm_bytes
+                )
+            if sp is not None:
+                programs.append(sp)
+                program = sp.program
+            else:
+                program = build_program(child, contract_path.nested[i])
+                programs.append(program)
+            metas.append(
+                LeafTensor(
+                    list(program.result_legs), list(program.result_shape)
+                )
             )
-        )
-        # mirror of "Scattering tensor network" (communication.rs:132)
-        logger.debug(
-            "scatter: partition %d -> device %d (%d tensors, %d steps%s)",
-            i,
-            mapping.device(i),
-            len(child),
-            len(program.steps),
-            ", sliced" if sp is not None else "",
-        )
+            buffers.append(
+                place_buffers(
+                    _leaf_arrays(child), dtype, split_complex,
+                    devices[mapping.device(i)],
+                )
+            )
+            # mirror of "Scattering tensor network" (communication.rs:132)
+            logger.debug(
+                "scatter: partition %d -> device %d (%d tensors, %d steps%s)",
+                i,
+                mapping.device(i),
+                len(child),
+                len(program.steps),
+                ", sliced" if sp is not None else "",
+            )
 
     comm = Communication(mapping, list(devices), programs, metas)
     return comm, buffers
@@ -342,16 +348,27 @@ def local_contract_partitions(
             )
         return jit_program(program, split_complex, precision)
 
+    def run_job(i, fn, bufs):
+        # runs on the pool worker thread, so each partition's span lands
+        # on its own timeline lane (tid) in the exported trace
+        with obs.span(
+            "partitioned.local_partition",
+            partition=i,
+            device=comm.mapping.device(i),
+        ):
+            return fn(bufs)
+
     jobs = [
-        (compile_one(i, program), list(bufs))
+        (i, compile_one(i, program), list(bufs))
         for i, (program, bufs) in enumerate(zip(comm.programs, buffers))
     ]
-    if len(jobs) > 1:
-        from concurrent.futures import ThreadPoolExecutor
+    with obs.span("partitioned.local", partitions=len(jobs)):
+        if len(jobs) > 1:
+            from concurrent.futures import ThreadPoolExecutor
 
-        with ThreadPoolExecutor(max_workers=len(jobs)) as pool:
-            return list(pool.map(lambda job: job[0](job[1]), jobs))
-    return [fn(bufs) for fn, bufs in jobs]
+            with ThreadPoolExecutor(max_workers=len(jobs)) as pool:
+                return list(pool.map(lambda job: run_job(*job), jobs))
+        return [run_job(i, fn, bufs) for i, fn, bufs in jobs]
 
 
 def intermediate_reduce(
@@ -369,21 +386,22 @@ def intermediate_reduce(
 
     metas = list(comm.results_meta)
     held: list[Any] = list(results)
-    for x, y in toplevel:
-        target = comm.devices[comm.mapping.device(x)]
-        logger.debug(
-            "fan-in: partition %d (device %d) <- partition %d (device %d)",
-            x,
-            comm.mapping.device(x),
-            y,
-            comm.mapping.device(y),
-        )
-        moved = jax.device_put(held[y], target)  # device-to-device (ICI)
-        program, result_meta = _pair_program(metas[x], metas[y])
-        fn = jit_program(program, split_complex, precision)
-        held[x] = fn([held[x], moved])
-        held[y] = None
-        metas[x] = result_meta
+    with obs.span("partitioned.fanin", pairs=len(toplevel)):
+        for x, y in toplevel:
+            target = comm.devices[comm.mapping.device(x)]
+            logger.debug(
+                "fan-in: partition %d (device %d) <- partition %d (device %d)",
+                x,
+                comm.mapping.device(x),
+                y,
+                comm.mapping.device(y),
+            )
+            moved = jax.device_put(held[y], target)  # device-to-device (ICI)
+            program, result_meta = _pair_program(metas[x], metas[y])
+            fn = jit_program(program, split_complex, precision)
+            held[x] = fn([held[x], moved])
+            held[y] = None
+            metas[x] = result_meta
     root = _fanin_survivor(len(held), toplevel) if toplevel else 0
     return held[root], metas[root]
 
@@ -703,6 +721,20 @@ def partitioned_sliced_executor(
         num = slicing.num_slices if max_slices is None else min(
             slicing.num_slices, max_slices
         )
+        with obs.maybe_jax_profiler_trace(), obs.span(
+            "partitioned.sliced_run", slices=num, partitions=k
+        ):
+            acc = _run_slices(num)
+
+        if split_complex:
+            from tnc_tpu.ops.split_complex import combine_array
+
+            data = combine_array(*acc)
+        else:
+            data = np.asarray(acc)
+        return data.reshape(tuple(final_meta.bond_dims))
+
+    def _run_slices(num: int):
         acc = None
         for s in range(num):
             # host (uncommitted) indices: each jit transfers them to its
@@ -725,14 +757,7 @@ def partitioned_sliced_executor(
                 acc = (acc[0] + held[root][0], acc[1] + held[root][1])
             else:
                 acc = acc + held[root]
-
-        if split_complex:
-            from tnc_tpu.ops.split_complex import combine_array
-
-            data = combine_array(*acc)
-        else:
-            data = np.asarray(acc)
-        return data.reshape(tuple(final_meta.bond_dims))
+        return acc
 
     return run, slicing, final_meta
 

@@ -21,6 +21,7 @@ import numpy as np
 
 logger = logging.getLogger(__name__)
 
+from tnc_tpu import obs
 from tnc_tpu.contractionpath.contraction_path import ContractionPath
 from tnc_tpu.contractionpath.slicing import Slicing
 from tnc_tpu.ops.backends import _run_steps
@@ -50,6 +51,19 @@ def _shard_map(f, mesh, in_specs, out_specs):
             f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
             check_rep=False,
         )
+
+
+def _effective_chunk(
+    num_slices: int, n_devices: int, max_slices: int | None
+) -> int:
+    """Per-device slice count actually executed: the full share, shrunk
+    to ``ceil(max_slices / n_devices)`` under a probe subset. The ONE
+    definition shared by the compiled fn, its cache key, and the trace
+    flop accounting — they must never disagree on the chunk size."""
+    chunk = num_slices // n_devices
+    if max_slices is not None:
+        chunk = min(chunk, max(1, -(-max_slices // n_devices)))
+    return chunk
 
 
 def make_mesh(n_devices: int | None = None, axis: str = "slices"):
@@ -110,9 +124,7 @@ def _make_spmd_fn(
         raise ValueError(
             f"num_slices ({num}) must be divisible by mesh size ({n_devices})"
         )
-    chunk = num // n_devices
-    if max_slices is not None:
-        chunk = min(chunk, max(1, -(-max_slices // n_devices)))
+    chunk = _effective_chunk(num, n_devices, max_slices)
 
     hp = None
     if hoist:
@@ -227,14 +239,14 @@ _SPMD_FN_CACHE_MAX = 64
 def _spmd_fn_cached(sp, mesh, axis, dtype, split_complex, precision, unroll,
                     max_slices, hoist=False):
     n_devices = mesh.shape[axis]
-    chunk = sp.slicing.num_slices // n_devices
-    if max_slices is not None:
-        chunk = min(chunk, max(1, -(-max_slices // n_devices)))
+    chunk = _effective_chunk(sp.slicing.num_slices, n_devices, max_slices)
     key = (
         sp.signature(), tuple(mesh.devices.flat), axis, str(dtype),
         split_complex, precision, unroll, chunk, hoist,
     )
     fn = _SPMD_FN_CACHE.get(key)
+    obs.counter_add("spmd_fn_cache.hit" if fn is not None else
+                    "spmd_fn_cache.miss")
     if fn is None:
         fn = _make_spmd_fn(
             sp, mesh, axis, dtype, split_complex, precision, unroll,
@@ -318,21 +330,55 @@ def distributed_sliced_contraction(
         sp, mesh, axis, dtype, split_complex, precision, unroll, max_slices,
         hoist,
     )
-    if split_complex:
-        from tnc_tpu.ops.split_complex import combine_array, split_array
+    n_dev = mesh.shape[axis]
+    chunk = _effective_chunk(slicing.num_slices, n_dev, max_slices)
+    executed = chunk * n_dev  # prefix-subset semantics (_make_spmd_fn)
+    # the SAME effective-hoist decision _make_spmd_fn takes (the pass is
+    # lru-cached, so this re-derivation is a dict hit), so the span's
+    # hoisted flag and flop count describe what actually executes
+    hp = None
+    if hoist:
+        from tnc_tpu.ops.hoist import hoist_sliced_program
 
-        part_dtype = "float64" if "128" in str(dtype) else "float32"
-        arrays = []
-        for leaf in leaves:
-            re, im = split_array(leaf.data.into_data(), part_dtype)
-            arrays.append((jnp.asarray(re), jnp.asarray(im)))
-        re, im = fn(*arrays)
-        result = combine_array(re, im).reshape(sp.program.result_shape)
-    else:
-        arrays = [
-            jnp.asarray(leaf.data.into_data(), dtype=dtype) for leaf in leaves
-        ]
-        result = np.asarray(fn(*arrays)).reshape(sp.program.result_shape)
+        cand = hoist_sliced_program(sp)
+        if not cand.is_noop:
+            hp = cand
+    # device-level profiling (TNC_TPU_TRACE_JAX=<dir>) wraps the SPMD
+    # dispatch + fetch; obs spans record the host-side wall time either way
+    with obs.maybe_jax_profiler_trace(), obs.span(
+        "spmd.contract",
+        slices=executed,
+        devices=n_dev,
+        hoisted=hp is not None,
+    ) as osp:
+        if split_complex:
+            from tnc_tpu.ops.split_complex import combine_array, split_array
+
+            part_dtype = "float64" if "128" in str(dtype) else "float32"
+            arrays = []
+            for leaf in leaves:
+                re, im = split_array(leaf.data.into_data(), part_dtype)
+                arrays.append((jnp.asarray(re), jnp.asarray(im)))
+            re, im = fn(*arrays)
+            result = combine_array(re, im).reshape(sp.program.result_shape)
+        else:
+            arrays = [
+                jnp.asarray(leaf.data.into_data(), dtype=dtype)
+                for leaf in leaves
+            ]
+            result = np.asarray(fn(*arrays)).reshape(sp.program.result_shape)
+        if obs.enabled():
+            from tnc_tpu.ops.program import steps_flops
+
+            if hp is not None:
+                # hoisted: each device runs the prelude once, then the
+                # residual per slice of its chunk
+                flops = n_dev * steps_flops(
+                    ps.step for ps in hp.prelude_steps
+                ) + executed * steps_flops(hp.residual.program.steps)
+            else:
+                flops = executed * steps_flops(sp.program.steps)
+            osp.add(flops=flops)
     return LeafTensor(
         list(sp.program.result_legs),
         list(sp.program.result_shape),

@@ -24,6 +24,7 @@ import random
 from dataclasses import dataclass
 from typing import Sequence
 
+from tnc_tpu import obs
 from tnc_tpu.partitioning.bisect import partition_kway
 from tnc_tpu.partitioning.hypergraph import hypergraph_from_tensors
 from tnc_tpu.tensornetwork.tensor import CompositeTensor
@@ -81,6 +82,7 @@ class PartitionConfig:
         )
 
 
+@obs.traced("plan.find_partitioning")
 def find_partitioning(
     tn: CompositeTensor,
     k: int,
