@@ -61,6 +61,14 @@ Env knobs:
     Chrome-trace/Perfetto timeline of the run is written — load it in
     ui.perfetto.dev; docs/observability.md)
 
+Flags: ``--resume`` arms slice-range checkpointing (sets TNC_TPU_CKPT
+to .cache/bench_ckpt unless already set): a run killed mid-slice-range
+resumes from the persisted accumulator+cursor instead of restarting at
+slice 0 (docs/resilience.md). Retry-ladder subprocesses inherit it, so
+a degraded retry also resumes whatever range the crashed stage
+finished. Resilience activity (retries, degradation rungs, checkpoint
+saves/resumes) lands in the JSON record's "resilience" field.
+
 Executor/precision/target defaults may also come from the hardware-
 promoted marker .cache/best_config.json (see _tuned_default); env wins.
 """
@@ -1718,6 +1726,13 @@ def _attach_obs_breakdown(record: dict, obs) -> None:
                 record.setdefault("jit_cache", {})[
                     key.split(".")[1]
                 ] = int(counters[key])
+        # resilience activity (retries, degradation rungs, checkpoint
+        # saves/resumes, fired faults): read BEFORE the trace export so
+        # an unwritable trace path cannot drop the recovery record of
+        # exactly the run that needed recovering
+        resilience = obs.counters_by_prefix("resilience.")
+        if resilience:
+            record["resilience"] = resilience
         trace_out = (
             os.environ.get("BENCH_TRACE_JSON")
             or obs.trace_path()
@@ -1737,6 +1752,18 @@ def _attach_obs_breakdown(record: dict, obs) -> None:
 
 
 def main() -> None:
+    if "--resume" in sys.argv[1:]:
+        # arm slice-range checkpointing (docs/resilience.md): the chunked
+        # executor persists accumulator+cursor under this directory and a
+        # rerun resumes mid-range; retry-ladder subprocesses inherit it
+        os.environ.setdefault(
+            "TNC_TPU_CKPT",
+            os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                ".cache", "bench_ckpt",
+            ),
+        )
+        log(f"[bench] --resume: checkpoints in {os.environ['TNC_TPU_CKPT']}")
     config = os.environ.get("BENCH_CONFIG", "sycamore_amplitude")
     if config not in CONFIGS:
         _emit(

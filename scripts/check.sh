@@ -30,6 +30,9 @@ obs.export_chrome_trace("/tmp/tnc_tpu_check_trace.json")
 PY
 python scripts/trace_summarize.py /tmp/tnc_tpu_check_trace.json > /dev/null
 
+echo "== crash-resume smoke (SIGKILL mid-range, resume, compare to golden) =="
+TNC_TPU_PLATFORM=cpu python scripts/crash_resume_smoke.py
+
 echo "== examples =="
 # TNC_TPU_PLATFORM pins JAX to CPU via jax.config (env vars alone can be
 # overridden by interpreter startup hooks that pre-wire an accelerator);

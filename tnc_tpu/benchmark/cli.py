@@ -35,6 +35,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--cache-dir", default=Path("bench_cache"), type=Path)
     p.add_argument("--out", default=Path("results.jsonl"), type=Path)
     p.add_argument("--protocol", default=Path("protocol.jsonl"), type=Path)
+    p.add_argument(
+        "--checkpoint-dir", default=None, type=Path,
+        help="slice-range checkpoint root (tnc_tpu.resilience): run cells "
+        "with per-cell TNC_TPU_CKPT, and requeue crashed cells whose "
+        "checkpoint survives (mid-range resume) instead of failing them",
+    )
     p.add_argument("--log-dir", default=None, type=Path)
     p.add_argument("--partitions", nargs="+", type=int, default=[4])
     p.add_argument("--seeds", nargs="+", type=int, default=[0])
@@ -89,7 +95,7 @@ def main(argv: list[str] | None = None) -> int:
 
     cache = ArtifactCache(args.cache_dir)
     writer = ResultWriter(args.out)
-    protocol = Protocol(args.protocol)
+    protocol = Protocol(args.protocol, checkpoint_dir=args.checkpoint_dir)
 
     scenarios = enumerate_scenarios(args)
     log.info("%d scenarios in %s mode", len(scenarios), args.mode)
@@ -114,6 +120,7 @@ def main(argv: list[str] | None = None) -> int:
                     backend=args.backend,
                     distributed=args.distributed,
                     repeats=args.repeats,
+                    checkpoint_dir=args.checkpoint_dir,
                 )
         except Exception:
             log.exception("scenario %s failed", scenario.run_id)

@@ -159,13 +159,25 @@ def do_run(
     backend: str = "jax",
     distributed: bool = False,
     repeats: int = 1,
+    checkpoint_dir=None,
 ) -> RunResult | None:
     """Contract a cached artifact, timing only the contraction (the
-    reference barriers before timing, ``main.rs:365-405``)."""
+    reference barriers before timing, ``main.rs:365-405``).
+
+    With ``checkpoint_dir``, the cell runs under a per-cell
+    ``TNC_TPU_CKPT`` (``tnc_tpu.resilience.checkpoint``): a crash
+    mid-slice-range leaves a checkpoint, the protocol requeues the cell
+    on restart, and the rerun resumes from the persisted cursor."""
+    import contextlib
+    import os
+
     run_id = f"run-{backend}/" + scenario.run_id
     if not protocol.should_run(run_id):
         log.info("skipping %s (already done or failed)", run_id)
         return None
+    # a requeued cell resumes mid-range: its wall time is NOT a full
+    # contraction time and the record must say so
+    resumed = run_id in protocol.resumable
     loaded = cache.load(scenario.key())
     if loaded is None:
         raise FileNotFoundError(
@@ -174,16 +186,37 @@ def do_run(
     protocol.trying(run_id)
     tn, path = loaded
 
-    times = []
-    for _ in range(max(1, repeats)):
-        t0 = time.monotonic()
-        if distributed and path.nested:
-            from tnc_tpu.parallel import distributed_partitioned_contraction
+    @contextlib.contextmanager
+    def _cell_ckpt_env():
+        if checkpoint_dir is None:
+            yield
+            return
+        from tnc_tpu.benchmark.protocol import cell_checkpoint_dir
 
-            distributed_partitioned_contraction(tn, path)
-        else:
-            contract_tensor_network(tn, path, backend=backend)
-        times.append(time.monotonic() - t0)
+        cell = cell_checkpoint_dir(checkpoint_dir, run_id)
+        prev = os.environ.get("TNC_TPU_CKPT")
+        os.environ["TNC_TPU_CKPT"] = str(cell)
+        try:
+            yield
+        finally:
+            if prev is None:
+                os.environ.pop("TNC_TPU_CKPT", None)
+            else:
+                os.environ["TNC_TPU_CKPT"] = prev
+
+    times = []
+    with _cell_ckpt_env():
+        for _ in range(max(1, repeats)):
+            t0 = time.monotonic()
+            if distributed and path.nested:
+                from tnc_tpu.parallel import (
+                    distributed_partitioned_contraction,
+                )
+
+                distributed_partitioned_contraction(tn, path)
+            else:
+                contract_tensor_network(tn, path, backend=backend)
+            times.append(time.monotonic() - t0)
 
     record = RunResult(
         id=run_id,
@@ -193,6 +226,7 @@ def do_run(
         seed=scenario.seed,
         time_to_solution=min(times),
         backend=backend,
+        resumed=resumed,
     )
     writer.write(record)
     protocol.done(run_id)

@@ -41,6 +41,10 @@ class RunResult:
     seed: int
     time_to_solution: float  # seconds, contraction only
     backend: str = "jax"
+    # the run resumed a crashed cell from a slice-range checkpoint: its
+    # wall time covers only the REMAINING range, not a full contraction —
+    # comparisons must not read it as a clean-run time
+    resumed: bool = False
 
 
 class ResultWriter:

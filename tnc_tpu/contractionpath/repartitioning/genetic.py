@@ -12,6 +12,7 @@ a pure function of the chromosome so results are worker-count invariant.
 
 from __future__ import annotations
 
+import logging
 import os
 import random
 from dataclasses import dataclass
@@ -21,7 +22,10 @@ from tnc_tpu.contractionpath.communication_schemes import CommunicationScheme
 from tnc_tpu.contractionpath.repartitioning.simulated_annealing import (
     evaluate_partitioning,
 )
+from tnc_tpu.resilience.retry import pool_map_with_retry
 from tnc_tpu.tensornetwork.tensor import CompositeTensor
+
+logger = logging.getLogger(__name__)
 
 _POOL_CTX = None
 
@@ -115,13 +119,22 @@ def balance_partitions(
     def score_population(population: list[list[int]]) -> list[tuple[float, list[int]]]:
         nonlocal pool
         jobs = [(rng.getrandbits(64), c) for c in population]
-        if pool is not None:
-            try:
-                scores = pool.map_async(_fitness_worker, jobs).get(timeout=600.0)
-                return list(zip(scores, population))
-            except Exception:
-                pool.terminate()
-                pool = None
+        # transient pool failures (a worker lost to a timeout/preemption)
+        # get ONE retry on a FRESH pool; anything else logs the real
+        # worker error and falls back to serial evaluation (identical
+        # results, slower) — see resilience.retry.pool_map_with_retry
+        scores, pool = pool_map_with_retry(
+            pool,
+            lambda p: p.map_async(_fitness_worker, jobs).get(timeout=600.0),
+            lambda: _make_fitness_pool(
+                tensor, communication_scheme, memory_limit,
+                settings.population_size,
+            ),
+            logger,
+            "genetic fitness pool",
+        )
+        if scores is not None:
+            return list(zip(scores, population))
         return [
             (
                 evaluate_partitioning(

@@ -35,12 +35,15 @@ touched partitions per move, ``simulated_annealing.rs:457-562``).
 
 from __future__ import annotations
 
+import logging
 import math
 import os
 import random
 import time
 from dataclasses import dataclass
 from typing import Sequence
+
+logger = logging.getLogger(__name__)
 
 from tnc_tpu.contractionpath.communication_schemes import CommunicationScheme
 from tnc_tpu.contractionpath.contraction_cost import (
@@ -55,6 +58,7 @@ from tnc_tpu.contractionpath.repartitioning import (
     compute_solution,
     compute_solution_with_paths,
 )
+from tnc_tpu.resilience.retry import pool_map_with_retry
 from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
 
 
@@ -604,16 +608,20 @@ class SimulatedAnnealingOptimizer:
                     )
                     for _ in range(self.n_trials)
                 ]
-                if pool is not None:
-                    try:
-                        results = pool.map_async(_pool_chain, jobs).get(
-                            timeout=pool_timeout
-                        )
-                    except Exception:
-                        pool.terminate()
-                        pool = None
-                        results = [_run_chain(model, *job) for job in jobs]
-                else:
+                # transient pool failures get ONE retry on a FRESH pool;
+                # other failures log the real worker error with the
+                # decision and fall back to serial chains for the rest
+                # of the run — see resilience.retry.pool_map_with_retry
+                results, pool = pool_map_with_retry(
+                    pool,
+                    lambda p: p.map_async(_pool_chain, jobs).get(
+                        timeout=pool_timeout
+                    ),
+                    lambda: self._make_pool(model),
+                    logger,
+                    "simulated-annealing chain pool",
+                )
+                if results is None:
                     results = [_run_chain(model, *job) for job in jobs]
 
                 best_chain = None

@@ -14,6 +14,7 @@ from tnc_tpu.obs.core import (  # noqa: F401
     SpanRecord,
     configure,
     counter_add,
+    counters_by_prefix,
     enabled,
     gauge_set,
     get_registry,

@@ -202,20 +202,24 @@ class MetricsRegistry:
     def snapshot(self) -> dict:
         """Plain-data snapshot of every metric (JSON-ready; labels as
         ``name{k=v}`` strings)."""
-
-        def fmt(key: tuple) -> str:
-            name, labels = key
-            if not labels:
-                return name
-            inner = ",".join(f"{k}={v}" for k, v in labels)
-            return f"{name}{{{inner}}}"
-
+        fmt = format_metric_key
         return {
             "counters": {fmt(k): v for k, v in self.counters().items()},
             "gauges": {fmt(k): v for k, v in self.gauges().items()},
             "histograms": {fmt(k): v for k, v in self.histograms().items()},
             "dropped_spans": self.dropped_spans(),
         }
+
+
+def format_metric_key(key: tuple) -> str:
+    """Registry metric key → ``name`` / ``name{k=v,...}`` string — the
+    ONE rendering rule shared by :meth:`MetricsRegistry.snapshot` and
+    :func:`counters_by_prefix` (they feed the same JSON consumers)."""
+    name, labels = key
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={v}" for k, v in labels)
+    return f"{name}{{{inner}}}"
 
 
 class _NullSpan:
@@ -438,6 +442,26 @@ def gauge_set(name: str, value: float, **labels) -> None:
 def observe(name: str, value: float, **labels) -> None:
     if _ENABLED:
         _REGISTRY.observe(name, value, **labels)
+
+
+def counters_by_prefix(prefix: str) -> dict[str, float]:
+    """Flattened view of every counter under a name prefix, labels
+    rendered as ``name{k=v}`` strings — how the bench record and tests
+    read out a subsystem's activity (e.g. ``resilience.`` for retries,
+    degradation rungs, checkpoint saves/resumes, fired faults).
+
+    >>> _ = configure(enabled=True, registry=MetricsRegistry())
+    >>> counter_add("resilience.retry.attempts", 2, site="spmd.dispatch")
+    >>> counter_add("other.thing", 1)
+    >>> counters_by_prefix("resilience.")
+    {'resilience.retry.attempts{site=spmd.dispatch}': 2.0}
+    >>> _ = configure(enabled=False, registry=MetricsRegistry())
+    """
+    out: dict[str, float] = {}
+    for key, value in sorted(_REGISTRY.counters().items()):
+        if key[0].startswith(prefix):
+            out[format_metric_key(key)] = value
+    return out
 
 
 _JAX_TRACE_ACTIVE = False

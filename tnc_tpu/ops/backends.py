@@ -29,6 +29,8 @@ import numpy as np
 
 from tnc_tpu import obs
 from tnc_tpu.ops.program import ContractionProgram
+from tnc_tpu.resilience import faultinject as _faults
+from tnc_tpu.resilience import retry as _retry
 
 logger = logging.getLogger(__name__)
 
@@ -233,6 +235,29 @@ def jit_program(
         first_call = [True]  # compile-vs-execute split for the trace
 
         def fn(buffers, _jitted=jitted):
+            # transient runtime failures (preemption notice, ICI/DCN
+            # hiccup) retry the dispatch under the shared policy; OOM and
+            # genuine errors re-raise for the callers' degradation
+            # ladders. The no-failure path costs one extra frame.
+            def _dispatch():
+                _faults.fault_point("backend.dispatch")
+                out = _jitted(buffers)
+                if _retry.sync_dispatch():
+                    # surface async device failures inside this guarded
+                    # region instead of at the next use of the result
+                    jax.block_until_ready(out)
+                return out
+
+            def _run_with_retry():
+                # the guard downgrades TRANSIENT to FATAL once a donating
+                # dispatch consumed the inputs (retrying deleted arrays
+                # would mask the original error)
+                return _retry.default_policy().run(
+                    _dispatch,
+                    label="backend.dispatch",
+                    classify=_retry.donation_guarded_classify(buffers),
+                )
+
             with warnings.catch_warnings():
                 # Tiny gate inputs routinely can't back larger intermediates;
                 # XLA's per-buffer donation warning is pure noise here.
@@ -241,7 +266,7 @@ def jit_program(
                 )
                 if not obs.enabled():
                     first_call[0] = False
-                    return _jitted(buffers)
+                    return _run_with_retry()
                 # first call of a traced program pays the XLA compile
                 # (jax.jit is lazy); later calls are dispatch-only
                 name = (
@@ -251,7 +276,7 @@ def jit_program(
                 )
                 first_call[0] = False
                 with obs.span(name, steps=n_steps):
-                    return _jitted(buffers)
+                    return _run_with_retry()
 
         with _PROGRAM_JIT_CACHE_LOCK:
             _PROGRAM_JIT_CACHE[key] = fn

@@ -32,12 +32,19 @@ from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Any, Sequence
 
+import logging
+
 import numpy as np
 
 from tnc_tpu import obs
 from tnc_tpu.ops.backends import apply_step, place_buffers
 from tnc_tpu.ops.program import ContractionProgram, PairStep, steps_flops
 from tnc_tpu.ops.sliced import SlicedProgram, index_buffer, kahan_add
+from tnc_tpu.resilience import checkpoint as _ckpt
+from tnc_tpu.resilience import faultinject as _faults
+from tnc_tpu.resilience import retry as _retry
+
+logger = logging.getLogger(__name__)
 
 
 @dataclass(frozen=True)
@@ -213,6 +220,7 @@ def _compiled_plan(
             return hit
     obs.counter_add("chunk_plan_cache.miss")
 
+    _faults.fault_point("chunked.plan")
     chunks = split_program(sp.program, chunk_steps)
     num_inputs = sp.program.num_inputs
 
@@ -363,6 +371,7 @@ def execute_sliced_batched_jax(
     max_slices: int | None = None,
     host: bool = True,
     hoist: bool = False,
+    ckpt: str | None = None,
 ):
     """Run a sliced program as chunked, slice-batched jitted calls.
 
@@ -378,12 +387,24 @@ def execute_sliced_batched_jax(
     device→host transfer — benchmark timing must stay transfer-free:
     on tunneled backends the first D2H permanently degrades dispatch
     (measured 430× on the v5e axon tunnel, TPU_EVIDENCE_r03.md).
+
+    ``ckpt`` (or ``TNC_TPU_CKPT``) arms slice-range checkpointing:
+    the accumulator + cursor persist periodically and a restarted run
+    resumes bit-identically (:mod:`tnc_tpu.resilience.checkpoint`).
     """
     if sp.slicing.num_slices <= 1:
         raise ValueError(
             "execute_sliced_batched_jax expects a sliced program; "
             "use JaxBackend.execute for unsliced networks"
         )
+    # input-data digest for the checkpoint signature, from the HOST
+    # arrays (hashing device buffers would force a D2H): a structurally
+    # identical program over different leaf data must not cross-resume
+    data_digest = (
+        _ckpt.arrays_digest(arrays)
+        if _ckpt.resolve_ckpt(ckpt) is not None
+        else None
+    )
     device_full = place_buffers(arrays, dtype, split_complex, device)
     acc = run_sliced_chunked_placed(
         sp,
@@ -397,6 +418,8 @@ def execute_sliced_batched_jax(
         enforce_budget=enforce_budget,
         max_slices=max_slices,
         hoist=hoist,
+        ckpt=ckpt,
+        ckpt_data_digest=data_digest,
     )
     if not host:
         return acc
@@ -419,6 +442,8 @@ def run_sliced_chunked_placed(
     enforce_budget: bool = True,
     max_slices: int | None = None,
     hoist: bool = False,
+    ckpt: str | None = None,
+    ckpt_data_digest: str | None = None,
 ):
     """Chunked slice-batched execution over already-placed device
     buffers; returns the device-resident accumulator in stored shape
@@ -461,6 +486,8 @@ def run_sliced_chunked_placed(
                 enforce_budget=enforce_budget,
                 max_slices=max_slices,
                 hoist=False,
+                ckpt=ckpt,
+                ckpt_data_digest=ckpt_data_digest,
             )
 
     num = sp.slicing.num_slices
@@ -487,6 +514,38 @@ def run_sliced_chunked_placed(
     batch = max(1, min(batch, num))
     while num % batch:  # largest divisor <= requested (dims are tiny)
         batch -= 1
+
+    # slice-range checkpointing (TNC_TPU_CKPT / ckpt=): load cursor +
+    # accumulator before compiling; the signature covers everything that
+    # changes the accumulation sequence except the batch (the cursor is a
+    # slice index, valid at any batch alignment)
+    ckpt_path = _ckpt.resolve_ckpt(ckpt)
+    mgr = None
+    resumed = None
+    start0 = 0
+    if ckpt_path is not None:
+        # str(device) disambiguates the distributed local phase: two
+        # structurally identical partitions share a program signature but
+        # run on different devices, and must not cross-resume each
+        # other's accumulator out of a shared TNC_TPU_CKPT directory.
+        # ckpt_data_digest covers the leaf DATA (the program signature is
+        # structural — same circuit, different bitstring, same hash); it
+        # is None only on the placed-buffers entry point, whose callers
+        # isolate runs by directory (per-cell TNC_TPU_CKPT)
+        sig = _ckpt.signature_hash(
+            "chunked-v1", sp.signature(), chunk_steps, split_complex,
+            precision, str(dtype), num, str(device), ckpt_data_digest,
+        )
+        mgr = _ckpt.SliceCheckpoint(ckpt_path, sig)
+        loaded = mgr.load()
+        if loaded is not None:
+            # the cursor may be unaligned to the batch (the crashed run
+            # could have degraded its batch mid-range); the dispatch
+            # loop below tolerates that — each range is b = min(batch,
+            # num - start) slices, and the jitted chunk fns retrace
+            # once for an odd tail shape
+            start0, resumed = loaded
+            start0 = max(0, min(start0, num))
 
     chunks, chunk_fns = _compiled_plan(
         sp, batch, chunk_steps, split_complex, precision
@@ -534,7 +593,9 @@ def run_sliced_chunked_placed(
         return fn(leaf, idx_all)
 
     # Kahan (sum, comp) accumulator per part; finalized to sum+comp below
-    if split_complex:
+    if resumed is not None:
+        acc = _unflatten_acc(resumed, split_complex, place)
+    elif split_complex:
         acc = (
             (zeros(part_dtype), zeros(part_dtype)),
             (zeros(part_dtype), zeros(part_dtype)),
@@ -542,35 +603,99 @@ def run_sliced_chunked_placed(
     else:
         acc = (zeros(dtype), zeros(dtype))
 
-    last_ci = len(chunks) - 1
+    # TNC_TPU_SYNC_DISPATCH: force device errors to surface inside the
+    # retry/degradation scope below (async dispatch otherwise raises
+    # them at the NEXT use of the poisoned accumulator)
+    sync = _retry.sync_dispatch()
     with obs.span(
         "sliced.residual", executor="chunked", batch=batch,
         chunks=len(chunks),
     ) as osp:
-        for start in range(0, num, batch):
-            idx = place(all_indices[start : start + batch])
+        start = start0
+        dispatches = 0
+        while start < num:
+            b = min(batch, num - start)
+            idx = place(all_indices[start : start + b])
+
             # leaf in_slots receive the FULL buffers; each chunk's jit does
             # its own per-row gather and the last one folds the reduction —
             # exactly one dispatch per chunk per batch
-            state = dict(enumerate(device_full))
-            for ci, (chunk, fn) in enumerate(zip(chunks, chunk_fns)):
-                ins = tuple(state[s] for s in chunk.in_slots)
-                if ci == last_ci:
-                    acc = fn(ins, idx, acc)
-                else:
-                    outs = fn(ins, idx)
-                    for slot, buf in zip(chunk.out_slots, outs):
-                        state[slot] = buf
-                    for step in chunk.steps:
-                        state.pop(step.rhs, None)
+            def _one_batch(_idx=idx, _acc=acc, _start=start, _b=b):
+                _faults.fault_point("chunked.batch", start=_start, batch=_b)
+                last_ci = len(chunks) - 1
+                state = dict(enumerate(device_full))
+                a = _acc
+                for ci, (chunk, fn) in enumerate(zip(chunks, chunk_fns)):
+                    ins = tuple(state[s] for s in chunk.in_slots)
+                    if ci == last_ci:
+                        a = fn(ins, _idx, a)
+                    else:
+                        outs = fn(ins, _idx)
+                        for slot, buf in zip(chunk.out_slots, outs):
+                            state[slot] = buf
+                        for step in chunk.steps:
+                            state.pop(step.rhs, None)
+                if sync:
+                    jax.block_until_ready(a)
+                return a
+
+            try:
+                # transient failures (preemption, disconnect) retry the
+                # same batch — nothing was accumulated until the last
+                # chunk's dispatch returns
+                acc = _retry.retry_call(_one_batch, label="chunked.batch")
+            except Exception as exc:  # noqa: BLE001 — classified below
+                cls = _retry.classify_exception(exc)
+                if cls is _retry.FailureClass.RESOURCE and batch > 1:
+                    # OOM ladder rung 1: halve the slice batch (still a
+                    # divisor of num and of the current cursor) and retry
+                    # this range with a recompiled chunk plan
+                    batch = max(1, batch // 2)
+                    logger.warning(
+                        "chunked dispatch hit a resource error (%s); "
+                        "degrading slice batch to %d", exc, batch,
+                    )
+                    obs.counter_add("resilience.degrade.batch_shrink")
+                    obs.gauge_set("resilience.degrade.batch", batch)
+                    chunks, chunk_fns = _compiled_plan(
+                        sp, batch, chunk_steps, split_complex, precision
+                    )
+                    continue
+                raise
+            dispatches += len(chunks)
+            start += b
+            if mgr is not None:
+                mgr.maybe_save(
+                    start,
+                    lambda _a=acc: _flatten_acc(_a, split_complex),
+                )
         if obs.enabled():
             osp.add(
-                slices=num,
-                dispatches=len(chunks) * -(-num // batch),
-                flops=num * steps_flops(sp.program.steps),
+                slices=num - start0,
+                dispatches=dispatches,
+                flops=(num - start0) * steps_flops(sp.program.steps),
             )
+        if mgr is not None:
+            mgr.finalize()
         # fold the compensation in (two tiny dispatches, untimed-scale cost)
         if split_complex:
             (sr, cr), (si, ci) = acc
             return (sr + cr, si + ci)
         return acc[0] + acc[1]
+
+
+def _flatten_acc(acc, split_complex: bool) -> list:
+    """Kahan accumulator tree → flat array list (checkpoint payload)."""
+    if split_complex:
+        (sr, cr), (si, ci) = acc
+        return [sr, cr, si, ci]
+    return [acc[0], acc[1]]
+
+
+def _unflatten_acc(arrs, split_complex: bool, place):
+    """Checkpoint payload → device-resident Kahan accumulator tree."""
+    if split_complex:
+        sr, cr, si, ci = (place(a) for a in arrs)
+        return ((sr, cr), (si, ci))
+    s, c = (place(a) for a in arrs)
+    return (s, c)

@@ -156,6 +156,7 @@ def execute_sliced_numpy(
     dtype=np.complex128,
     max_slices: int | None = None,
     hoist: bool = False,
+    ckpt: str | None = None,
 ) -> np.ndarray:
     """CPU oracle: python loop over slices, sum of program results.
 
@@ -163,8 +164,15 @@ def execute_sliced_numpy(
     baselines that extrapolate from a slice subset. ``hoist=True``
     computes the slice-invariant stem once and loops only the residual
     program (numerically identical — the same step kernels run in the
-    same order, just not once per slice).
+    same order, just not once per slice). ``ckpt`` (or ``TNC_TPU_CKPT``)
+    arms slice-range checkpointing — the partial sum + cursor persist
+    and an interrupted oracle run resumes bit-identically
+    (:mod:`tnc_tpu.resilience.checkpoint`); minutes-per-slice oracle
+    work is exactly what should never restart from slice 0.
     """
+    from tnc_tpu.resilience import checkpoint as _ckpt
+    from tnc_tpu.resilience import faultinject as _faults
+
     full = [np.asarray(a, dtype=dtype) for a in arrays]
     if hoist:
         from tnc_tpu.ops.hoist import hoist_sliced_program, run_prelude
@@ -184,16 +192,40 @@ def execute_sliced_numpy(
     num = sp.slicing.num_slices
     if max_slices is not None:
         num = min(num, max_slices)
+    ckpt_path = _ckpt.resolve_ckpt(ckpt)
+    mgr = None
+    start = 0
+    if ckpt_path is not None:
+        # arrays_digest: the program signature is structural — same
+        # circuit with different leaf data must not cross-resume
+        sig = _ckpt.signature_hash(
+            "numpy-v1", sp.signature(), str(np.dtype(dtype)), num, hoist,
+            _ckpt.arrays_digest(arrays),
+        )
+        mgr = _ckpt.SliceCheckpoint(ckpt_path, sig)
+        loaded = mgr.load()
+        if loaded is not None:
+            start, (saved,) = loaded
+            start = max(0, min(start, num))
+            acc = np.asarray(saved, dtype=dtype)
     with obs.span("sliced.residual", executor="numpy") as osp:
-        for s in range(num):
+        for s in range(start, num):
+            _faults.fault_point("sliced.slice", s=s)
             indices = _slice_indices(sp.slicing, s)
             buffers = [
                 index_buffer(np, arr, info, indices)
                 for arr, info in zip(full, sp.slot_slices)
             ]
             acc = acc + _run_steps(np, sp.program, buffers)
+            if mgr is not None:
+                mgr.maybe_save(s + 1, lambda _a=acc: [_a])
         if obs.enabled():
-            osp.add(slices=num, flops=num * steps_flops(sp.program.steps))
+            osp.add(
+                slices=num - start,
+                flops=(num - start) * steps_flops(sp.program.steps),
+            )
+    if mgr is not None:
+        mgr.finalize()
     return acc.reshape(sp.program.result_shape)
 
 

@@ -1,6 +1,7 @@
 from tnc_tpu.parallel.partitioned import (  # noqa: F401
     Communication,
     DeviceTensorMapping,
+    PartitionExecutionError,
     distributed_partitioned_contraction,
     intermediate_reduce,
     local_contract_partitions,

@@ -50,8 +50,26 @@ from tnc_tpu.ops.program import (
     _pair_step,
     build_program,
 )
+from tnc_tpu.resilience import faultinject as _faults
+from tnc_tpu.resilience import retry as _retry
 from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
 from tnc_tpu.tensornetwork.tensordata import TensorData
+
+
+class PartitionExecutionError(RuntimeError):
+    """A partition's local contraction failed; names the partition index
+    and device slot so a pool-surfaced XLA error is attributable
+    (``pool.map`` otherwise raises a bare runtime error with no hint of
+    which partition died). Chains the original (``__cause__``)."""
+
+    def __init__(self, partition: int, device: int, original: BaseException):
+        super().__init__(
+            f"partition {partition} on device {device} failed: "
+            f"{type(original).__name__}: {original}"
+        )
+        self.partition = partition
+        self.device = device
+        self.original = original
 
 def _fanin_survivor(k: int, toplevel: Sequence[tuple[int, int]]) -> int:
     """Index that holds the final tensor after a replace-left fan-in."""
@@ -351,12 +369,31 @@ def local_contract_partitions(
     def run_job(i, fn, bufs):
         # runs on the pool worker thread, so each partition's span lands
         # on its own timeline lane (tid) in the exported trace
+        dev = comm.mapping.device(i)
         with obs.span(
             "partitioned.local_partition",
             partition=i,
-            device=comm.mapping.device(i),
+            device=dev,
         ):
-            return fn(bufs)
+            # transient failures retry THIS partition in place (bounded,
+            # shared policy) instead of killing the pool with the other
+            # partitions' finished work; anything that survives the
+            # retries is re-raised naming the partition and device
+            def _attempt():
+                _faults.fault_point("partition.local", partition=i, device=dev)
+                return fn(bufs)
+
+            try:
+                # unsliced partition programs dispatch with donated
+                # inputs (jit_program default), so the donation guard
+                # blocks retries once a failed dispatch consumed them
+                return _retry.default_policy().run(
+                    _attempt,
+                    label="partition.local",
+                    classify=_retry.donation_guarded_classify(bufs),
+                )
+            except Exception as exc:  # noqa: BLE001 — annotate and re-raise
+                raise PartitionExecutionError(i, dev, exc) from exc
 
     jobs = [
         (i, compile_one(i, program), list(bufs))

@@ -25,6 +25,8 @@ from tnc_tpu import obs
 from tnc_tpu.contractionpath.contraction_path import ContractionPath
 from tnc_tpu.contractionpath.slicing import Slicing
 from tnc_tpu.ops.backends import _run_steps
+from tnc_tpu.resilience import faultinject as _faults
+from tnc_tpu.resilience import retry as _retry
 from tnc_tpu.ops.program import flat_leaf_tensors
 from tnc_tpu.ops.sliced import SlicedProgram, build_sliced_program
 from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
@@ -351,6 +353,10 @@ def distributed_sliced_contraction(
         devices=n_dev,
         hoisted=hp is not None,
     ) as osp:
+        # transient runtime failures (preemption notice on one chip, ICI
+        # hiccup) retry the whole SPMD dispatch under the shared policy —
+        # the computation is replicated-input + psum, so a re-dispatch is
+        # exact; OOM propagates to the caller's degradation ladder
         if split_complex:
             from tnc_tpu.ops.split_complex import combine_array, split_array
 
@@ -359,14 +365,26 @@ def distributed_sliced_contraction(
             for leaf in leaves:
                 re, im = split_array(leaf.data.into_data(), part_dtype)
                 arrays.append((jnp.asarray(re), jnp.asarray(im)))
-            re, im = fn(*arrays)
-            result = combine_array(re, im).reshape(sp.program.result_shape)
         else:
             arrays = [
                 jnp.asarray(leaf.data.into_data(), dtype=dtype)
                 for leaf in leaves
             ]
-            result = np.asarray(fn(*arrays)).reshape(sp.program.result_shape)
+
+        def _dispatch():
+            _faults.fault_point("spmd.dispatch")
+            out = fn(*arrays)
+            if _retry.sync_dispatch():
+                jax.block_until_ready(out)
+            return out
+
+        if split_complex:
+            re, im = _retry.retry_call(_dispatch, label="spmd.dispatch")
+            result = combine_array(re, im).reshape(sp.program.result_shape)
+        else:
+            result = np.asarray(
+                _retry.retry_call(_dispatch, label="spmd.dispatch")
+            ).reshape(sp.program.result_shape)
         if obs.enabled():
             from tnc_tpu.ops.program import steps_flops
 

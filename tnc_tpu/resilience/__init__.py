@@ -1,0 +1,51 @@
+"""tnc_tpu.resilience — fault-tolerant execution for long-running jobs.
+
+Four pieces, threaded through the execution stack (see
+``docs/resilience.md``):
+
+- :mod:`~tnc_tpu.resilience.retry` — exception classification
+  (TRANSIENT / RESOURCE / FATAL) + the shared bounded-backoff
+  :class:`RetryPolicy` applied at every device-dispatch boundary.
+- :mod:`~tnc_tpu.resilience.checkpoint` — atomic slice-range
+  checkpoints (``TNC_TPU_CKPT``): the chunked/numpy sliced executors
+  persist the partial accumulator + next-slice cursor and resume
+  bit-identically after a crash.
+- :mod:`~tnc_tpu.resilience.degrade` — the OOM degradation ladder
+  (smaller slice batch → finer slicing → chunked host-loop fallback).
+- :mod:`~tnc_tpu.resilience.faultinject` — deterministic scripted
+  failures (``TNC_TPU_FAULTS``) at the same boundaries, so every
+  recovery path above is unit-testable on CPU.
+
+Everything is env/arg-gated with a no-op fast path; with no resilience
+env vars set the hot paths pay one bool/dict check (pinned by
+``tests/test_resilience.py``).
+"""
+
+from tnc_tpu.resilience.checkpoint import (  # noqa: F401
+    SliceCheckpoint,
+    resolve_ckpt,
+    signature_hash,
+)
+from tnc_tpu.resilience.degrade import execute_sliced_resilient  # noqa: F401
+from tnc_tpu.resilience.faultinject import (  # noqa: F401
+    InjectedFault,
+    InjectedFatal,
+    InjectedOOM,
+    InjectedTransient,
+    configure_faults,
+    fault_point,
+    faults,
+)
+from tnc_tpu.resilience.retry import (  # noqa: F401
+    FailureClass,
+    RetryExhaustedError,
+    RetryPolicy,
+    buffers_alive,
+    classify_exception,
+    classify_pool_failure,
+    configure_retry,
+    default_policy,
+    donation_guarded_classify,
+    pool_map_with_retry,
+    retry_call,
+)
