@@ -57,9 +57,20 @@ Env knobs:
   BENCH_OBS (1; tnc_tpu.obs span/metric recording — the per-phase
     "phases" breakdown in the JSON record and the Chrome-trace export;
     0 disables both),
+  BENCH_CALIBRATE (1; sycamore config — one extra UNTIMED complex64
+    oracle slice with per-step spans on feeds the record's
+    "calibration" block without perturbing any timed region; 0 skips
+    the pass, ~minutes of host work on the full north-star),
   BENCH_TRACE_JSON (bench_trace.json next to this file; where the
     Chrome-trace/Perfetto timeline of the run is written — load it in
     ui.perfetto.dev; docs/observability.md)
+
+The JSON record also gains "rep_stats" (per-rep timing spread, the
+perf gate's noise model — scripts/perf_gate.py) and "calibration"
+(fitted effective device model + cost-model error percentiles + the
+worst-mispredicted steps, from the run's per-step spans —
+tnc_tpu/obs/calibrate.py; set TNC_TPU_STEP_TIME=1 to add device-side
+per-step samples, at the cost of eager step-by-step dispatch).
 
 Flags: ``--resume`` arms slice-range checkpointing (sets TNC_TPU_CKPT
 to .cache/bench_ckpt unless already set): a run killed mid-slice-range
@@ -214,7 +225,7 @@ def _current_target_log2() -> float:
     )
 
 
-def _time_backend(run, reps):
+def _time_backend(run, reps, region="run"):
     """Median wall-clock of ``run()`` over ``reps`` after one warmup.
 
     ``run()`` may return device arrays (host=False executors) — timing
@@ -243,6 +254,11 @@ def _time_backend(run, reps):
             out = run()
             jax.block_until_ready(out)
         times.append(time.monotonic() - t0)
+        # per-rep histogram, labeled by timed region: the perf gate's
+        # noise estimate is the WITHIN-region rep spread — pooling the
+        # probe with the full run would read their level difference as
+        # noise and saturate the gate's tolerance
+        obs.observe("bench.rep_s", times[-1], region=region)
     log(f"[bench] runs: {[round(t, 4) for t in times]}")
     return float(np.median(times)), out
 
@@ -274,15 +290,23 @@ def _time_pipelined(bound, reps, calls=None):
                 out = bound()
             jax.block_until_ready(out)
         times.append((time.monotonic() - t0) / calls)
+        obs.observe("bench.rep_s", times[-1], region="pipelined")
     log(f"[bench] pipelined per-eval (x{calls}): "
         f"{[round(t * 1e3, 4) for t in times]} ms")
     return float(np.median(times)), calls, out
 
 
-def _time_numpy(run, reps):
+def _time_numpy(run, reps, calibration_run=None):
     """CPU-oracle counterpart of :func:`_time_pipelined`: same
     steady-state contract (arrays already in memory, repeated
-    evaluation), median per-eval over ``reps`` regions."""
+    evaluation), median per-eval over ``reps`` regions.
+
+    ``run`` must execute with per-step spans OFF (``step_spans=False``)
+    so span bookkeeping never sits inside the timed region — on
+    tiny-step programs it would rival the steps themselves and inflate
+    the published baseline. ``calibration_run`` (same work, step spans
+    on) is invoked ONCE, untimed, afterwards: the per-step calibration
+    samples without the measurement distortion."""
     from tnc_tpu import obs
 
     run()  # warmup: allocator + BLAS thread pools
@@ -292,6 +316,9 @@ def _time_numpy(run, reps):
         with obs.span("bench.cpu_baseline"):
             run()
         times.append(time.monotonic() - t0)
+        obs.observe("bench.rep_s", times[-1], region="cpu_baseline")
+    if calibration_run is not None and obs.enabled():
+        calibration_run()
     return float(np.median(times))
 
 
@@ -536,6 +563,7 @@ def bench_sycamore_amplitude():
                 sp, arrays, max_slices=probe, host=False
             ),
             reps,
+            region="probe",
         )
     per_slice = probe_s / probe
     projected = per_slice * num
@@ -556,6 +584,7 @@ def bench_sycamore_amplitude():
                     sp, arrays, max_slices=probe, host=False, hoist=False
                 ),
                 reps,
+                region="hoist_ab_naive",
             )
         extra["probe_s_hoisted"] = round(probe_s, 4)
         extra["probe_s_naive"] = round(naive_probe_s, 4)
@@ -573,7 +602,9 @@ def bench_sycamore_amplitude():
         # cheap enough: run and time ALL slices (the honest number)
         with obs.span("bench.full_run", slices=num):
             tpu_s, amp = _time_backend(
-                lambda: backend.execute_sliced(sp, arrays, host=False), reps
+                lambda: backend.execute_sliced(sp, arrays, host=False),
+                reps,
+                region="full_run",
             )
     else:
         tpu_s = projected
@@ -698,6 +729,20 @@ def bench_sycamore_amplitude():
     else:
         log(f"[bench] parity UNMEASURED: {parity_skip_reason}")
         extra["parity_unmeasured"] = parity_skip_reason
+
+    # -- calibration pass: one untimed complex64 slice with per-step
+    # spans ON — the numpy-side samples obs.calibrate fits the record's
+    # "calibration" block from. The timed baseline above runs with
+    # spans OFF (bookkeeping must never sit inside a published timed
+    # region); this pass is host-only work (safe on accelerator runs —
+    # it never touches the device). BENCH_CALIBRATE=0 skips it.
+    if obs.enabled() and os.environ.get("BENCH_CALIBRATE", "1") != "0":
+        from tnc_tpu.ops.sliced import execute_sliced_numpy
+
+        with obs.span("bench.calibration_pass", slices=1):
+            execute_sliced_numpy(
+                sp, arrays, dtype=np.complex64, max_slices=1
+            )
 
     # -- CPU baseline: same program, serial slice subset, extrapolated -----
     # (rounds 1-3 methodology: slices are identical work by construction)
@@ -828,7 +873,14 @@ def _oracle_artifact(cache, plan_key, sp, arrays, n_sub, n_time) -> dict:
         )
     if obj.get("cpu_timed_slices", 0) < n_time:
         t0 = time.monotonic()
-        execute_sliced_numpy(sp, arrays, dtype=np.complex64, max_slices=n_time)
+        # step_spans=False: the published (and disk-cached) baseline
+        # seconds must not include per-step span bookkeeping; the
+        # calibration sample comes from a separate untimed pass
+        # (bench_sycamore_amplitude's bench.calibration_pass)
+        execute_sliced_numpy(
+            sp, arrays, dtype=np.complex64, max_slices=n_time,
+            step_spans=False,
+        )
         obj["cpu_per_slice_s"] = (time.monotonic() - t0) / n_time
         obj["cpu_timed_slices"] = n_time
         cache.store_obj(okey, obj)
@@ -1109,7 +1161,10 @@ def bench_ghz3():
         raise BenchCheckError(f"ghz3 amplitude wrong: {sv[0]} vs 1/sqrt(2)")
 
     cpu = NumpyBackend(dtype=np.complex64)
-    cpu_s = _time_numpy(lambda: cpu.execute(program, arrays), reps)
+    cpu_s = _time_numpy(
+        lambda: cpu.execute(program, arrays, step_spans=False), reps,
+        calibration_run=lambda: cpu.execute(program, arrays),
+    )
     extra = {"timing": "pipelined-steady-state", "pipeline_calls": calls}
     return ("ghz3_statevector_wallclock", tpu_s,
             cpu_s / tpu_s if tpu_s else 0.0, extra)
@@ -1144,7 +1199,10 @@ def bench_random20():
         raise BenchCheckError(f"random20 statevector norm wrong: {norm}")
 
     cpu = NumpyBackend(dtype=np.complex64)
-    cpu_s = _time_numpy(lambda: cpu.execute(program, arrays), reps)
+    cpu_s = _time_numpy(
+        lambda: cpu.execute(program, arrays, step_spans=False), reps,
+        calibration_run=lambda: cpu.execute(program, arrays),
+    )
     extra = {"timing": "pipelined-steady-state", "pipeline_calls": calls}
     return ("random20_d12_statevector_wallclock", tpu_s,
             cpu_s / tpu_s if tpu_s else 0.0, extra)
@@ -1191,7 +1249,10 @@ def bench_qaoa30():
     log(f"[bench] <Z...Z> = {ev}")
 
     cpu = NumpyBackend(dtype=np.complex64)
-    cpu_s = _time_numpy(lambda: cpu.execute(program, arrays), reps)
+    cpu_s = _time_numpy(
+        lambda: cpu.execute(program, arrays, step_spans=False), reps,
+        calibration_run=lambda: cpu.execute(program, arrays),
+    )
     extra = {"timing": "pipelined-steady-state", "pipeline_calls": calls}
     return (f"qaoa{qubits}_expectation_wallclock", tpu_s,
             cpu_s / tpu_s if tpu_s else 0.0, extra)
@@ -1726,6 +1787,34 @@ def _attach_obs_breakdown(record: dict, obs) -> None:
                 record.setdefault("jit_cache", {})[
                     key.split(".")[1]
                 ] = int(counters[key])
+        # per-rep timing spread, one entry per timed region: the perf
+        # gate's noise model (scripts/perf_gate.py) reads the
+        # within-region spread — regions deliberately differ in level
+        # (probe vs full run), so they must not share one histogram
+        hists = obs.get_registry().histograms()
+        rep_stats = {}
+        for (name, labels), h in sorted(hists.items()):
+            if name != "bench.rep_s":
+                continue
+            region = dict(labels).get("region", "run")
+            rep_stats[region] = {
+                "count": int(h["count"]),
+                "min_s": round(h["min"], 6),
+                "max_s": round(h["max"], 6),
+                "mean_s": round(h["sum"] / max(h["count"], 1), 6),
+            }
+        if rep_stats:
+            record["rep_stats"] = rep_stats
+        # cost-model calibration: fitted device model + prediction-error
+        # distribution from whatever per-step spans the run recorded
+        # (numpy-oracle steps always; device steps under TNC_TPU_STEP_TIME)
+        from tnc_tpu.obs import calibrate as _calibrate
+
+        cal = _calibrate.calibration_report()
+        if cal is not None:
+            record["calibration"] = cal
+            log("[bench] cost-model calibration:")
+            log(_calibrate.format_calibration_table(cal))
         # resilience activity (retries, degradation rungs, checkpoint
         # saves/resumes, fired faults): read BEFORE the trace export so
         # an unwritable trace path cannot drop the recovery record of

@@ -30,6 +30,27 @@ obs.export_chrome_trace("/tmp/tnc_tpu_check_trace.json")
 PY
 python scripts/trace_summarize.py /tmp/tnc_tpu_check_trace.json > /dev/null
 
+echo "== perf gate (CPU smoke: fresh baseline vs itself + injected 2x slowdown) =="
+BENCH_CONFIG=ghz3 BENCH_FORCE_CPU=1 BENCH_REPS=2 BENCH_PIPELINE_CALLS=4 \
+  TNC_TPU_PLATFORM=cpu python bench.py > /tmp/tnc_tpu_perf_baseline.json
+python scripts/perf_gate.py /tmp/tnc_tpu_perf_baseline.json /tmp/tnc_tpu_perf_baseline.json
+python - <<'PY'
+import json
+rec = json.load(open("/tmp/tnc_tpu_perf_baseline.json"))
+assert "calibration" in rec, "bench record is missing the calibration block"
+assert "rep_stats" in rec, "bench record is missing rep_stats"
+rec["value"] *= 2
+json.dump(rec, open("/tmp/tnc_tpu_perf_slow.json", "w"))
+PY
+# exit code must be exactly 1 (regression): 0 = slowdown missed,
+# 2 = the gate never evaluated it (unusable input) — both are failures
+gate_rc=0
+python scripts/perf_gate.py /tmp/tnc_tpu_perf_baseline.json /tmp/tnc_tpu_perf_slow.json || gate_rc=$?
+if [ "$gate_rc" -ne 1 ]; then
+  echo "perf gate did not flag the injected 2x slowdown as a regression (rc=$gate_rc)" >&2
+  exit 1
+fi
+
 echo "== crash-resume smoke (SIGKILL mid-range, resume, compare to golden) =="
 TNC_TPU_PLATFORM=cpu python scripts/crash_resume_smoke.py
 

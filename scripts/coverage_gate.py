@@ -40,6 +40,12 @@ REQUIRED_SUBPACKAGES = (
     "tensornetwork",
 )
 
+# Individual modules the report must include (a subpackage can stay
+# present while a new module inside it silently vanishes):
+REQUIRED_MODULES = (
+    os.path.join("tnc_tpu", "obs", "calibrate.py"),
+)
+
 executed: set[tuple[str, int]] = set()
 
 
@@ -121,6 +127,16 @@ def main() -> int:
         print(
             f"coverage gate: subpackages missing from the report: "
             f"{missing_pkgs}",
+            file=sys.stderr,
+        )
+        return 1
+
+    seen_files = {rel for rel, _, _ in per_file}
+    missing_mods = [m for m in REQUIRED_MODULES if m not in seen_files]
+    if missing_mods:
+        print(
+            f"coverage gate: modules missing from the report: "
+            f"{missing_mods}",
             file=sys.stderr,
         )
         return 1

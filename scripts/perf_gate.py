@@ -1,0 +1,204 @@
+#!/usr/bin/env python
+"""Noise-aware performance regression gate over bench.py JSON records.
+
+Compares a fresh benchmark record against a committed baseline record
+(same ``BENCH_CONFIG``) and exits nonzero on a statistically
+significant slowdown. "Significant" is noise-aware: the allowed ratio
+grows with the per-rep timing spread both records carry in their
+``rep_stats`` field, floored at ``--min-tol`` so micro-jitter never
+fails a build and capped at ``--max-tol`` so a genuine 2x regression
+always does, however noisy the samples claim to be.
+
+Besides the headline wall-clock, the gate cross-checks (warnings, not
+failures, unless ``--strict``):
+
+- per-phase times (the record's ``phases`` breakdown) — localizes a
+  regression to planning / probe / oracle before anyone opens a trace;
+- the calibrated device model (``calibration.flops_per_s``) — a drop in
+  achieved throughput with unchanged wall-clock means the run did less
+  work, not that the hardware got slower.
+
+Exit codes: 0 pass, 1 regression, 2 unusable input (missing files,
+error records, mismatched metrics).
+
+Usage:
+    python scripts/perf_gate.py BASELINE.json CANDIDATE.json
+    python scripts/perf_gate.py --min-tol 0.15 base.json cand.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_record(path: str) -> dict:
+    """Read a bench record: a JSON file, or a log whose last line is the
+    record (bench.py prints exactly one JSON line to stdout)."""
+    with open(path, encoding="utf-8") as fh:
+        text = fh.read()
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        for line in reversed(text.splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                return json.loads(line)
+        raise
+
+
+def _region_noise(stats: dict) -> float:
+    mean = float(stats.get("mean_s", 0.0))
+    if mean <= 0.0:
+        return 0.0
+    spread = float(stats.get("max_s", 0.0)) - float(stats.get("min_s", 0.0))
+    return max(spread / mean, 0.0)
+
+
+def rel_noise(record: dict) -> float:
+    """Relative per-rep spread of a record: the worst WITHIN-region
+    (max - min) / mean over the timed reps. ``rep_stats`` is keyed by
+    timed region (probe, full_run, pipelined, ...) — regions differ in
+    level by design, so only the spread inside each counts as noise. A
+    flat single-region dict is accepted too. 0.0 when the record
+    carries no rep_stats (old baseline or single-rep run)."""
+    stats = record.get("rep_stats")
+    if not isinstance(stats, dict):
+        return 0.0
+    if "mean_s" in stats:  # flat single-region shape
+        return _region_noise(stats)
+    return max(
+        (_region_noise(s) for s in stats.values() if isinstance(s, dict)),
+        default=0.0,
+    )
+
+
+def allowed_ratio(
+    base: dict, cand: dict, min_tol: float, max_tol: float, sigma: float
+) -> float:
+    """Candidate/baseline wall-clock ratio the gate accepts: 1 + the
+    larger of the noise-scaled spread and the floor, capped."""
+    noise = max(rel_noise(base), rel_noise(cand))
+    return 1.0 + min(max(min_tol, sigma * noise), max_tol)
+
+
+def compare(
+    base: dict,
+    cand: dict,
+    min_tol: float = 0.10,
+    max_tol: float = 0.60,
+    sigma: float = 2.0,
+    phase_tol: float = 0.75,
+    phase_floor_s: float = 0.05,
+) -> tuple[int, list[str]]:
+    """Gate logic; returns (exit_code, messages). Pure on dicts so the
+    tests drive it without subprocesses."""
+    msgs: list[str] = []
+    for name, rec in (("baseline", base), ("candidate", cand)):
+        if "error" in rec:
+            return 2, [f"{name} record carries an error: {rec['error']}"]
+        if "value" not in rec:
+            return 2, [f"{name} record has no value field"]
+    if base.get("metric") != cand.get("metric"):
+        return 2, [
+            f"metric mismatch: baseline {base.get('metric')!r} vs "
+            f"candidate {cand.get('metric')!r} — records are not comparable"
+        ]
+    base_s, cand_s = float(base["value"]), float(cand["value"])
+    if base_s <= 0.0:
+        return 2, [f"baseline value {base_s} is not a usable wall-clock"]
+
+    ratio = cand_s / base_s
+    allowed = allowed_ratio(base, cand, min_tol, max_tol, sigma)
+    verdict = 0
+    msgs.append(
+        f"{base.get('metric')}: baseline {base_s:.4g}s -> candidate "
+        f"{cand_s:.4g}s (ratio {ratio:.3f}, allowed {allowed:.3f}, "
+        f"noise {max(rel_noise(base), rel_noise(cand)):.1%})"
+    )
+    if ratio > allowed:
+        verdict = 1
+        msgs.append(
+            f"REGRESSION: candidate is {ratio:.2f}x the baseline "
+            f"wall-clock (allowed {allowed:.2f}x)"
+        )
+    elif ratio < 1.0 / allowed:
+        msgs.append(f"improvement: {1.0 / ratio:.2f}x faster than baseline")
+
+    # per-phase localization (warn-only by default: phases double-count
+    # nothing but are noisier than the headline median)
+    bp, cp = base.get("phases") or {}, cand.get("phases") or {}
+    for phase in sorted(set(bp) & set(cp)):
+        b, c = float(bp[phase]), float(cp[phase])
+        if b < phase_floor_s and c < phase_floor_s:
+            continue
+        if b > 0 and c / b > 1.0 + phase_tol:
+            msgs.append(
+                f"warning: phase {phase} regressed {c / b:.2f}x "
+                f"({b:.3f}s -> {c:.3f}s)"
+            )
+
+    # calibrated throughput cross-check
+    bc, cc = base.get("calibration") or {}, cand.get("calibration") or {}
+    bf, cf = bc.get("flops_per_s"), cc.get("flops_per_s")
+    if bf and cf and cf < bf / 1.5:
+        msgs.append(
+            f"warning: calibrated throughput dropped "
+            f"{bf / cf:.2f}x ({bf:.3g} -> {cf:.3g} FLOP/s)"
+        )
+    return verdict, msgs
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Noise-aware bench.py regression gate"
+    )
+    parser.add_argument("baseline", help="committed baseline record (JSON)")
+    parser.add_argument("candidate", help="fresh bench record (JSON)")
+    parser.add_argument(
+        "--min-tol", type=float, default=0.10,
+        help="slowdown tolerance floor even on noiseless records "
+             "(default 0.10 = 10%%)",
+    )
+    parser.add_argument(
+        "--max-tol", type=float, default=0.60,
+        help="tolerance cap: no amount of claimed noise excuses a "
+             "slowdown beyond 1+cap (default 0.60)",
+    )
+    parser.add_argument(
+        "--sigma", type=float, default=2.0,
+        help="noise multiplier applied to the rep spread (default 2.0)",
+    )
+    parser.add_argument(
+        "--strict", action="store_true",
+        help="phase regressions fail the gate instead of warning",
+    )
+    args = parser.parse_args(argv)
+
+    try:
+        base = load_record(args.baseline)
+        cand = load_record(args.candidate)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"perf gate: cannot load records: {e}", file=sys.stderr)
+        return 2
+
+    code, msgs = compare(
+        base, cand, min_tol=args.min_tol, max_tol=args.max_tol,
+        sigma=args.sigma,
+    )
+    warned = any(m.startswith("warning:") for m in msgs)
+    for m in msgs:
+        print(f"perf gate: {m}", file=sys.stderr if code else sys.stdout)
+    if code == 0 and args.strict and warned:
+        print("perf gate: FAILED (--strict: warnings above)", file=sys.stderr)
+        return 1
+    if code == 1:
+        print("perf gate: FAILED", file=sys.stderr)
+    elif code == 0:
+        print("perf gate: OK")
+    return code
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -7,9 +7,17 @@ Reads a Chrome-trace JSON produced by ``tnc_tpu.obs.export_chrome_trace``
 per span name: call count, total wall time, time share, and the summed
 span counters (flops, slices, dispatches, ...).
 
+``--roofline`` switches to predicted-vs-measured mode: every stage that
+carried a flops/bytes counter (per-step ``step[i] MxK·KxN`` spans, the
+hoisted ``sliced.prelude`` / ``sliced.residual`` phases, ...) is printed
+with its achieved throughput (GFLOP/s, GB/s) over its measured wall
+time — the roofline view of where the cost model and the hardware
+disagree (docs/observability.md).
+
 Usage:
     python scripts/trace_summarize.py bench_trace.json
     python scripts/trace_summarize.py --top 10 bench_trace.json
+    python scripts/trace_summarize.py --roofline bench_trace.json
 """
 
 from __future__ import annotations
@@ -30,6 +38,11 @@ def main(argv: list[str] | None = None) -> int:
         "--top", type=int, default=0,
         help="show only the N most expensive stages (default: all)",
     )
+    parser.add_argument(
+        "--roofline", action="store_true",
+        help="per-stage predicted flops/bytes and achieved throughput "
+             "instead of the plain time table",
+    )
     args = parser.parse_args(argv)
 
     from tnc_tpu.obs.export import (
@@ -42,6 +55,22 @@ def main(argv: list[str] | None = None) -> int:
     if not rows:
         print("no spans in trace", file=sys.stderr)
         return 1
+    if args.roofline:
+        from tnc_tpu.obs.calibrate import format_roofline_table, roofline_rows
+
+        rrows = roofline_rows(rows)
+        if not rrows:
+            print(
+                "no stages with flops/bytes counters in trace "
+                "(record with TNC_TPU_TRACE and flops-instrumented "
+                "executors)",
+                file=sys.stderr,
+            )
+            return 1
+        if args.top > 0:
+            rrows = rrows[: args.top]
+        print(format_roofline_table(rrows))
+        return 0
     if args.top > 0:
         rows = rows[: args.top]
     print(format_summary_table(rows))

@@ -1,0 +1,524 @@
+"""Cost-model calibration (tnc_tpu.obs.calibrate) + the perf gate.
+
+Pins the new predicted-vs-measured loop: per-step spans carry the
+program's predicted flops/bytes next to measured wall time; the
+least-squares device-model fit recovers known synthetic constants; the
+error report names a deliberately mispredicted step; the perf gate
+passes a record against itself and fails an injected 2x slowdown; and
+the disabled path (``TNC_TPU_STEP_TIME`` unset) keeps the JAX backend
+on its compiled dispatch — no per-step sync.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import tnc_tpu.obs as obs
+from tnc_tpu.obs import calibrate
+from tnc_tpu.obs.calibrate import (
+    CalibratedCostModel,
+    StepSample,
+    aggregate_samples,
+    error_report,
+    fit_device_model,
+    step_samples,
+)
+from tnc_tpu.obs.core import MetricsRegistry, SpanRecord
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def enabled_obs():
+    reg = obs.configure(enabled=True, registry=MetricsRegistry(),
+                        step_time=False)
+    try:
+        yield reg
+    finally:
+        obs.configure(enabled=False, registry=MetricsRegistry(),
+                      step_time=False)
+
+
+def _perf_gate():
+    spec = importlib.util.spec_from_file_location(
+        "perf_gate", os.path.join(REPO, "scripts", "perf_gate.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _synthetic_samples(F=2e11, B=5e10, c=1e-4, n=12, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        flops = float(rng.integers(1, 100)) * 1e8
+        nbytes = float(rng.integers(1, 100)) * 1e7
+        out.append(
+            StepSample(f"step[{i}] synth", flops, nbytes,
+                       flops / F + nbytes / B + c)
+        )
+    return out
+
+
+def _small_program():
+    from tnc_tpu.contractionpath.contraction_path import ContractionPath
+    from tnc_tpu.ops.program import build_program
+    from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+    from tnc_tpu.tensornetwork.tensordata import TensorData
+
+    rng = np.random.default_rng(0)
+
+    def mk(legs):
+        return LeafTensor(
+            legs, [4] * len(legs),
+            TensorData.matrix(rng.standard_normal([4] * len(legs))),
+        )
+
+    tn = CompositeTensor([mk([0, 1]), mk([1, 2]), mk([2, 3]), mk([3, 0])])
+    path = ContractionPath.simple([(0, 3), (0, 1), (0, 2)])
+    program = build_program(tn, path)
+    arrays = [t.data.into_data() for t in tn.tensors]
+    return program, arrays
+
+
+# -- model fit ----------------------------------------------------------
+
+
+def test_fit_recovers_synthetic_constants():
+    F, B, c = 2e11, 5e10, 1e-4
+    model = fit_device_model(_synthetic_samples(F, B, c))
+    assert model.terms == ("flops", "bytes", "dispatch")
+    assert abs(model.flops_per_s - F) / F < 1e-6
+    assert abs(model.bytes_per_s - B) / B < 1e-6
+    assert abs(model.dispatch_s - c) / c < 1e-6
+    # the fitted model predicts its own samples exactly
+    rep = error_report(_synthetic_samples(F, B, c), model)
+    assert rep["error_max"] < 1e-6
+
+
+def test_fit_degrades_to_fewer_terms():
+    # flops-only samples can't identify a bandwidth term
+    F = 1e11
+    samples = [
+        StepSample(f"step[{i}] x", float(i) * 1e9, 0.0, float(i) * 1e9 / F)
+        for i in range(1, 6)
+    ]
+    model = fit_device_model(samples)
+    assert model is not None
+    assert model.bytes_per_s is None
+    assert abs(model.flops_per_s - F) / F < 1e-6
+
+
+def test_fit_needs_two_samples():
+    assert fit_device_model([]) is None
+    assert fit_device_model([StepSample("step[0] x", 1e9, 0.0, 0.1)]) is None
+
+
+def test_error_report_flags_mispredicted_step():
+    samples = _synthetic_samples()
+    model = fit_device_model(samples)
+    slow = StepSample(
+        "step[99] pathological", 1e8, 1e7,
+        10.0 * model.predict_s(1e8, 1e7),
+    )
+    rep = error_report(samples + [slow], model, top=3)
+    assert rep["worst_steps"][0]["step"] == "step[99] pathological"
+    assert rep["worst_steps"][0]["rel_err"] < 0  # model under-predicts it
+    assert rep["error_max"] >= 0.89
+    assert len(rep["worst_steps"]) == 3
+
+
+def test_aggregate_samples_takes_median_per_name():
+    samples = [
+        StepSample("step[0] a", 1e9, 0.0, d) for d in (0.1, 0.3, 0.2)
+    ] + [StepSample("step[1] b", 2e9, 0.0, 0.5)]
+    agg = {s.name: s for s in aggregate_samples(samples)}
+    assert agg["step[0] a"].dur_s == 0.2
+    assert agg["step[1] b"].dur_s == 0.5
+
+
+def test_calibration_never_blends_executors():
+    """A trace carrying both host- and device-measured samples of the
+    same steps must fit from ONE source (device preferred), not a
+    meaningless blend."""
+    from tnc_tpu.obs.calibrate import calibration_report, pick_source
+
+    reg = MetricsRegistry()
+    obs.configure(enabled=True, registry=reg)
+    try:
+        for i in range(4):
+            # identical labels, wildly different measured scales
+            for source, dur in (("numpy", 0.05), ("jax", 0.0001)):
+                reg._spans.append(SpanRecord(
+                    f"step[{i}] 8x8·8x8", 0, int((dur + i * dur) * 1e9),
+                    1, 1, "t", 0,
+                    {"executor": source, "flops": (i + 1) * 1e6,
+                     "bytes_in": 1e3, "bytes_out": 1e3},
+                ))
+    finally:
+        obs.configure(enabled=False, registry=MetricsRegistry())
+    samples = aggregate_samples(step_samples(registry=reg))
+    assert pick_source(samples) == "jax"
+    rep = calibration_report(registry=reg)
+    assert rep["source"] == "jax"
+    # jax samples: dur = (i+1)*1e-4, flops = (i+1)*1e6 → 1e10 FLOP/s
+    assert rep["flops_per_s"] == pytest.approx(1e10, rel=1e-3)
+    # the numpy-only fit is 500x slower — the blend would sit between
+    rep_np = calibration_report(registry=reg, source="numpy")
+    assert rep_np["flops_per_s"] == pytest.approx(2e7, rel=1e-3)
+
+
+def test_step_spans_carry_executor_tag(enabled_obs):
+    from tnc_tpu.ops.backends import NumpyBackend
+
+    program, arrays = _small_program()
+    NumpyBackend().execute(program, arrays)
+    steps = [
+        r for r in enabled_obs.span_records() if r.name.startswith("step[")
+    ]
+    assert steps and all(r.args["executor"] == "numpy" for r in steps)
+
+
+def test_numpy_backend_step_spans_suppressible(enabled_obs):
+    """step_spans=False keeps span bookkeeping out of timed regions
+    (the bench CPU baseline) without touching the tracing gate."""
+    from tnc_tpu.ops.backends import NumpyBackend
+
+    program, arrays = _small_program()
+    NumpyBackend().execute(program, arrays, step_spans=False)
+    names = [r.name for r in enabled_obs.span_records()]
+    assert not any(n.startswith("step[") for n in names)
+
+
+def test_sliced_oracle_step_spans_suppressible(enabled_obs):
+    """The sycamore CPU-baseline timing region passes step_spans=False;
+    the default (tracing on) still records per-step spans."""
+    from tnc_tpu.contractionpath.contraction_path import ContractionPath
+    from tnc_tpu.contractionpath.slicing import Slicing
+    from tnc_tpu.ops.sliced import build_sliced_program, execute_sliced_numpy
+    from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+    from tnc_tpu.tensornetwork.tensordata import TensorData
+
+    rng = np.random.default_rng(0)
+
+    def mk(legs):
+        return LeafTensor(
+            legs, [4] * len(legs),
+            TensorData.matrix(rng.standard_normal([4] * len(legs))),
+        )
+
+    tn = CompositeTensor([mk([0, 1]), mk([1, 2]), mk([2, 3]), mk([3, 0])])
+    path = ContractionPath.simple([(0, 3), (0, 1), (0, 2)])
+    sp = build_sliced_program(tn, path, Slicing((2,), (4,)))
+    arrays = [t.data.into_data() for t in tn.tensors]
+
+    execute_sliced_numpy(sp, arrays, step_spans=False)
+    names = [r.name for r in enabled_obs.span_records()]
+    assert not any(n.startswith("step[") for n in names)
+    assert "sliced.residual" in names  # phase spans unaffected
+
+    execute_sliced_numpy(sp, arrays)  # default: spans on
+    n_steps = sum(
+        1 for r in enabled_obs.span_records() if r.name.startswith("step[")
+    )
+    assert n_steps == 4 * len(sp.program.steps)  # one per step per slice
+
+
+def test_dtype_width():
+    from tnc_tpu.ops.backends import dtype_width
+
+    assert dtype_width("complex64") == 8.0
+    assert dtype_width("complex128") == 16.0
+    assert dtype_width(np.complex128) == 16.0
+    assert dtype_width(np.float32) == 4.0
+
+
+def test_step_samples_reads_span_records():
+    recs = [
+        SpanRecord("step[0] 4x4·4x4", 0, 1_000_000, 1, 1, "t", 0,
+                   {"flops": 64.0, "bytes_in": 512.0, "bytes_out": 256.0}),
+        SpanRecord("sliced.residual", 0, 5_000_000, 1, 1, "t", 0,
+                   {"flops": 100.0}),  # not a step span: ignored
+        SpanRecord("step[1] no-cost", 0, 1_000_000, 1, 1, "t", 0, {}),
+    ]
+    samples = step_samples(records=recs)
+    assert len(samples) == 1
+    s = samples[0]
+    assert (s.flops, s.bytes, s.dur_s) == (64.0, 768.0, 1e-3)
+
+
+# -- per-step spans from the executors ---------------------------------
+
+
+def test_numpy_backend_step_spans_always_on_under_tracing(enabled_obs):
+    from tnc_tpu.ops.backends import NumpyBackend
+
+    program, arrays = _small_program()
+    NumpyBackend().execute(program, arrays)
+    steps = [
+        r for r in enabled_obs.span_records() if r.name.startswith("step[")
+    ]
+    assert len(steps) == len(program.steps)
+    for rec in steps:
+        assert rec.args["flops"] > 0
+        assert rec.args["bytes_in"] > 0 and rec.args["bytes_out"] > 0
+    # the fit end-to-end: a real run yields a usable calibration block
+    rep = calibrate.calibration_report(registry=enabled_obs)
+    assert rep is not None
+    assert rep["flops_per_s"] > 0
+    assert {"dispatch_overhead_s", "error_p50", "error_p90", "error_max",
+            "worst_steps"} <= set(rep)
+
+
+def test_jax_backend_no_step_spans_without_step_time(enabled_obs):
+    """TNC_TPU_STEP_TIME unset: the JAX backend stays on its compiled
+    whole-program dispatch — no per-step spans, no per-step sync."""
+    from tnc_tpu.ops.backends import JaxBackend
+
+    assert not obs.step_timing_enabled()
+    program, arrays = _small_program()
+    JaxBackend(dtype="complex64").execute(program, arrays)
+    names = [r.name for r in enabled_obs.span_records()]
+    assert not any(n.startswith("step[") for n in names)
+    assert any(n.startswith("backend.") for n in names)  # compiled path ran
+
+
+def test_jax_backend_step_time_mode_records_and_matches(enabled_obs):
+    from tnc_tpu.ops.backends import JaxBackend, NumpyBackend
+
+    program, arrays = _small_program()
+    want = NumpyBackend().execute(program, arrays)
+    obs.configure(step_time=True)
+    try:
+        got = JaxBackend(dtype="complex64").execute(program, arrays)
+    finally:
+        obs.configure(step_time=False)
+    assert np.allclose(got, want, atol=1e-4)
+    steps = [
+        r for r in enabled_obs.span_records() if r.name.startswith("step[")
+    ]
+    # numpy run + jax run each record one span per program step
+    assert len(steps) == 2 * len(program.steps)
+
+
+def test_step_time_env_gate(monkeypatch):
+    monkeypatch.setenv("TNC_TPU_STEP_TIME", "1")
+    monkeypatch.setenv("TNC_TPU_TRACE", "1")
+    obs.refresh_from_env()
+    assert obs.step_timing_enabled()
+    monkeypatch.delenv("TNC_TPU_STEP_TIME")
+    monkeypatch.setenv("TNC_TPU_TRACE", "0")
+    obs.refresh_from_env()
+    assert not obs.step_timing_enabled()
+    assert not obs.enabled()
+
+
+def test_step_label_format():
+    from tnc_tpu.ops.program import step_label
+
+    program, _ = _small_program()
+    label = step_label(12, program.steps[0])
+    assert label.startswith("step[12] ")
+    assert "x" in label and "·" in label
+
+
+# -- calibrated cost model in the planner -------------------------------
+
+
+def test_calibrated_cost_model_charges_dispatches():
+    m = CalibratedCostModel(flops_per_s=1e9, dispatch_s=1e-3)
+    # same flops, more slices: the dispatch term must separate them
+    flat = m.sliced_cost(0.0, 4e6, 1)
+    sliced4 = m.sliced_cost(0.0, 1e6, 4)
+    assert sliced4 > flat
+    assert sliced4 == pytest.approx(4 * (1e-3 + 1e-3))
+
+
+def test_stem_accountant_uses_cost_model():
+    from tnc_tpu.contractionpath.slicing import StemAccountant
+    from tnc_tpu.tensornetwork.tensor import LeafTensor
+
+    ts = [
+        LeafTensor.from_const([0, 1], 4), LeafTensor.from_const([1, 2], 4),
+        LeafTensor.from_const([2, 3], 4), LeafTensor.from_const([3, 0], 4),
+    ]
+    path = [(0, 3), (0, 1), (0, 2)]
+    plain = StemAccountant(ts, path)
+    model = CalibratedCostModel(flops_per_s=1e9, dispatch_s=0.5)
+    calibrated = StemAccountant(ts, path, cost_model=model)
+    per_slice = plain.total_flops
+    flops_cost = plain.hoisted_cost({2}, per_slice, 4)
+    seconds_cost = calibrated.hoisted_cost({2}, per_slice, 4)
+    # seconds domain, per-STEP dispatch accounting: 1 invariant step in
+    # the prelude + 2 variant steps per slice x 4 slices, at 0.5 s each
+    assert seconds_cost == pytest.approx(4.5, rel=0.2)
+    assert flops_cost > 100  # raw flop count, unchanged semantics
+
+
+def test_sliced_cost_charges_per_step_overhead():
+    """dispatch_s is fitted per STEP: a residual program of 50 steps
+    pays it 50x per slice, so deep slicing of a multi-step program is
+    not modeled as near-free."""
+    m = CalibratedCostModel(flops_per_s=1e12, dispatch_s=1e-4)
+    shallow = m.sliced_cost(0.0, 1e9, 4, steps_per_slice=50)
+    deep = m.sliced_cost(0.0, 1e9 / 16, 64, steps_per_slice=50)
+    # same total flops; 16x more slices => ~16x the per-step overhead
+    assert deep > 10 * shallow
+
+
+def test_cost_model_from_report_roundtrip():
+    rep = {"flops_per_s": 2e11, "bytes_per_s": 5e10,
+           "dispatch_overhead_s": 1e-4}
+    m = CalibratedCostModel.from_report(rep)
+    assert m.op_seconds(2e11, 5e10) == pytest.approx(2.0001)
+
+
+# -- perf gate ----------------------------------------------------------
+
+
+def _record(value=0.01, **over):
+    rec = {
+        "metric": "ghz3_statevector_wallclock", "value": value, "unit": "s",
+        "vs_baseline": 2.0,
+        "rep_stats": {"count": 3, "min_s": value * 0.98,
+                      "max_s": value * 1.02, "mean_s": value},
+        "phases": {"bench.warmup": 0.5, "bench.timed_run": 3 * value},
+        "calibration": {"flops_per_s": 1e9},
+    }
+    rec.update(over)
+    return rec
+
+
+def test_perf_gate_passes_identical_baseline(tmp_path):
+    gate = _perf_gate()
+    path = tmp_path / "base.json"
+    path.write_text(json.dumps(_record()))
+    assert gate.main([str(path), str(path)]) == 0
+
+
+def test_perf_gate_fails_on_2x_slowdown(tmp_path):
+    gate = _perf_gate()
+    base, cand = tmp_path / "base.json", tmp_path / "cand.json"
+    base.write_text(json.dumps(_record(0.01)))
+    cand.write_text(json.dumps(_record(0.02)))
+    assert gate.main([str(base), str(cand)]) == 1
+
+
+def test_perf_gate_noise_cap_still_catches_2x(tmp_path):
+    gate = _perf_gate()
+    noisy = _record(0.01, rep_stats={"count": 3, "min_s": 0.002,
+                                     "max_s": 0.03, "mean_s": 0.01})
+    base, cand = tmp_path / "base.json", tmp_path / "cand.json"
+    base.write_text(json.dumps(noisy))
+    cand.write_text(json.dumps(dict(noisy, value=0.02)))
+    assert gate.main([str(base), str(cand)]) == 1
+
+
+def test_perf_gate_tolerates_noise_level_jitter():
+    gate = _perf_gate()
+    base = _record(0.01)
+    cand = _record(0.0105)  # 5% — inside the 10% floor
+    code, _msgs = gate.compare(base, cand)
+    assert code == 0
+
+
+def test_perf_gate_per_region_rep_stats():
+    """bench records key rep_stats by timed region; only the
+    within-region spread counts as noise — a probe 100x faster than the
+    full run must not widen the tolerance."""
+    gate = _perf_gate()
+    rec = _record(10.0, rep_stats={
+        "probe": {"count": 3, "min_s": 0.1, "max_s": 0.102, "mean_s": 0.101},
+        "full_run": {"count": 3, "min_s": 9.9, "max_s": 10.1, "mean_s": 10.0},
+    })
+    assert gate.rel_noise(rec) < 0.05
+    code, _ = gate.compare(rec, dict(rec, value=20.0))
+    assert code == 1
+
+
+def test_perf_gate_rejects_unusable_records():
+    gate = _perf_gate()
+    good = _record()
+    assert gate.compare({"metric": "m", "value": 1.0, "error": "boom"},
+                        good)[0] == 2
+    assert gate.compare(good, dict(good, metric="other"))[0] == 2
+
+
+def test_perf_gate_warns_on_phase_regression():
+    gate = _perf_gate()
+    base = _record(0.01)
+    cand = _record(0.0101)
+    cand["phases"] = dict(base["phases"], **{"bench.warmup": 5.0})
+    code, msgs = gate.compare(base, cand)
+    assert code == 0
+    assert any("phase bench.warmup" in m for m in msgs)
+
+
+# -- roofline + export satellites ---------------------------------------
+
+
+def test_trace_summarize_roofline_cli(enabled_obs, tmp_path):
+    from tnc_tpu.ops.backends import NumpyBackend
+
+    program, arrays = _small_program()
+    NumpyBackend().execute(program, arrays)
+    with obs.span("sliced.residual") as sp:
+        sp.add(flops=1000, bytes=4000, slices=2)
+    trace = str(tmp_path / "trace.json")
+    obs.export_chrome_trace(trace)
+    r = subprocess.run(
+        [sys.executable, "scripts/trace_summarize.py", "--roofline", trace],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "GFLOP/s" in r.stdout
+    assert "step[0]" in r.stdout
+    assert "sliced.residual" in r.stdout
+
+
+def test_export_jsonl_carries_dropped_spans(enabled_obs, tmp_path, caplog):
+    import logging
+
+    reg = obs.configure(registry=MetricsRegistry(max_spans=1))
+    with obs.span("kept"):
+        pass
+    with obs.span("dropped"):
+        pass
+    assert reg.dropped_spans() == 1
+    path = str(tmp_path / "m.jsonl")
+    with caplog.at_level(logging.WARNING, logger="tnc_tpu.obs.export"):
+        obs.export_jsonl(path)
+    assert any("PARTIAL" in r.message for r in caplog.records)
+    records = [json.loads(line) for line in open(path)]
+    dropped = [r for r in records if r["type"] == "dropped_spans"]
+    assert dropped == [{"type": "dropped_spans", "value": 1}]
+
+
+def test_export_chrome_trace_warns_on_drop(enabled_obs, tmp_path, caplog):
+    import logging
+
+    obs.configure(registry=MetricsRegistry(max_spans=1))
+    with obs.span("kept"):
+        pass
+    with obs.span("dropped"):
+        pass
+    path = str(tmp_path / "t.json")
+    with caplog.at_level(logging.WARNING, logger="tnc_tpu.obs.export"):
+        obs.export_chrome_trace(path)
+    assert any("PARTIAL" in r.message for r in caplog.records)
+    assert json.load(open(path))["otherData"]["dropped_spans"] == 1
+
+
+def test_export_jsonl_no_drop_is_zero(enabled_obs, tmp_path):
+    with obs.span("kept"):
+        pass
+    path = str(tmp_path / "m.jsonl")
+    obs.export_jsonl(path)
+    records = [json.loads(line) for line in open(path)]
+    assert {"type": "dropped_spans", "value": 0} in records

@@ -132,14 +132,24 @@ class StemAccountant:
     ``invariant_flops(R)`` is then an O(steps) mask-and-sum per query —
     cheap enough for the planner's per-candidate scoring loops, on top
     of the (native) replayer's total-flops query.
+
+    ``cost_model`` (a :class:`tnc_tpu.obs.calibrate.CalibratedCostModel`
+    fitted from measured step spans) switches :meth:`hoisted_cost` from
+    raw flop counts to predicted *seconds* — including the per-slice
+    dispatch overhead raw op counts are blind to, so candidate scoring
+    stops treating ever-deeper slicing as free (the plan → measure →
+    replan loop).
     """
 
     def __init__(
         self,
         inputs: Sequence[LeafTensor],
         replace_path: Sequence[tuple[int, int]],
+        cost_model=None,
     ):
         import numpy as np
+
+        self._cost_model = cost_model
 
         tensors = [t.copy() for t in inputs]
         contrib: list[frozenset[int]] = [
@@ -165,17 +175,21 @@ class StemAccountant:
                     self._leg_steps[leg] = mask
                 mask[idx] = True
 
-    def invariant_flops(self, removed) -> float:
-        """Flops of the steps that stay slice-invariant with ``removed``
-        legs sliced — paid once under hoisted execution."""
-        import numpy as np
-
+    def _variant_mask(self, removed):
+        """Boolean step mask (True = variant under ``removed``), or
+        ``None`` when no removed leg touches any step."""
         variant = None
         for leg in removed:
             mask = self._leg_steps.get(leg)
             if mask is None:
                 continue
             variant = mask.copy() if variant is None else (variant | mask)
+        return variant
+
+    def invariant_flops(self, removed) -> float:
+        """Flops of the steps that stay slice-invariant with ``removed``
+        legs sliced — paid once under hoisted execution."""
+        variant = self._variant_mask(removed)
         if variant is None:
             return self.total_flops
         return float(self._costs[~variant].sum())
@@ -184,9 +198,27 @@ class StemAccountant:
         self, removed, per_slice_flops: float, num_slices: int
     ) -> float:
         """``invariant + num_slices * residual`` given the replayer's
-        per-slice total ``per_slice_flops`` for the same removal set."""
+        per-slice total ``per_slice_flops`` for the same removal set.
+        With a calibrated ``cost_model`` the same split is priced in
+        predicted seconds (residual dispatches included) instead of raw
+        flops — both are valid scoring keys (monotone in the work), so
+        callers compare candidates without caring which one is active.
+        """
         inv = self.invariant_flops(removed)
         residual = max(per_slice_flops - inv, 0.0)
+        if self._cost_model is not None:
+            # the fitted dispatch overhead is per STEP: a slice runs
+            # every variant step, the prelude every invariant one
+            variant = self._variant_mask(removed)
+            n = len(self._costs)
+            n_var = 0 if variant is None else int(variant.sum())
+            return self._cost_model.sliced_cost(
+                inv,
+                residual,
+                num_slices,
+                steps_per_slice=max(float(n_var), 1.0),
+                prelude_steps=max(float(n - n_var), 1.0),
+            )
         return inv + float(num_slices) * residual
 
 
@@ -318,6 +350,7 @@ def find_parallel_slicing(
     target_size: float | None = None,
     max_extra_legs: int = 8,
     base: Slicing | None = None,
+    cost_model=None,
 ) -> Slicing | None:
     """A slicing suitable for **slice-parallel** SPMD execution
     (:func:`tnc_tpu.parallel.distributed_sliced_contraction`): at least
@@ -331,7 +364,10 @@ def find_parallel_slicing(
     sliced flops (the overhead the mesh must amortize).
     Returns ``None`` if no divisible slicing exists within
     ``max_extra_legs`` extra legs — the caller falls back to partition
-    parallelism.
+    parallelism. ``cost_model`` (a measured
+    :class:`~tnc_tpu.obs.calibrate.CalibratedCostModel`) scores the
+    extra legs in predicted seconds — per-slice dispatch overhead
+    included — instead of raw flops.
 
     >>> from tnc_tpu.tensornetwork.tensor import LeafTensor
     >>> ts = [LeafTensor.from_const([0, 1], 4), LeafTensor.from_const([1, 2], 4),
@@ -389,7 +425,7 @@ def find_parallel_slicing(
         # (invariant stem paid once, residual per slice) after adding
         # the leg
         if acct is None:
-            acct = StemAccountant(inputs, replace_path)
+            acct = StemAccountant(inputs, replace_path, cost_model=cost_model)
         best = min(
             candidates,
             key=lambda leg: (
@@ -427,6 +463,7 @@ def slice_and_reconfigure(
     final_budget: float | None = 45.0,
     max_slices: int = 1 << 26,
     max_leg_candidates: int = 48,
+    cost_model=None,
 ) -> tuple[list[tuple[int, int]], Slicing]:
     """Interleaved slicing + subtree reconfiguration (cotengra's
     ``slicing_reconf`` approach): repeatedly slice a leg of the peak
@@ -447,6 +484,11 @@ def slice_and_reconfigure(
 
     Returns (replace_path, slicing); the path is valid for the unsliced
     network (slicing only pins index values, it never reorders legs).
+
+    ``cost_model`` (a measured
+    :class:`~tnc_tpu.obs.calibrate.CalibratedCostModel`) switches leg
+    scoring from hoisted flop counts to predicted seconds, charging
+    each extra slice its real dispatch overhead.
     """
     from tnc_tpu.contractionpath.contraction_path import (
         ContractionPath,
@@ -509,7 +551,7 @@ def slice_and_reconfigure(
         # flops component is invariant + num_slices * residual, which
         # prefers legs that keep a large hoistable stem over legs that
         # drag the whole program into the per-slice loop
-        acct = StemAccountant(inputs, replace)
+        acct = StemAccountant(inputs, replace, cost_model=cost_model)
         best_leg = -1
         best_key: tuple[float, float] | None = None
         for leg in candidates[:max_leg_candidates]:

@@ -23,6 +23,7 @@ from tnc_tpu.obs.core import (  # noqa: F401
     refresh_from_env,
     reset,
     span,
+    step_timing_enabled,
     trace_path,
     traced,
 )
@@ -34,4 +35,12 @@ from tnc_tpu.obs.export import (  # noqa: F401
     format_summary_table,
     load_trace_events,
     trace_summary,
+)
+from tnc_tpu.obs.calibrate import (  # noqa: F401
+    CalibratedCostModel,
+    DeviceModel,
+    StepSample,
+    calibration_report,
+    fit_device_model,
+    step_samples,
 )

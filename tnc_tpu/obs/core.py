@@ -315,6 +315,7 @@ class Span:
 # -- module-level state + API ------------------------------------------
 
 _ENABLED = False
+_STEP_TIME = False
 _TRACE_PATH: str | None = None
 _REGISTRY = MetricsRegistry()
 _ATEXIT_REGISTERED = False
@@ -323,6 +324,20 @@ _ATEXIT_REGISTERED = False
 def enabled() -> bool:
     """Is recording on? The one check every instrumented call site pays."""
     return _ENABLED
+
+
+def step_timing_enabled() -> bool:
+    """Is the opt-in per-step timing mode on (``TNC_TPU_STEP_TIME``)?
+
+    When true *and* recording is on, the JAX backend's whole-program
+    executor runs eagerly — one dispatch plus ``block_until_ready`` per
+    :class:`~tnc_tpu.ops.program.PairStep` — so every step span carries
+    a true measured wall time next to its predicted flops/bytes (the
+    calibration input, :mod:`tnc_tpu.obs.calibrate`). The numpy oracle
+    is synchronous anyway and records step spans whenever tracing is on.
+    Off (the default): zero per-step sync, compiled dispatch unchanged.
+    """
+    return _STEP_TIME
 
 
 def get_registry() -> MetricsRegistry:
@@ -339,14 +354,18 @@ def configure(
     enabled: bool | None = None,
     trace_path: str | None = None,
     registry: MetricsRegistry | None = None,
+    step_time: bool | None = None,
 ) -> MetricsRegistry:
     """Programmatic override of the env gate (bench/tests). Returns the
-    active registry. ``trace_path`` arms the atexit Chrome-trace export."""
-    global _ENABLED, _TRACE_PATH, _REGISTRY
+    active registry. ``trace_path`` arms the atexit Chrome-trace export;
+    ``step_time`` overrides the ``TNC_TPU_STEP_TIME`` per-step mode."""
+    global _ENABLED, _STEP_TIME, _TRACE_PATH, _REGISTRY
     if registry is not None:
         _REGISTRY = registry
     if enabled is not None:
         _ENABLED = bool(enabled)
+    if step_time is not None:
+        _STEP_TIME = bool(step_time)
     if trace_path is not None:
         _TRACE_PATH = trace_path
         _register_atexit()
@@ -360,9 +379,13 @@ def reset() -> MetricsRegistry:
 
 
 def refresh_from_env() -> bool:
-    """Re-read ``TNC_TPU_TRACE`` (import-time default; call after
-    changing the env mid-process). Returns the new enabled state."""
-    global _ENABLED, _TRACE_PATH
+    """Re-read ``TNC_TPU_TRACE`` / ``TNC_TPU_STEP_TIME`` (import-time
+    defaults; call after changing the env mid-process). Returns the new
+    enabled state."""
+    global _ENABLED, _STEP_TIME, _TRACE_PATH
+    _STEP_TIME = (
+        os.environ.get("TNC_TPU_STEP_TIME", "").strip().lower() in _TRUTHY
+    )
     raw = os.environ.get("TNC_TPU_TRACE", "").strip()
     if not raw or raw == "0" or raw.lower() in ("false", "off", "no"):
         _ENABLED = False

@@ -31,6 +31,25 @@ from typing import Any, Iterable
 
 from tnc_tpu.obs.core import MetricsRegistry, get_registry
 
+logger = logging.getLogger(__name__)
+
+
+def _warn_if_truncated(reg: MetricsRegistry, sink: str) -> int:
+    """Spans past the retention cap (``TNC_TPU_TRACE_MAX_SPANS``) are
+    counted but dropped; every exporter surfaces that loudly — a
+    truncated trace must never read as a complete one. Returns the
+    dropped count."""
+    dropped = reg.dropped_spans()
+    if dropped:
+        logger.warning(
+            "obs: span retention cap hit — %d spans were dropped; the "
+            "%s export is PARTIAL (raise TNC_TPU_TRACE_MAX_SPANS to "
+            "keep more)",
+            dropped,
+            sink,
+        )
+    return dropped
+
 
 def chrome_trace_events(
     registry: MetricsRegistry | None = None,
@@ -68,9 +87,11 @@ def export_chrome_trace(
     path: str, registry: MetricsRegistry | None = None
 ) -> str:
     """Write the registry as a Chrome-trace JSON file loadable in
-    ``ui.perfetto.dev``; counters/gauges ride along under ``otherData``.
-    Returns ``path``."""
+    ``ui.perfetto.dev``; counters/gauges ride along under ``otherData``
+    (including ``dropped_spans``, warned about when nonzero). Returns
+    ``path``."""
     reg = registry if registry is not None else get_registry()
+    _warn_if_truncated(reg, "Chrome-trace")
     doc = {
         "traceEvents": chrome_trace_events(reg),
         "displayTimeUnit": "ms",
@@ -84,8 +105,11 @@ def export_chrome_trace(
 def export_jsonl(path: str, registry: MetricsRegistry | None = None) -> str:
     """Write every span and metric as one JSON object per line (the
     flexi_logger-style record stream; round-trips through
-    ``json.loads`` per line). Returns ``path``."""
+    ``json.loads`` per line), histograms included, closing with a
+    ``dropped_spans`` record so a capped trace is never silently
+    partial. Returns ``path``."""
     reg = registry if registry is not None else get_registry()
+    dropped = _warn_if_truncated(reg, "JSONL")
     with open(path, "w", encoding="utf-8") as fh:
         for rec in reg.span_records():
             fh.write(json.dumps({
@@ -104,6 +128,9 @@ def export_jsonl(path: str, registry: MetricsRegistry | None = None) -> str:
             fh.write(json.dumps(
                 {"type": "histogram", "name": name, **h}
             ) + "\n")
+        fh.write(json.dumps(
+            {"type": "dropped_spans", "value": dropped}
+        ) + "\n")
     return path
 
 
@@ -111,12 +138,15 @@ def emit_metrics(
     logger: logging.Logger | None = None,
     registry: MetricsRegistry | None = None,
 ) -> int:
-    """Log every metric as a structured record through the std logging
-    tree, so :class:`tnc_tpu.benchmark.logging_util.JsonFormatter` (which
+    """Log every metric — counters, gauges, histograms, span stats — as
+    a structured record through the std logging tree, so
+    :class:`tnc_tpu.benchmark.logging_util.JsonFormatter` (which
     serializes ``extra=`` fields) lands them in the per-process JSONL
-    sink. Returns the number of records emitted."""
+    sink. A ``dropped_spans`` record (warned about when nonzero) closes
+    the stream. Returns the number of records emitted."""
     reg = registry if registry is not None else get_registry()
     lg = logger if logger is not None else logging.getLogger("tnc_tpu.obs")
+    dropped = _warn_if_truncated(reg, "metrics")
     n = 0
     snap = reg.snapshot()
     for kind in ("counters", "gauges"):
@@ -136,6 +166,15 @@ def emit_metrics(
             "metric", extra={"metric_type": "span", "metric": name, **stats},
         )
         n += 1
+    lg.info(
+        "metric",
+        extra={
+            "metric_type": "dropped_spans",
+            "metric": "dropped_spans",
+            "value": dropped,
+        },
+    )
+    n += 1
     return n
 
 

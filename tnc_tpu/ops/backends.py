@@ -151,6 +151,80 @@ def _run_steps(xp, program: ContractionProgram, buffers: list[Any]) -> Any:
     return buffers[program.result_slot]
 
 
+def dtype_width(dtype) -> float:
+    """Element width in bytes of a backend dtype (name string, numpy
+    dtype, or anything ``np.dtype`` accepts) — the ONE rule every
+    predicted-bytes computation shares (step spans, prelude/residual
+    byte counters, the calibration fit). Split-complex pairs carry the
+    same bytes as the complex dtype they represent, so no special case.
+
+    >>> dtype_width("complex64"), dtype_width(np.complex128)
+    (8.0, 16.0)
+    """
+    try:
+        return float(np.dtype(dtype).itemsize)
+    except TypeError:
+        return 16.0 if "128" in str(dtype) else 8.0
+
+
+def run_steps_timed(
+    xp,
+    program: ContractionProgram,
+    buffers: list[Any],
+    dtype_bytes: float = 16.0,
+    split_complex: bool = False,
+    precision: str | None = None,
+    sync=None,
+) -> Any:
+    """Step-timed variant of :func:`_run_steps`: one obs span per
+    :class:`~tnc_tpu.ops.program.PairStep`, named ``step[i] MxK·KxN``
+    and carrying the step's *predicted* cost (``flops``, ``bytes_in``,
+    ``bytes_out``) next to the span's *measured* wall time — the raw
+    samples :mod:`tnc_tpu.obs.calibrate` fits its device model from.
+
+    ``sync`` (JAX path: ``jax.block_until_ready``) forces each step's
+    result before its span closes, so the measured time is device wall
+    time, not async enqueue. The host oracle passes no ``sync`` — numpy
+    is synchronous already. Same result contract as ``_run_steps``
+    (stored shape). Must not be called under jit tracing (the spans
+    would measure trace time once, not run time).
+
+    Each span is tagged ``executor="numpy"|"jax"`` so the calibration
+    fit never blends host- and device-measured samples of the same step
+    into one "device" model.
+    """
+    from tnc_tpu.ops.program import step_elems, step_flops, step_label
+
+    executor = "numpy" if xp is np else "jax"
+
+    if split_complex:
+        from tnc_tpu.ops.split_complex import apply_step_split
+
+        def kernel(a, b, st):
+            return apply_step_split(xp, a, b, st, precision)
+
+    else:
+
+        def kernel(a, b, st):
+            return apply_step(xp, a, b, st)
+
+    for i, step in enumerate(program.steps):
+        elems_in, elems_out = step_elems(step)
+        with obs.span(
+            step_label(i, step),
+            executor=executor,
+            flops=step_flops(step),
+            bytes_in=elems_in * dtype_bytes,
+            bytes_out=elems_out * dtype_bytes,
+        ):
+            out = kernel(buffers[step.lhs], buffers[step.rhs], step)
+            if sync is not None:
+                sync(out)
+        buffers[step.lhs] = out
+        buffers[step.rhs] = None  # free eagerly
+    return buffers[program.result_slot]
+
+
 # Locked: the distributed local phase compiles/executes per-partition
 # programs from a thread pool (parallel/partitioned.py).
 _PROGRAM_JIT_CACHE: "OrderedDict[tuple, Any]" = OrderedDict()
@@ -323,9 +397,24 @@ class NumpyBackend(Backend):
     def __init__(self, dtype=np.complex128):
         self.dtype = np.dtype(dtype)
 
-    def execute(self, program: ContractionProgram, arrays: Sequence[Any]) -> np.ndarray:
+    def execute(
+        self,
+        program: ContractionProgram,
+        arrays: Sequence[Any],
+        step_spans: bool | None = None,
+    ) -> np.ndarray:
+        """``step_spans``: per-step timing spans. Default (``None``) —
+        on whenever tracing is on (the oracle is synchronous, so the
+        timing is exact and costs no sync). Timed regions that must not
+        carry span bookkeeping inside them (the bench CPU baseline)
+        pass ``False`` explicitly."""
         buffers = [np.asarray(a, dtype=self.dtype) for a in arrays]
-        out = _run_steps(np, program, buffers)
+        if obs.enabled() and (step_spans is None or step_spans):
+            out = run_steps_timed(
+                np, program, buffers, float(self.dtype.itemsize)
+            )
+        else:
+            out = _run_steps(np, program, buffers)
         return np.asarray(out).reshape(program.result_shape)
 
     def execute_sliced(
@@ -445,6 +534,24 @@ class JaxBackend(Backend):
         return np.asarray(result).reshape(program.result_shape)
 
     def _run(self, program: ContractionProgram, buffers: list[Any]):
+        if obs.enabled() and obs.step_timing_enabled():
+            # TNC_TPU_STEP_TIME: eager op-by-op execution, blocking on
+            # each step's result — every step span carries a true
+            # measured device time next to its predicted flops/bytes
+            # (the calibration input). Orders of magnitude slower than
+            # the compiled path; never on by default.
+            import jax
+            import jax.numpy as jnp
+
+            return run_steps_timed(
+                jnp,
+                program,
+                list(buffers),
+                dtype_bytes=dtype_width(self.dtype),
+                split_complex=self.split_complex,
+                precision=self.precision,
+                sync=jax.block_until_ready,
+            )
         return self._compiled(program)(buffers)
 
     def execute_sliced(

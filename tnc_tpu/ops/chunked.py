@@ -38,7 +38,12 @@ import numpy as np
 
 from tnc_tpu import obs
 from tnc_tpu.ops.backends import apply_step, place_buffers
-from tnc_tpu.ops.program import ContractionProgram, PairStep, steps_flops
+from tnc_tpu.ops.program import (
+    ContractionProgram,
+    PairStep,
+    steps_bytes,
+    steps_flops,
+)
 from tnc_tpu.ops.sliced import SlicedProgram, index_buffer, kahan_add
 from tnc_tpu.resilience import checkpoint as _ckpt
 from tnc_tpu.resilience import faultinject as _faults
@@ -471,9 +476,13 @@ def run_sliced_chunked_placed(
                     hp, list(device_full), split_complex, precision
                 )
                 if obs.enabled():
-                    osp.add(flops=steps_flops(
-                        ps.step for ps in hp.prelude_steps
-                    ))
+                    from tnc_tpu.ops.backends import dtype_width
+
+                    pre = [ps.step for ps in hp.prelude_steps]
+                    osp.add(
+                        flops=steps_flops(pre),
+                        bytes=steps_bytes(pre, dtype_width(dtype)),
+                    )
             return run_sliced_chunked_placed(
                 hp.residual,
                 res_inputs,
@@ -670,10 +679,14 @@ def run_sliced_chunked_placed(
                     lambda _a=acc: _flatten_acc(_a, split_complex),
                 )
         if obs.enabled():
+            from tnc_tpu.ops.backends import dtype_width
+
             osp.add(
                 slices=num - start0,
                 dispatches=dispatches,
                 flops=(num - start0) * steps_flops(sp.program.steps),
+                bytes=(num - start0)
+                * steps_bytes(sp.program.steps, dtype_width(dtype)),
             )
         if mgr is not None:
             mgr.finalize()

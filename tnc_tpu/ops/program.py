@@ -52,6 +52,49 @@ _STAGED_PAD_FACTOR = 4.0  # naive materialization tolerated up to this
 _LANEMIX_MAX_W = 65536
 
 
+def step_dims(st) -> tuple[int, int, int]:
+    """The ``(m, k, n)`` matmul shape of one :class:`PairStep`: the dot
+    contracts a ``(m, k)`` lhs against a ``(k, n)`` rhs (orientation and
+    ``swap`` folded out — these are the *logical* dims every cost shares).
+    """
+    k = st.a_dot[0] if st.a_cfirst else st.a_dot[-1]
+    m = math.prod(st.a_dot) // max(k, 1)
+    n = math.prod(st.b_dot) // max(k, 1)
+    return int(m), int(k), int(n)
+
+
+def step_flops(st) -> float:
+    """Naive multiply-add count of one step: ``k * m * n``."""
+    m, k, n = step_dims(st)
+    return float(k) * float(m) * float(n)
+
+
+def step_elems(st) -> tuple[float, float]:
+    """(elements read, elements written) by one step — the operands'
+    stored views in, the stored result out. Multiplied by the dtype
+    width this is the step's predicted HBM traffic, the bytes side of
+    the roofline next to :func:`step_flops`."""
+    elems_in = float(math.prod(st.a_view)) + float(math.prod(st.b_view))
+    return elems_in, float(math.prod(st.out_store))
+
+
+def step_label(i: int, st) -> str:
+    """Self-describing span name for one step: index + matmul dims
+    (``step[12] 256x512·512x64``), so Perfetto lanes and roofline rows
+    read without cross-referencing the program dump.
+
+    >>> from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+    >>> from tnc_tpu.contractionpath.contraction_path import ContractionPath
+    >>> tn = CompositeTensor([LeafTensor.from_const([0, 1], 4),
+    ...                       LeafTensor.from_const([1, 2], 4)])
+    >>> program = build_program(tn, ContractionPath.simple([(0, 1)]))
+    >>> step_label(0, program.steps[0])
+    'step[0] 4x4·4x4'
+    """
+    m, k, n = step_dims(st)
+    return f"step[{i}] {m}x{k}·{k}x{n}"
+
+
 def steps_flops(steps) -> float:
     """Naive multiply-add count of a step sequence (``k * m * n`` per
     dot) — the shared formula under the hoist accounting
@@ -66,12 +109,29 @@ def steps_flops(steps) -> float:
     >>> steps_flops(program.steps)   # one (4,4) @ (4,4) dot
     64.0
     """
+    return sum(step_flops(st) for st in steps)
+
+
+def steps_bytes(steps, dtype_bytes: float = 16.0) -> float:
+    """Predicted HBM traffic of a step sequence: per step, operands read
+    + result written, times the element width (complex128 = 16 by
+    default; the executors pass their actual width). The bytes
+    counterpart of :func:`steps_flops` on the obs spans, so the
+    calibration fit (:mod:`tnc_tpu.obs.calibrate`) sees both roofline
+    axes.
+
+    >>> from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+    >>> from tnc_tpu.contractionpath.contraction_path import ContractionPath
+    >>> tn = CompositeTensor([LeafTensor.from_const([0, 1], 4),
+    ...                       LeafTensor.from_const([1, 2], 4)])
+    >>> program = build_program(tn, ContractionPath.simple([(0, 1)]))
+    >>> steps_bytes(program.steps, 1.0)   # 16 + 16 read, 16 written
+    48.0
+    """
     total = 0.0
     for st in steps:
-        k = st.a_dot[0] if st.a_cfirst else st.a_dot[-1]
-        m = math.prod(st.a_dot) // max(k, 1)
-        n = math.prod(st.b_dot) // max(k, 1)
-        total += float(k) * float(m) * float(n)
+        elems_in, elems_out = step_elems(st)
+        total += (elems_in + elems_out) * dtype_bytes
     return total
 
 

@@ -34,8 +34,13 @@ import numpy as np
 from tnc_tpu import obs
 from tnc_tpu.contractionpath.contraction_path import ContractionPath
 from tnc_tpu.contractionpath.slicing import Slicing
-from tnc_tpu.ops.program import ContractionProgram, build_program, steps_flops
-from tnc_tpu.ops.backends import _run_steps
+from tnc_tpu.ops.program import (
+    ContractionProgram,
+    build_program,
+    steps_bytes,
+    steps_flops,
+)
+from tnc_tpu.ops.backends import _run_steps, run_steps_timed
 from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
 
 
@@ -157,6 +162,7 @@ def execute_sliced_numpy(
     max_slices: int | None = None,
     hoist: bool = False,
     ckpt: str | None = None,
+    step_spans: bool | None = None,
 ) -> np.ndarray:
     """CPU oracle: python loop over slices, sum of program results.
 
@@ -169,6 +175,13 @@ def execute_sliced_numpy(
     and an interrupted oracle run resumes bit-identically
     (:mod:`tnc_tpu.resilience.checkpoint`); minutes-per-slice oracle
     work is exactly what should never restart from slice 0.
+
+    ``step_spans``: per-step timing spans (predicted flops/bytes next
+    to measured wall time — the calibration input). Default (``None``):
+    on whenever tracing is on. Callers that wall-clock this function as
+    a published baseline pass ``False`` so span bookkeeping never sits
+    inside their timed region (``bench.py`` takes its calibration
+    sample from a separate untimed pass).
     """
     from tnc_tpu.resilience import checkpoint as _ckpt
     from tnc_tpu.resilience import faultinject as _faults
@@ -184,9 +197,11 @@ def execute_sliced_numpy(
             ) as osp:
                 full = run_prelude(np, hp, full)
                 if obs.enabled():
-                    osp.add(flops=steps_flops(
-                        ps.step for ps in hp.prelude_steps
-                    ))
+                    pre = [ps.step for ps in hp.prelude_steps]
+                    osp.add(
+                        flops=steps_flops(pre),
+                        bytes=steps_bytes(pre, np.dtype(dtype).itemsize),
+                    )
             sp = hp.residual
     acc = np.zeros(sp.program.stored_result_shape, dtype=dtype)
     num = sp.slicing.num_slices
@@ -208,6 +223,11 @@ def execute_sliced_numpy(
             start, (saved,) = loaded
             start = max(0, min(start, num))
             acc = np.asarray(saved, dtype=dtype)
+    # per-step spans (predicted flops/bytes + measured wall time) are
+    # on by default for the synchronous oracle under tracing — the
+    # richest CPU-side calibration sample (obs.calibrate)
+    step_timed = obs.enabled() and (step_spans is None or step_spans)
+    item_bytes = float(np.dtype(dtype).itemsize)
     with obs.span("sliced.residual", executor="numpy") as osp:
         for s in range(start, num):
             _faults.fault_point("sliced.slice", s=s)
@@ -216,13 +236,21 @@ def execute_sliced_numpy(
                 index_buffer(np, arr, info, indices)
                 for arr, info in zip(full, sp.slot_slices)
             ]
-            acc = acc + _run_steps(np, sp.program, buffers)
+            if step_timed:
+                contrib = run_steps_timed(
+                    np, sp.program, buffers, item_bytes
+                )
+            else:
+                contrib = _run_steps(np, sp.program, buffers)
+            acc = acc + contrib
             if mgr is not None:
                 mgr.maybe_save(s + 1, lambda _a=acc: [_a])
         if obs.enabled():
             osp.add(
                 slices=num - start,
                 flops=(num - start) * steps_flops(sp.program.steps),
+                bytes=(num - start)
+                * steps_bytes(sp.program.steps, item_bytes),
             )
     if mgr is not None:
         mgr.finalize()
@@ -484,17 +512,30 @@ def make_jax_sliced_fn(
     # span covers both; its flop counter is the hoisted total (prelude
     # once + residual per slice)
     total_flops = num * steps_flops(loop_sp.program.steps)
+    total_elem_bytes = num * steps_bytes(loop_sp.program.steps, 1.0)
     if hp is not None:
-        total_flops += steps_flops(ps.step for ps in hp.prelude_steps)
+        pre = [ps.step for ps in hp.prelude_steps]
+        total_flops += steps_flops(pre)
+        total_elem_bytes += steps_bytes(pre, 1.0)
 
     def run(full_buffers, _jitted=jitted):
         if not obs.enabled():
             return _jitted(full_buffers)
+        first = full_buffers[0]
+        item = (
+            2.0 * first[0].dtype.itemsize
+            if isinstance(first, tuple)
+            else float(first.dtype.itemsize)
+        )
         with obs.span(
             "sliced.loop", hoisted=hoisted, executor="loop"
         ) as osp:
             out = _jitted(full_buffers)
-            osp.add(slices=num, flops=total_flops)
+            osp.add(
+                slices=num,
+                flops=total_flops,
+                bytes=total_elem_bytes * item,
+            )
             return out
 
     return run
