@@ -72,7 +72,17 @@ worst-mispredicted steps, from the run's per-step spans —
 tnc_tpu/obs/calibrate.py; set TNC_TPU_STEP_TIME=1 to add device-side
 per-step samples, at the cost of eager step-by-step dispatch).
 
-Flags: ``--resume`` arms slice-range checkpointing (sets TNC_TPU_CKPT
+Flags: ``--serve`` (equivalently ``BENCH_SERVE=1`` — the flag is
+forwarded to virtual-mesh/retry relaunches via that env var)
+additionally runs the in-process amplitude serving
+benchmark (docs/serving.md) and records a ``"serving"`` block in the
+JSON — queries/sec, batch-size distribution, p50/p99 latency — so the
+perf gate can watch serving throughput alongside contraction
+wall-clock (knobs: BENCH_SERVE_QUERIES (256), BENCH_SERVE_QUBITS (10),
+BENCH_SERVE_DEPTH (6), BENCH_SERVE_BATCH (32), BENCH_SERVE_WAIT_MS
+(2), BENCH_SERVE_BACKEND jax|numpy).
+
+``--resume`` arms slice-range checkpointing (sets TNC_TPU_CKPT
 to .cache/bench_ckpt unless already set): a run killed mid-slice-range
 resumes from the persisted accumulator+cursor instead of restarting at
 slice 0 (docs/resilience.md). Retry-ladder subprocesses inherit it, so
@@ -1697,6 +1707,80 @@ CONFIGS = {
 }
 
 
+def _serve_bench() -> dict:
+    """``--serve``: throughput/latency of the in-process amplitude
+    service (docs/serving.md). A random circuit is bound once
+    (plan+compile amortized), then BENCH_SERVE_QUERIES mixed bitstrings
+    are fired from a thread pool through the micro-batching front end;
+    the block reports queries/sec, the realized batch-size
+    distribution, and p50/p99 request latency."""
+    import concurrent.futures
+
+    from tnc_tpu import obs
+    from tnc_tpu.builders.random_circuit import brickwork_circuit
+    from tnc_tpu.serve import ContractionService
+
+    n = _env_int("BENCH_SERVE_QUBITS", 10)
+    depth = _env_int("BENCH_SERVE_DEPTH", 6)
+    n_queries = _env_int("BENCH_SERVE_QUERIES", 256)
+    max_batch = _env_int("BENCH_SERVE_BATCH", 32)
+    wait_ms = float(os.environ.get("BENCH_SERVE_WAIT_MS", "2"))
+    rng = np.random.default_rng(_env_int("BENCH_SEED", 42))
+    circuit = brickwork_circuit(n, depth, rng)
+
+    backend = None  # numpy oracle
+    backend_name = os.environ.get("BENCH_SERVE_BACKEND", "jax")
+    if backend_name == "jax":
+        from tnc_tpu.ops.backends import JaxBackend
+
+        backend = JaxBackend(dtype="complex64", donate=False)
+    queries = [
+        "".join(rng.choice(["0", "1"], n)) for _ in range(n_queries)
+    ]
+    with obs.span("bench.serve", queries=n_queries):
+        with ContractionService.from_circuit(
+            circuit,
+            backend=backend,
+            max_batch=max_batch,
+            max_wait_ms=wait_ms,
+            max_queue=max(n_queries, 1024),
+        ) as svc:
+            # warmup outside the timed window: one singleton (the
+            # batch-1 bucket) AND one full batch (the max_batch bucket)
+            # — the jax threaded path compiles one executable per pow2
+            # batch bucket, and steady traffic lands on the full bucket
+            svc.amplitude(queries[0])
+            warm = [svc.submit(queries[0]) for _ in range(max_batch)]
+            for f in warm:
+                f.result(timeout=600)
+            svc.reset_stats()  # warmup must not skew the published stats
+            t0 = time.monotonic()
+            with concurrent.futures.ThreadPoolExecutor(16) as pool:
+                futs = list(pool.map(svc.submit, queries))
+            for f in futs:
+                f.result(timeout=600)
+            wall = time.monotonic() - t0
+        stats = svc.stats()
+    block = {
+        "backend": backend_name,
+        "qubits": n,
+        "depth": depth,
+        "queries": n_queries,
+        "wall_s": round(wall, 4),
+        "qps": round(n_queries / wall, 1) if wall > 0 else 0.0,
+        "batch_size": stats["batch_size"],
+        "latency_s": stats["latency_s"],
+        "counts": stats["counts"],
+    }
+    log(
+        f"[bench] serving: {block['qps']} q/s over {n_queries} queries "
+        f"(mean batch {stats['batch_size']['mean']:.1f}, "
+        f"p50 {stats['latency_s']['p50'] * 1e3:.2f} ms, "
+        f"p99 {stats['latency_s']['p99'] * 1e3:.2f} ms)"
+    )
+    return block
+
+
 def _emit(record: dict) -> None:
     print(json.dumps(record), flush=True)
 
@@ -1753,6 +1837,12 @@ def _run_config(config: str) -> dict:
         "device": f"{device.platform}:{device.device_kind}",
     }
     record.update(extra)
+    if os.environ.get("BENCH_SERVE") == "1":
+        try:
+            record["serving"] = _serve_bench()
+        except Exception as e:  # noqa: BLE001 — one-JSON-line contract
+            log(f"[bench] serving bench failed: {type(e).__name__}: {e}")
+            record["serving"] = {"error": f"{type(e).__name__}: {e}"}
     if obs.enabled():
         _attach_obs_breakdown(record, obs)
     return record
@@ -1841,6 +1931,10 @@ def _attach_obs_breakdown(record: dict, obs) -> None:
 
 
 def main() -> None:
+    if "--serve" in sys.argv[1:]:
+        # carried by env, not argv: the virtual-mesh and retry-ladder
+        # relaunches re-exec this file without the caller's flags
+        os.environ["BENCH_SERVE"] = "1"
     if "--resume" in sys.argv[1:]:
         # arm slice-range checkpointing (docs/resilience.md): the chunked
         # executor persists accumulator+cursor under this directory and a

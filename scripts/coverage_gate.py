@@ -37,6 +37,7 @@ REQUIRED_SUBPACKAGES = (
     "ops",
     "parallel",
     "resilience",
+    "serve",
     "tensornetwork",
 )
 
@@ -44,6 +45,7 @@ REQUIRED_SUBPACKAGES = (
 # present while a new module inside it silently vanishes):
 REQUIRED_MODULES = (
     os.path.join("tnc_tpu", "obs", "calibrate.py"),
+    os.path.join("tnc_tpu", "utils", "digest.py"),
 )
 
 executed: set[tuple[str, int]] = set()

@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Serving smoke for scripts/check.sh: an in-process ContractionService
+under concurrent mixed-bitstring load on CPU, amplitudes compared to
+the sequential numpy oracle (bit-exact), plus the plan-cache
+zero-pathfinding contract — serving a second, structurally identical
+circuit must produce ≥1 plan-cache hit and NO new ``plan.find_path``
+span.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+import tnc_tpu.obs as obs  # noqa: E402
+from tnc_tpu.builders.circuit_builder import Circuit  # noqa: E402
+from tnc_tpu.builders.random_circuit import brickwork_circuit  # noqa: E402
+from tnc_tpu.contractionpath.paths import Greedy, OptMethod  # noqa: E402
+from tnc_tpu.obs.core import MetricsRegistry  # noqa: E402
+from tnc_tpu.ops.backends import NumpyBackend  # noqa: E402
+from tnc_tpu.ops.program import build_program, flat_leaf_tensors  # noqa: E402
+from tnc_tpu.serve import ContractionService, PlanCache  # noqa: E402
+
+N_QUBITS = 6
+DEPTH = 4
+N_QUERIES = 32
+
+
+def make_circuit(seed: int = 0) -> Circuit:
+    """Same recipe ``bench.py --serve`` measures (shared builder)."""
+    return brickwork_circuit(N_QUBITS, DEPTH, np.random.default_rng(seed))
+
+
+def oracle(bits: str) -> complex:
+    tn, _ = make_circuit().into_amplitude_network(bits)
+    program = build_program(
+        tn, Greedy(OptMethod.GREEDY).find_path(tn).replace_path()
+    )
+    arrays = [leaf.data.into_data() for leaf in flat_leaf_tensors(tn)]
+    return complex(np.asarray(NumpyBackend().execute(program, arrays)).reshape(()))
+
+
+def find_path_spans() -> int:
+    return sum(
+        1
+        for r in obs.get_registry().span_records()
+        if r.name == "plan.find_path"
+    )
+
+
+def main() -> int:
+    obs.configure(enabled=True, registry=MetricsRegistry())
+    rng = np.random.default_rng(7)
+    queries = [
+        "".join(rng.choice(["0", "1"], N_QUBITS)) for _ in range(N_QUERIES)
+    ]
+
+    with tempfile.TemporaryDirectory() as cache_dir:
+        cache = PlanCache(cache_dir)
+
+        with ContractionService.from_circuit(
+            make_circuit(), plan_cache=cache, max_batch=8, max_wait_ms=5.0
+        ) as svc:
+            # concurrent submission from a thread pool: mixed bitstrings
+            with concurrent.futures.ThreadPoolExecutor(8) as pool:
+                futs = list(pool.map(svc.submit, queries))
+            got = [f.result(timeout=60) for f in futs]
+        for bits, amp in zip(queries, got):
+            want = oracle(bits)
+            assert amp == want, f"{bits}: served {amp} != oracle {want}"
+        stats = svc.stats()
+        assert stats["counts"]["completed"] == N_QUERIES, stats
+        print(
+            f"[serve_smoke] {N_QUERIES} concurrent queries bit-match the "
+            f"oracle (batches: {stats['batch_size']}, "
+            f"p50 {stats['latency_s']['p50'] * 1e3:.2f} ms)"
+        )
+
+        # second, structurally identical circuit: the plan cache must
+        # hit and the planner must never run
+        spans_before = find_path_spans()
+        with ContractionService.from_circuit(
+            make_circuit(), plan_cache=cache, max_batch=8, max_wait_ms=5.0
+        ) as svc2:
+            amp = svc2.amplitude(queries[0], timeout_s=60)
+        assert find_path_spans() == spans_before, (
+            "second service creation ran the pathfinder"
+        )
+        assert amp == oracle(queries[0])
+        hits = obs.counters_by_prefix("serve.plan_cache.hit")
+        assert sum(hits.values()) >= 1, f"no plan-cache hit: {hits}"
+        print(
+            "[serve_smoke] repeat structure: plan-cache hit, zero "
+            "plan.find_path spans"
+        )
+    print("[serve_smoke] OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

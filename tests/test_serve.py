@@ -1,0 +1,676 @@
+"""tnc_tpu.serve: rebinding, plan cache, and the serving front end.
+
+Pins the subsystem's contracts:
+
+- rebind-vs-oracle **bit**-equality on the numpy path: a batch of B
+  bitstrings through one bound program equals B independent
+  plan+compile+contract runs, bit for bit (incl. ``*`` open legs);
+  split-complex serving agrees with the oracle to f32 parity;
+- a plan-cache hit performs zero pathfinding (no ``plan.find_path``
+  span) and zero retracing (jit cache-hit counter) for a second,
+  structurally identical circuit;
+- LRU eviction and corrupted-entry recovery in the on-disk plan cache;
+- micro-batching, admission control, deadline expiry, and
+  batch-failure → singleton degradation in :class:`ContractionService`;
+- the shared digest helper is stable across Python hash seeds and dict
+  orderings (subprocess-pinned).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import tnc_tpu.obs as obs
+from tnc_tpu.builders.circuit_builder import Circuit, normalize_bitstring
+from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+from tnc_tpu.obs.core import MetricsRegistry
+from tnc_tpu.ops.backends import JaxBackend, NumpyBackend
+from tnc_tpu.ops.program import build_program, flat_leaf_tensors
+from tnc_tpu.resilience.retry import RetryPolicy
+from tnc_tpu.serve import (
+    ContractionService,
+    DeadlineExceededError,
+    PlanCache,
+    QueueFullError,
+    ServiceClosedError,
+    bind_circuit,
+    thread_batch,
+)
+from tnc_tpu.tensornetwork.tensordata import TensorData
+
+
+@pytest.fixture
+def enabled_obs():
+    reg = obs.configure(enabled=True, registry=MetricsRegistry())
+    try:
+        yield reg
+    finally:
+        obs.configure(enabled=False, registry=MetricsRegistry())
+
+
+def make_circuit(n=5, depth=4, seed=0):
+    """Random-ish circuit; same (n, depth, seed) → identical structure
+    AND identical gate values."""
+    rng = np.random.default_rng(seed)
+    c = Circuit()
+    reg = c.allocate_register(n)
+    for q in range(n):
+        c.append_gate(TensorData.gate("h"), [reg.qubit(q)])
+    for d in range(depth):
+        for q in range(n):
+            gate = TensorData.gate(
+                "rz" if (d + q) % 2 else "rx", (float(rng.uniform(0, 3)),)
+            )
+            c.append_gate(gate, [reg.qubit(q)])
+        for q in range(d % 2, n - 1, 2):
+            c.append_gate(
+                TensorData.gate("cx"), [reg.qubit(q), reg.qubit(q + 1)]
+            )
+    return c
+
+
+def oracle_amplitude(bits, n=5, depth=4, seed=0):
+    """The sequential oracle: full pipeline per bitstring — fresh
+    network, fresh plan, fresh program, numpy complex128 contraction."""
+    tn, _ = make_circuit(n, depth, seed).into_amplitude_network(bits)
+    program = build_program(
+        tn, Greedy(OptMethod.GREEDY).find_path(tn).replace_path()
+    )
+    arrays = [leaf.data.into_data() for leaf in flat_leaf_tensors(tn)]
+    return np.asarray(NumpyBackend().execute(program, arrays))
+
+
+def random_bits(n, b, seed):
+    rng = np.random.default_rng(seed)
+    return ["".join(rng.choice(["0", "1"], n)) for _ in range(b)]
+
+
+# ---------------------------------------------------------------------------
+# rebinding
+
+
+class TestRebind:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_batched_rebind_bitcompares_to_sequential_oracle(self, seed):
+        bp = bind_circuit(make_circuit(seed=seed))
+        bits = random_bits(5, 7, seed)
+        amps = bp.amplitudes(bits)
+        want = np.array(
+            [complex(oracle_amplitude(b, seed=seed).reshape(())) for b in bits]
+        )
+        # bit-equality, not allclose: same operands, same GEMMs, same
+        # summation order per batch entry
+        assert np.array_equal(
+            amps.view(np.float64), want.view(np.float64)
+        )
+
+    def test_open_legs_bitcompare(self):
+        bp = bind_circuit(make_circuit(seed=1), mask="0*0*0")
+        reqs = ["0*1*0", "1*0*1"]
+        out = bp.amplitudes(reqs)
+        assert out.shape == (2, 2, 2)
+        for i, bits in enumerate(reqs):
+            want = oracle_amplitude(bits, seed=1)
+            assert np.array_equal(out[i], want)
+
+    def test_batch_of_b_equals_b_singletons(self):
+        bp = bind_circuit(make_circuit(seed=2))
+        bits = random_bits(5, 6, 3)
+        batched = bp.amplitudes(bits)
+        singles = np.concatenate([bp.amplitudes([b]) for b in bits])
+        assert np.array_equal(
+            batched.view(np.float64), singles.view(np.float64)
+        )
+
+    def test_thread_batch_marks_only_bra_descendants(self):
+        bp = bind_circuit(make_circuit(seed=0))
+        flags, feasible = thread_batch(bp.program, bp.bra_slots)
+        assert feasible
+        # at least one step carries the leg, and the result-producing
+        # step must (every bra feeds the final amplitude)
+        assert any(ab or bb for ab, bb in flags)
+        assert flags[-1][0] or flags[-1][1]
+
+    def test_rebind_reuses_one_program(self):
+        """Rebinding never rebuilds/replans: the program object is
+        shared across queries."""
+        bp = bind_circuit(make_circuit(seed=0))
+        prog_before = bp.program
+        bp.amplitudes(["00000"])
+        bp.amplitudes(["11111", "10101"])
+        assert bp.program is prog_before
+
+    def test_jax_threaded_matches_numpy(self):
+        bp = bind_circuit(make_circuit(seed=0))
+        bits = random_bits(5, 4, 5)
+        want = bp.amplitudes(bits)
+        backend = JaxBackend(dtype="complex128", donate=False)
+        got = bp.amplitudes(bits, backend)
+        assert np.allclose(got, want, atol=1e-12)
+        # the gate leaves were staged to the device once and are reused
+        # (only the bras transfer per dispatch)
+        resident = bp._resident[(str(backend.dtype), backend.device)]
+        again = bp.amplitudes(bits, backend)
+        assert np.allclose(again, want, atol=1e-12)
+        assert bp._resident[(str(backend.dtype), backend.device)] is resident
+
+    def test_empty_batched_slots_is_explicit_error(self):
+        bp = bind_circuit(make_circuit(seed=0))
+        with pytest.raises(ValueError, match="at least one batched slot"):
+            NumpyBackend().execute_batched(bp.program, bp.arrays, [])
+
+    def test_split_complex_vmap_fallback_hits_f32_parity(self):
+        bp = bind_circuit(make_circuit(seed=0))
+        bits = random_bits(5, 4, 6)
+        want = bp.amplitudes(bits)
+        backend = JaxBackend(
+            dtype="complex64", split_complex=True, donate=False
+        )
+        got = bp.amplitudes(bits, backend)
+        assert np.allclose(got, want, atol=1e-5)
+
+    def test_fully_open_template_serves_statevector(self):
+        bp = bind_circuit(make_circuit(n=3, depth=2, seed=4), mask="***")
+        out = bp.amplitudes(["***", "***"])
+        assert out.shape[0] == 2
+        assert np.array_equal(out[0], out[1])
+
+    def test_invalid_request_names_position(self):
+        bp = bind_circuit(make_circuit(seed=0))
+        with pytest.raises(ValueError, match="position 2"):
+            bp.amplitudes(["01x01"])
+        # determined template rejects '*' requests
+        with pytest.raises(ValueError, match="position 1 is determined"):
+            bp.amplitudes(["0*000"])
+
+    def test_sliced_plan_serves_and_roundtrips(self, tmp_path):
+        cache = PlanCache(tmp_path)
+        bp = bind_circuit(
+            make_circuit(n=6, depth=3, seed=7),
+            plan_cache=cache,
+            target_size=2.0**5,
+        )
+        assert bp.sliced is not None and bp.sliced.slicing.num_slices > 1
+        assert bp.plan["slicing"] is not None
+        assert bp.plan["hoist"]["residual_steps"] > 0
+        bits = random_bits(6, 3, 8)
+        got = bp.amplitudes(bits)
+        want = np.array(
+            [
+                complex(oracle_amplitude(b, n=6, depth=3, seed=7).reshape(()))
+                for b in bits
+            ]
+        )
+        assert np.allclose(got, want, atol=1e-10)
+        # cache round-trip rebuilds the same sliced plan
+        bp2 = bind_circuit(
+            make_circuit(n=6, depth=3, seed=7),
+            plan_cache=cache,
+            target_size=2.0**5,
+        )
+        assert bp2.sliced is not None
+        assert bp2.sliced.slicing == bp.sliced.slicing
+        assert np.allclose(bp2.amplitudes(bits), got)
+
+
+# ---------------------------------------------------------------------------
+# plan cache
+
+
+class TestPlanCache:
+    def test_hit_skips_planner(self, tmp_path, enabled_obs):
+        cache = PlanCache(tmp_path)
+
+        def find_path_spans():
+            return sum(
+                1
+                for r in obs.get_registry().span_records()
+                if r.name == "plan.find_path"
+            )
+
+        bind_circuit(make_circuit(seed=0), plan_cache=cache)
+        after_first = find_path_spans()
+        assert after_first >= 1
+        bp2 = bind_circuit(make_circuit(seed=0), plan_cache=cache)
+        assert find_path_spans() == after_first  # ZERO new pathfinding
+        assert bp2.plan["pairs"]
+        hits = obs.counters_by_prefix("serve.plan_cache.hit")
+        assert sum(hits.values()) >= 1
+
+    def test_second_structural_circuit_hits_jit_cache(
+        self, tmp_path, enabled_obs
+    ):
+        """The acceptance criterion: repeat structure → no pathfinding
+        AND no recompilation (jit cache hit on the first dispatch)."""
+        cache = PlanCache(tmp_path)
+        backend = JaxBackend(dtype="complex64", donate=False)
+        bp = bind_circuit(make_circuit(seed=0), plan_cache=cache)
+        bp.amplitudes(["00000", "11111"], backend)
+        before = obs.counters_by_prefix("jit_cache")
+        bp2 = bind_circuit(make_circuit(seed=0), plan_cache=cache)
+        bp2.amplitudes(["00000", "11111"], backend)
+        after = obs.counters_by_prefix("jit_cache")
+        assert after.get("jit_cache.hit", 0) > before.get("jit_cache.hit", 0)
+        assert after.get("jit_cache.miss", 0) == before.get(
+            "jit_cache.miss", 0
+        )
+
+    def test_structure_key_is_bitstring_independent(self):
+        tn0, _ = make_circuit(seed=0).into_amplitude_network("00000")
+        tn1, _ = make_circuit(seed=0).into_amplitude_network("10110")
+        from tnc_tpu.serve import network_structure_digest
+
+        assert network_structure_digest(tn0) == network_structure_digest(tn1)
+
+    def test_lru_eviction(self, tmp_path):
+        cache = PlanCache(tmp_path, max_entries=2)
+        plan = {"version": 1, "pairs": [[0, 1]], "program_sig": "x"}
+        cache.store("k1", plan)
+        time.sleep(0.02)
+        cache.store("k2", plan)
+        time.sleep(0.02)
+        cache.load("k1")  # touch: k1 becomes most recently used
+        time.sleep(0.02)
+        cache.store("k3", plan)  # evicts k2 (LRU), not k1
+        assert cache.load("k1") is not None
+        assert cache.load("k2") is None
+        assert cache.load("k3") is not None
+        assert len(cache) == 2
+
+    def test_corrupted_entry_recovers(self, tmp_path):
+        cache = PlanCache(tmp_path)
+        key = cache.key_for_network(
+            make_circuit(seed=0).into_amplitude_network("00000")[0]
+        )
+        (tmp_path / f"{key}.json").write_text("{not json!!")
+        # load: corrupt → dropped, miss
+        assert cache.load(key) is None
+        assert not (tmp_path / f"{key}.json").exists()
+        # bind through the corrupt entry: replans and re-stores
+        bp = bind_circuit(make_circuit(seed=0), plan_cache=cache)
+        assert bp.plan["pairs"]
+        assert cache.load(key) is not None
+
+    def test_semantically_corrupt_plan_replans(self, tmp_path):
+        """Valid JSON whose pairs don't rebuild (out-of-range slots)
+        must degrade to a replan and purge the entry — never raise out
+        of bind, never leave a poison pill on disk."""
+        cache = PlanCache(tmp_path)
+        bind_circuit(make_circuit(seed=0), plan_cache=cache)
+        key = cache.key_for_network(
+            make_circuit(seed=0).into_amplitude_network("0" * 5)[0]
+        )
+        plan = cache.load(key)
+        plan["pairs"] = [[0, 999]]  # rebuilds nowhere
+        cache.store(key, plan)
+        bp = bind_circuit(make_circuit(seed=0), plan_cache=cache)
+        assert np.asarray(bp.amplitudes(["00000"])).shape == (1,)
+        healed = cache.load(key)
+        assert healed is not None and healed["pairs"] != [[0, 999]]
+
+    def test_store_failure_is_best_effort(self, tmp_path):
+        """A cache write failure must never fail the caller — the plan
+        is already in memory; the cache is an optimization."""
+        import shutil
+
+        cache = PlanCache(tmp_path / "plans")
+        shutil.rmtree(tmp_path / "plans")
+        cache.store("k", {"version": 1, "pairs": [[0, 1]]})  # no raise
+        # bind through the broken cache: plans and serves anyway
+        bp = bind_circuit(make_circuit(seed=0), plan_cache=cache)
+        assert np.asarray(bp.amplitudes(["00000"])).shape == (1,)
+
+    def test_wrong_version_is_a_miss(self, tmp_path):
+        cache = PlanCache(tmp_path)
+        (tmp_path / "k.json").write_text(
+            json.dumps({"version": 999, "pairs": [[0, 1]]})
+        )
+        assert cache.load("k") is None
+
+    def test_stale_program_sig_replans(self, tmp_path, enabled_obs):
+        cache = PlanCache(tmp_path)
+        bp = bind_circuit(make_circuit(seed=0), plan_cache=cache)
+        key = cache.key_for_network(bp.template.network)
+        plan = cache.load(key)
+        plan["program_sig"] = "deadbeef"  # foreign/stale plan
+        cache.store(key, plan)
+        before = sum(
+            1
+            for r in obs.get_registry().span_records()
+            if r.name == "plan.find_path"
+        )
+        bp2 = bind_circuit(make_circuit(seed=0), plan_cache=cache)
+        after = sum(
+            1
+            for r in obs.get_registry().span_records()
+            if r.name == "plan.find_path"
+        )
+        assert after == before + 1  # invalid entry → honest replan
+        assert cache.validate(bp2.plan, bp2.program)
+
+
+# ---------------------------------------------------------------------------
+# digest satellite
+
+
+class TestStableDigest:
+    def test_dict_and_set_order_independent(self):
+        from tnc_tpu.utils.digest import stable_digest
+
+        assert stable_digest({"a": 1, "b": [2, 3]}) == stable_digest(
+            {"b": [2, 3], "a": 1}
+        )
+        assert stable_digest({3, 1, 2}) == stable_digest({2, 3, 1})
+        assert stable_digest((1, 2)) != stable_digest([1, 2])
+
+    def test_stable_across_hash_seeds(self):
+        """The digest of a program signature (nested dataclass tuples)
+        must not depend on PYTHONHASHSEED — on-disk plan/checkpoint
+        keys cross process boundaries."""
+        code = (
+            "from tnc_tpu.utils.digest import stable_digest\n"
+            "from tnc_tpu.ops.program import PairStep\n"
+            "st = PairStep(0, 1, (2, 2), None, (2, 2), True, (2,), None,"
+            " (2,), True, False, (2,))\n"
+            "print(stable_digest({'step': st, 'z': {1, 2, 3}}, 'tag'))\n"
+        )
+        digests = set()
+        for seed in ("0", "424242"):
+            env = dict(os.environ)
+            env["PYTHONHASHSEED"] = seed
+            env["JAX_PLATFORMS"] = "cpu"
+            r = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True,
+                text=True,
+                env=env,
+                check=True,
+            )
+            digests.add(r.stdout.strip())
+        assert len(digests) == 1
+
+    def test_checkpoint_signature_routed_through_shared_helper(self):
+        from tnc_tpu.resilience.checkpoint import signature_hash
+        from tnc_tpu.utils.digest import stable_digest
+
+        assert signature_hash("a", 1, (2, 3)) == stable_digest("a", 1, (2, 3))
+
+    def test_numeric_kind_not_arrival_type(self):
+        """Same value, different numeric arrival type: numpy scalars
+        fold by KIND (Integral→int, Real→float), so np.float32(2.0)
+        digests like 2.0, never like the int 2."""
+        from tnc_tpu.utils.digest import stable_digest
+
+        assert stable_digest(np.float32(2.0)) == stable_digest(2.0)
+        assert stable_digest(np.float64(2.0)) == stable_digest(2.0)
+        assert stable_digest(np.int32(2)) == stable_digest(2)
+        assert stable_digest(2.0) != stable_digest(2)
+
+    def test_benchmark_cache_key_unchanged_format(self):
+        from tnc_tpu.benchmark.cache import cache_key
+
+        key = cache_key("greedy", "OPENQASM 2.0;", 7, 4, "sa")
+        assert key.startswith("greedy_") and key.endswith("_7_4_sa")
+        assert key == cache_key("greedy", "OPENQASM 2.0;", 7, 4, "sa")
+
+
+# ---------------------------------------------------------------------------
+# bitstring normalization satellite
+
+
+class TestNormalizeBitstring:
+    def test_iterable_states(self):
+        assert normalize_bitstring([0, 1, None, "*", "1"]) == "01**1"
+
+    def test_error_names_char_and_position(self):
+        with pytest.raises(ValueError, match=r"character '2' at position 3"):
+            normalize_bitstring("0112")
+        with pytest.raises(ValueError, match=r"state 7 at position 1"):
+            normalize_bitstring([0, 7])
+        with pytest.raises(ValueError, match="position 0"):
+            normalize_bitstring([True, 0])
+
+    def test_amplitude_network_accepts_iterable(self):
+        tn_str, _ = make_circuit(n=3, depth=2, seed=0).into_amplitude_network(
+            "010"
+        )
+        tn_it, _ = make_circuit(n=3, depth=2, seed=0).into_amplitude_network(
+            [0, 1, 0]
+        )
+        assert len(tn_str) == len(tn_it)
+
+    def test_length_mismatch(self):
+        c = make_circuit(n=3, depth=1, seed=0)
+        with pytest.raises(ValueError, match="length 2 != qubit count 3"):
+            c.into_amplitude_network("01")
+
+
+# ---------------------------------------------------------------------------
+# service front end
+
+
+class SlowBackend(NumpyBackend):
+    """Oracle backend with a configurable dispatch delay (and optional
+    scripted failures) — deterministic service-timing tests."""
+
+    def __init__(self, delay_s=0.0, fail_batches=0, fail_with=None):
+        super().__init__()
+        self.delay_s = delay_s
+        self.fail_batches = fail_batches
+        self.fail_with = fail_with or (lambda: ConnectionResetError("blip"))
+        self.calls = []
+
+    def execute_batched(self, program, arrays, batched):
+        b = int(np.asarray(arrays[list(batched)[0]]).shape[0])
+        self.calls.append(b)
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        if b > 1 and self.fail_batches > 0:
+            self.fail_batches -= 1
+            raise self.fail_with()
+        return super().execute_batched(program, arrays, batched)
+
+
+class PoisonBackend(NumpyBackend):
+    """Fails any dispatch whose batch contains the poisoned bra
+    pattern — a deterministic 'bad input at dispatch time' the
+    admission-time validation cannot catch."""
+
+    def __init__(self, poison_bits):
+        super().__init__()
+        self.poison = poison_bits
+
+    def execute_batched(self, program, arrays, batched):
+        slots = list(batched)
+        rows = np.stack([np.asarray(arrays[s]) for s in slots], axis=1)
+        for row in rows:  # row: (n_det, 2) one-hot bras, qubit order
+            bits = "".join("0" if abs(r[0]) > 0.5 else "1" for r in row)
+            if bits == self.poison:
+                raise ValueError(f"poisoned request {bits}")
+        return super().execute_batched(program, arrays, batched)
+
+
+class TestService:
+    def _service(self, backend=None, **kw):
+        bound = bind_circuit(make_circuit(seed=0))
+        kw.setdefault("max_wait_ms", 20.0)
+        kw.setdefault(
+            "retry_policy", RetryPolicy(max_attempts=2, base_delay_s=0.0)
+        )
+        return ContractionService(bound, backend=backend, **kw).start()
+
+    def test_concurrent_queries_match_oracle(self):
+        svc = self._service(max_batch=4)
+        try:
+            bits = random_bits(5, 10, 11)
+            futs = [svc.submit(b) for b in bits]
+            got = np.array([f.result(timeout=30) for f in futs])
+        finally:
+            svc.stop()
+        want = np.array(
+            [complex(oracle_amplitude(b).reshape(())) for b in bits]
+        )
+        assert np.array_equal(got.view(np.float64), want.view(np.float64))
+        stats = svc.stats()
+        assert stats["counts"]["completed"] == 10
+        assert stats["batch_size"]["max"] >= 1
+
+    def test_micro_batching_batches_riders(self):
+        backend = SlowBackend(delay_s=0.05)
+        svc = self._service(backend=backend, max_batch=8, max_wait_ms=100.0)
+        try:
+            futs = [svc.submit("00000") for _ in range(6)]
+            [f.result(timeout=30) for f in futs]
+        finally:
+            svc.stop()
+        # the waiting window must have merged riders into shared batches
+        assert max(backend.calls) >= 2
+
+    def test_deadline_expiry(self):
+        backend = SlowBackend(delay_s=0.5)
+        svc = self._service(backend=backend, max_batch=1, max_wait_ms=0.0)
+        try:
+            first = svc.submit("00000")  # occupies the dispatcher ~0.5 s
+            time.sleep(0.1)
+            doomed = svc.submit("11111", timeout_s=0.05)
+            with pytest.raises(DeadlineExceededError):
+                doomed.result(timeout=30)
+            assert complex(first.result(timeout=30)) is not None
+        finally:
+            svc.stop()
+        assert svc.stats()["counts"]["expired"] == 1
+
+    def test_admission_control_rejects_when_full(self):
+        backend = SlowBackend(delay_s=0.5)
+        svc = self._service(
+            backend=backend, max_batch=1, max_wait_ms=0.0, max_queue=1
+        )
+        try:
+            ok1 = svc.submit("00000")
+            time.sleep(0.1)  # dispatcher now busy with ok1
+            ok2 = svc.submit("00001")  # fills the queue
+            with pytest.raises(QueueFullError):
+                svc.submit("00010")
+            ok1.result(timeout=30)
+            ok2.result(timeout=30)
+        finally:
+            svc.stop()
+        assert svc.stats()["counts"]["rejected"] == 1
+
+    def test_transient_batch_failure_retries_in_place(self):
+        backend = SlowBackend(fail_batches=1)  # first batch dispatch blips
+        svc = self._service(backend=backend, max_batch=4, max_wait_ms=50.0)
+        try:
+            futs = [svc.submit(b) for b in random_bits(5, 3, 12)]
+            got = [f.result(timeout=30) for f in futs]
+        finally:
+            svc.stop()
+        assert all(isinstance(a, complex) for a in got)
+        assert svc.stats()["counts"]["degraded_batches"] == 0  # retry, not degrade
+
+    def test_batch_failure_degrades_to_singletons(self):
+        """A request that poisons the whole batch (fatal at dispatch)
+        fails alone; its co-riders still complete."""
+        svc = self._service(
+            backend=PoisonBackend("10101"), max_batch=4, max_wait_ms=100.0
+        )
+        try:
+            good1 = svc.submit("00000")
+            bad = svc.submit("10101")  # fails any dispatch containing it
+            good2 = svc.submit("11111")
+            a1 = good1.result(timeout=30)
+            a2 = good2.result(timeout=30)
+            with pytest.raises(ValueError, match="poisoned"):
+                bad.result(timeout=30)
+        finally:
+            svc.stop()
+        assert a1 == complex(oracle_amplitude("00000").reshape(()))
+        assert a2 == complex(oracle_amplitude("11111").reshape(()))
+        assert svc.stats()["counts"]["degraded_batches"] >= 1
+        assert svc.stats()["counts"]["failed"] == 1
+
+    def test_malformed_request_rejected_at_submit(self):
+        """Validation happens at admission: a typo'd bitstring never
+        enters the queue (and never poisons a batch)."""
+        svc = self._service(max_batch=4)
+        try:
+            with pytest.raises(ValueError, match="position 2"):
+                svc.submit("00x00")
+            amp = svc.amplitude("00000", timeout_s=30)
+        finally:
+            svc.stop()
+        assert amp == complex(oracle_amplitude("00000").reshape(()))
+        assert svc.stats()["counts"]["degraded_batches"] == 0
+
+    def test_cancelled_future_does_not_kill_dispatcher(self):
+        """A caller-cancelled future (fut.cancel(), or an abandoned
+        asyncio await) must not kill the dispatcher thread — later
+        requests still complete."""
+        backend = SlowBackend(delay_s=0.3)
+        svc = self._service(backend=backend, max_batch=1, max_wait_ms=0.0)
+        try:
+            first = svc.submit("00000")  # occupies the dispatcher
+            time.sleep(0.1)
+            doomed = svc.submit("11111")
+            assert doomed.cancel()
+            first.result(timeout=30)
+            after = svc.submit("01010")  # dispatcher must still be alive
+            assert isinstance(after.result(timeout=30), complex)
+        finally:
+            svc.stop()
+        assert svc.stats()["counts"]["cancelled"] == 1
+
+    def test_one_shot_iterable_request(self):
+        """A generator request is consumed exactly once (at admission
+        validation) — the normalized string is what gets dispatched."""
+        svc = self._service(max_batch=4)
+        try:
+            amp = svc.submit(iter([0, 1, 0, 1, 0])).result(timeout=30)
+        finally:
+            svc.stop()
+        assert amp == complex(oracle_amplitude("01010").reshape(()))
+
+    def test_submit_after_stop_raises(self):
+        svc = self._service()
+        svc.stop()
+        with pytest.raises(ServiceClosedError):
+            svc.submit("00000")
+
+    def test_asyncio_facade(self):
+        import asyncio
+
+        svc = self._service(max_batch=4)
+
+        async def run():
+            return await asyncio.gather(
+                *(svc.amplitude_async(b) for b in ["00000", "11111"])
+            )
+
+        try:
+            got = asyncio.run(run())
+        finally:
+            svc.stop()
+        assert got[0] == complex(oracle_amplitude("00000").reshape(()))
+        assert got[1] == complex(oracle_amplitude("11111").reshape(()))
+
+    def test_obs_wiring(self, enabled_obs):
+        svc = self._service(max_batch=4)
+        try:
+            futs = [svc.submit(b) for b in random_bits(5, 5, 13)]
+            [f.result(timeout=30) for f in futs]
+        finally:
+            svc.stop()
+        counters = obs.counters_by_prefix("serve.requests.")
+        assert counters.get("serve.requests.submitted", 0) == 5
+        assert counters.get("serve.requests.completed", 0) == 5
+        hists = obs.get_registry().histograms()
+        names = {name for (name, _labels) in hists}
+        assert "serve.batch_size" in names
+        assert "serve.latency_s" in names
+        gauges = obs.get_registry().gauges()
+        assert any(k[0] == "serve.queue_depth" for k in gauges)

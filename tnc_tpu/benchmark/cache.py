@@ -14,13 +14,13 @@ scatters metadata and lets ranks materialize.
 
 from __future__ import annotations
 
-import hashlib
 import pickle
 import zlib
 from pathlib import Path
 
 from tnc_tpu.contractionpath.contraction_path import ContractionPath
 from tnc_tpu.tensornetwork.tensor import CompositeTensor
+from tnc_tpu.utils.digest import stable_digest
 
 
 def cache_key(
@@ -34,7 +34,7 @@ def cache_key(
     >>> key.startswith("greedy_") and key.endswith("_7_4_sa")
     True
     """
-    digest = hashlib.sha256(circuit_text.encode()).hexdigest()[:16]
+    digest = stable_digest(circuit_text)[:16]
     return f"{scheme}_{digest}_{seed}_{partitions}_{method}"
 
 

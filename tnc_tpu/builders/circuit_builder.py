@@ -19,12 +19,60 @@ Mirror of ``tnc/src/builders/circuit_builder.rs``:
 
 from __future__ import annotations
 
-from typing import Iterator, Sequence
+from dataclasses import dataclass
+from typing import Iterable, Iterator, Sequence
 
 import numpy as np
 
 from tnc_tpu.tensornetwork.tensor import CompositeTensor, EdgeIndex, LeafTensor
 from tnc_tpu.tensornetwork.tensordata import TensorData
+
+
+def normalize_bitstring(
+    bitstring: str | Iterable, num_qubits: int | None = None
+) -> str:
+    """Canonicalize a bitstring spec to a ``str`` of ``0``/``1``/``*``.
+
+    Accepts a plain string or an iterable of per-qubit states: the
+    characters ``"0"``/``"1"``/``"*"``, the ints ``0``/``1``, or
+    ``None`` (= open leg, like ``"*"``). Errors name the offending
+    state *and its position*, so a 53-character Sycamore bitstring with
+    one typo is debuggable.
+
+    >>> normalize_bitstring([0, 1, None, "1"])
+    '01*1'
+    >>> normalize_bitstring("01x1")
+    Traceback (most recent call last):
+        ...
+    ValueError: invalid bitstring character 'x' at position 2 (only '0', '1' and '*' are allowed)
+    """
+    chars: list[str] = []
+    for pos, state in enumerate(bitstring):
+        if isinstance(state, str) and state in ("0", "1", "*"):
+            chars.append(state)
+        elif state is None:
+            chars.append("*")
+        elif (
+            isinstance(state, (int, np.integer))
+            and not isinstance(state, bool)
+            and state in (0, 1)
+        ):
+            chars.append(str(int(state)))
+        else:
+            what = (
+                f"character {state!r}"
+                if isinstance(state, str)
+                else f"state {state!r}"
+            )
+            raise ValueError(
+                f"invalid bitstring {what} at position {pos} "
+                "(only '0', '1' and '*' are allowed)"
+            )
+    if num_qubits is not None and len(chars) != num_qubits:
+        raise ValueError(
+            f"bitstring length {len(chars)} != qubit count {num_qubits}"
+        )
+    return "".join(chars)
 
 
 class Qubit:
@@ -163,31 +211,60 @@ class Circuit:
 
     # -- finalizers --------------------------------------------------------
 
-    def into_amplitude_network(self, bitstring: str) -> tuple[CompositeTensor, Permutor]:
+    def into_amplitude_network(
+        self, bitstring: str | Iterable
+    ) -> tuple[CompositeTensor, Permutor]:
         """Close the circuit with ⟨0|/⟨1| bras per the bitstring; ``*``
         leaves the leg open (statevector slice). Returns the network and a
         Permutor for the open legs in qubit order.
+
+        ``bitstring`` may also be an iterable of per-qubit states
+        (``0``/``1`` ints, ``"0"``/``"1"``/``"*"`` chars, or ``None``
+        for an open leg — :func:`normalize_bitstring`).
         """
-        if len(bitstring) != self.num_qubits():
-            raise ValueError(
-                f"bitstring length {len(bitstring)} != qubit count {self.num_qubits()}"
-            )
+        bitstring = normalize_bitstring(bitstring, self.num_qubits())
         self._finalize()
         final_legs: list[EdgeIndex] = []
         for c, edge in zip(bitstring, self.open_edges):
             if c == "*":
                 final_legs.append(edge)
                 continue
-            if c == "0":
-                data = _ket0()
-            elif c == "1":
-                data = _ket1()
-            else:
-                raise ValueError("Only 0, 1 and * are allowed in bitstring")
             bra = LeafTensor.from_const([edge], 2)
-            bra.data = data
+            bra.data = _ket0() if c == "0" else _ket1()
             self.tensor_network.push_tensor(bra)
         return self.tensor_network, Permutor(final_legs)
+
+    def into_amplitude_template(
+        self, mask: str | Iterable | None = None
+    ) -> "AmplitudeTemplate":
+        """Close the circuit with *symbolic* bra placeholders — the
+        serving finalizer (:mod:`tnc_tpu.serve`).
+
+        ``mask`` says only which positions are *determined* (get a bra
+        leaf, value bound later) vs *open* (``"*"``, statevector
+        slice); any determined character (``0``/``1``) is a placeholder
+        — the template's network structure, contraction path, and
+        compiled program are bitstring-independent, and per-request bra
+        values are rebound without replanning
+        (:mod:`tnc_tpu.serve.rebind`). Placeholder bras materialize as
+        ⟨0| so the template network stays directly executable.
+
+        Returns an :class:`AmplitudeTemplate`; the bra leaves are the
+        trailing ``len(determined)`` leaves of the network, in qubit
+        order (the slot contract the rebind layer relies on).
+        """
+        if mask is None:
+            mask = "0" * self.num_qubits()
+        mask = normalize_bitstring(mask, self.num_qubits())
+        network, permutor = self.into_amplitude_network(mask)
+        determined = tuple(i for i, c in enumerate(mask) if c != "*")
+        return AmplitudeTemplate(
+            network=network,
+            permutor=permutor,
+            num_qubits=len(mask),
+            determined=determined,
+            mask="".join("*" if c == "*" else "?" for c in mask),
+        )
 
     def into_statevector_network(self) -> tuple[CompositeTensor, Permutor]:
         return self.into_amplitude_network("*" * self.num_qubits())
@@ -217,3 +294,60 @@ class Circuit:
             observable.data = TensorData.gate("z")
             self.tensor_network.push_tensor(observable)
         return self.tensor_network
+
+
+@dataclass(frozen=True)
+class AmplitudeTemplate:
+    """A circuit closed with symbolic bras (``into_amplitude_template``).
+
+    ``network`` is a normal amplitude network whose trailing
+    ``len(determined)`` leaves are placeholder bras (one per determined
+    qubit, in qubit order); ``determined`` are the qubit positions that
+    carry a bra, the rest are open legs. A request bitstring supplies
+    one ``0``/``1`` per determined position; the open positions stay
+    ``*`` in every request.
+    """
+
+    network: CompositeTensor
+    permutor: Permutor
+    num_qubits: int
+    determined: tuple[int, ...]
+    mask: str  # '?' per determined position, '*' per open one
+
+    @property
+    def open_positions(self) -> frozenset[int]:
+        """Positions with no bra (computed once per template —
+        request validation runs per serving request)."""
+        cached = getattr(self, "_open_positions", None)
+        if cached is None:
+            cached = frozenset(range(self.num_qubits)) - frozenset(
+                self.determined
+            )
+            object.__setattr__(self, "_open_positions", cached)
+        return cached
+
+    def normalize_request(self, bitstring: str | Iterable) -> str:
+        """Validate a request against the template and return it as a
+        canonical full-length ``str``. One-shot iterables (generators)
+        are consumed exactly once here — callers that validate early
+        must carry THIS string forward, not the original object."""
+        bits = normalize_bitstring(bitstring, self.num_qubits)
+        open_set = self.open_positions
+        for pos, c in enumerate(bits):
+            if pos in open_set and c != "*":
+                raise ValueError(
+                    f"position {pos} is an open leg in this template; "
+                    f"request must use '*' there, got {c!r}"
+                )
+            if pos not in open_set and c == "*":
+                raise ValueError(
+                    f"position {pos} is determined in this template; "
+                    "request must supply '0' or '1' there"
+                )
+        return bits
+
+    def request_bits(self, bitstring: str | Iterable) -> str:
+        """The determined positions' bits of a validated request (a
+        ``len(self.determined)``-char ``0``/``1`` string, qubit order)."""
+        bits = self.normalize_request(bitstring)
+        return "".join(bits[p] for p in self.determined)

@@ -68,6 +68,32 @@ def random_open_circuit(
     return circuit
 
 
+def brickwork_circuit(
+    qubits: int, depth: int, rng: np.random.Generator
+) -> Circuit:
+    """Dense brickwork circuit (H layer, then per-round random-angle Rz
+    rotations + alternating CX bricks), unfinalized — the serving
+    workload generator shared by ``bench.py --serve`` and
+    ``scripts/serve_smoke.py`` (one recipe, so the smoke validates the
+    same structure the perf record measures). Deterministic in ``rng``:
+    same generator state → identical structure AND gate values."""
+    circuit = Circuit()
+    qr = circuit.allocate_register(qubits)
+    for q in range(qubits):
+        circuit.append_gate(TensorData.gate("h"), [qr.qubit(q)])
+    for d in range(depth):
+        for q in range(qubits):
+            circuit.append_gate(
+                TensorData.gate("rz", (float(rng.uniform(0, 3)),)),
+                [qr.qubit(q)],
+            )
+        for q in range(d % 2, qubits - 1, 2):
+            circuit.append_gate(
+                TensorData.gate("cx"), [qr.qubit(q), qr.qubit(q + 1)]
+            )
+    return circuit
+
+
 def random_circuit(
     qubits: int,
     rounds: int,

@@ -51,6 +51,21 @@ class Slicing:
         """Upper bound on the flops multiplier caused by slicing."""
         return float(self.num_slices)
 
+    def to_obj(self) -> dict:
+        """JSON-able form (plan serialization — the serving plan cache
+        persists path + slicing as plain JSON, never pickle)."""
+        return {"legs": list(self.legs), "dims": list(self.dims)}
+
+    @classmethod
+    def from_obj(cls, obj: dict) -> "Slicing":
+        """Inverse of :meth:`to_obj`.
+
+        >>> Slicing.from_obj(Slicing((3, 7), (2, 2)).to_obj())
+        Slicing(legs=(3, 7), dims=(2, 2))
+        """
+        return cls(tuple(int(l) for l in obj["legs"]),
+                   tuple(int(d) for d in obj["dims"]))
+
 
 class _PyReplayer:
     """Python-backed replayer with the native interface, so call sites

@@ -417,6 +417,44 @@ class NumpyBackend(Backend):
             out = _run_steps(np, program, buffers)
         return np.asarray(out).reshape(program.result_shape)
 
+    def execute_batched(
+        self,
+        program: ContractionProgram,
+        arrays: Sequence[Any],
+        batched: Sequence[int],
+    ) -> np.ndarray:
+        """Host counterpart of :meth:`JaxBackend.execute_batched`: the
+        slots in ``batched`` carry a leading ``(B, ...)`` axis, every
+        other slot is shared. The batch leg is threaded through the
+        step list (:mod:`tnc_tpu.ops.batched`) so each touched step
+        runs as one stacked GEMM — per-entry results bit-compare to B
+        sequential :meth:`execute` calls. Falls back to the sequential
+        loop when a step cannot carry the leg. Returns ``(B,) +
+        result_shape``. ``batched`` must name at least one slot — with
+        none there is no batch axis to thread; use :meth:`execute`."""
+        from tnc_tpu.ops.batched import (
+            run_steps_batched,
+            stacked_rows,
+            thread_batch,
+        )
+
+        batched = list(batched)
+        if not batched:
+            raise ValueError(
+                "execute_batched needs at least one batched slot; "
+                "use execute() for unbatched programs"
+            )
+        b = int(np.asarray(arrays[batched[0]]).shape[0])
+        flags, threadable = thread_batch(program, batched)
+        if threadable:
+            buffers = [np.asarray(a, dtype=self.dtype) for a in arrays]
+            out = run_steps_batched(np, program, buffers, flags)
+            return np.asarray(out).reshape((b,) + tuple(program.result_shape))
+        return stacked_rows(
+            lambda per: self.execute(program, per),
+            list(arrays), batched, b, program.result_shape,
+        )
+
     def execute_sliced(
         self,
         sp,

@@ -1,0 +1,146 @@
+"""Batch-leg threading through compiled contraction programs.
+
+Generic batched-execution machinery for the ops layer: given a
+:class:`~tnc_tpu.ops.program.ContractionProgram` and a set of input
+slots that carry a leading batch axis, :func:`thread_batch` marks, per
+:class:`~tnc_tpu.ops.program.PairStep`, which operands carry the axis
+(exactly the steps downstream of a batched slot), and
+:func:`run_steps_batched` executes the program with each touched step
+issued as ONE stacked matmul — the un-batched operand broadcasts, and
+steps the axis never reaches run exactly once.
+
+Per-batch-entry GEMMs see the same operands in the same summation
+order as the singleton kernel (:func:`~tnc_tpu.ops.backends.
+apply_step`'s host path), so on numpy a batch of B bit-compares to B
+sequential executions — the contract `NumpyBackend.execute_batched`
+and the serving layer (:mod:`tnc_tpu.serve.rebind`, the main consumer)
+rely on.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Sequence
+
+import numpy as np
+
+from tnc_tpu.ops.backends import _prep_operand, apply_step
+from tnc_tpu.ops.program import ContractionProgram
+
+
+def thread_batch(
+    program: ContractionProgram, batched_slots: Iterable[int]
+) -> tuple[tuple[tuple[bool, bool], ...], bool]:
+    """Propagate the batch leg through the program's steps.
+
+    Returns ``(flags, feasible)``: ``flags[i] = (lhs_batched,
+    rhs_batched)`` for step ``i``, and ``feasible`` is False when some
+    step cannot carry the leg — its batched operand has a staged prep
+    plan (``a_ops``/``b_ops``), whose reshape/lanemix shapes are baked
+    for the flat buffer — in which case callers must use a
+    vmap/stacked-dispatch fallback.
+
+    >>> from tnc_tpu.contractionpath.contraction_path import ContractionPath
+    >>> from tnc_tpu.ops.program import build_program
+    >>> from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+    >>> tn = CompositeTensor([LeafTensor.from_const([0], 2),
+    ...                       LeafTensor.from_const([0], 2)])
+    >>> program = build_program(tn, ContractionPath.simple([(0, 1)]))
+    >>> thread_batch(program, [1])   # slot 1 carries the batch axis
+    (((False, True),), True)
+    """
+    carried = set(batched_slots)
+    flags: list[tuple[bool, bool]] = []
+    feasible = True
+    for st in program.steps:
+        ab, bb = st.lhs in carried, st.rhs in carried
+        if (ab and st.a_ops is not None) or (bb and st.b_ops is not None):
+            feasible = False
+        flags.append((ab, bb))
+        if ab or bb:
+            carried.add(st.lhs)
+        else:
+            carried.discard(st.lhs)
+        carried.discard(st.rhs)
+    return tuple(flags), feasible
+
+
+def _prep_batched(xp, buf, view, perm, dot_shape):
+    """Batched analogue of ``_prep_operand``: the leading batch axis
+    rides through the fused-view reshape and macro transpose untouched."""
+    b = buf.shape[0]
+    v = buf.reshape((b,) + tuple(view))
+    if perm is not None:
+        v = xp.transpose(v, (0,) + tuple(p + 1 for p in perm))
+    return v.reshape((b,) + tuple(dot_shape))
+
+
+def _mat2(xp, v, mat, cfirst, batched):
+    """Dot operand → ``(B?, k, f)`` matrix (k always the second-minor)."""
+    if batched:
+        b = v.shape[0]
+        m = v.reshape((b,) + tuple(mat if cfirst else mat[::-1]))
+        return m if cfirst else xp.swapaxes(m, -1, -2)
+    m = v.reshape(tuple(mat if cfirst else mat[::-1]))
+    return m if cfirst else m.T
+
+
+def apply_step_batched(xp, a: Any, b: Any, step, ab: bool, bb: bool) -> Any:
+    """One pairwise contraction with an optional leading batch axis on
+    either operand. Reduces to the same 2-D GEMM per batch entry as the
+    host path of :func:`~tnc_tpu.ops.backends.apply_step` (operands and
+    summation order identical), so batched and sequential results
+    bit-compare on the numpy oracle; on JAX, ``jnp.matmul`` lowers to
+    one batched ``dot_general``."""
+    if not (ab or bb):
+        return apply_step(xp, a, b, step)
+    av = (
+        _prep_batched(xp, a, step.a_view, step.a_perm, step.a_dot)
+        if ab
+        else _prep_operand(xp, a, step.a_view, step.a_perm, step.a_dot, step.a_ops)
+    )
+    bv = (
+        _prep_batched(xp, b, step.b_view, step.b_perm, step.b_dot)
+        if bb
+        else _prep_operand(xp, b, step.b_view, step.b_perm, step.b_dot, step.b_ops)
+    )
+    a2 = _mat2(xp, av, step.a_mat, step.a_cfirst, ab)  # (B?, k, m)
+    b2 = _mat2(xp, bv, step.b_mat, step.b_cfirst, bb)  # (B?, k, n)
+    if step.swap:
+        out = xp.matmul(xp.swapaxes(b2, -1, -2) if bb else b2.T, a2)
+    else:
+        out = xp.matmul(xp.swapaxes(a2, -1, -2) if ab else a2.T, b2)
+    batch = a.shape[0] if ab else b.shape[0]
+    return out.reshape((batch,) + tuple(step.out_store))
+
+
+def run_steps_batched(
+    xp,
+    program: ContractionProgram,
+    buffers: list[Any],
+    flags: Sequence[tuple[bool, bool]],
+) -> Any:
+    """Execute all steps with the batch leg threaded per ``flags``;
+    result in ``(B,) + stored`` shape (the result is always batched when
+    any batched slot feeds it)."""
+    for st, (ab, bb) in zip(program.steps, flags):
+        buffers[st.lhs] = apply_step_batched(
+            xp, buffers[st.lhs], buffers[st.rhs], st, ab, bb
+        )
+        buffers[st.rhs] = None  # free eagerly
+    return buffers[program.result_slot]
+
+
+def stacked_rows(execute, buffers, batched_slots, b, result_shape):
+    """Sequential stacked dispatch: run ``execute`` once per batch
+    entry, selecting row ``i`` of each batched slot, and stack the
+    results as ``(B,) + result_shape``. The ONE fallback loop shared by
+    the numpy executor's non-threadable fallback and the serving
+    layer's sliced and generic-backend paths."""
+    bset = set(batched_slots)
+    rows = [
+        np.asarray(
+            execute([x[i] if s in bset else x for s, x in enumerate(buffers)])
+        )
+        for i in range(b)
+    ]
+    return np.stack(rows).reshape((b,) + tuple(result_shape))

@@ -303,6 +303,22 @@ def run_prelude(
     ]
 
 
+def hoist_split_counts(sp: SlicedProgram) -> dict:
+    """JSON-able summary of the hoist split — how many steps run once
+    (prelude) vs per slice (residual), and the flops on each side.
+    Persisted next to path + slicing by the serving plan cache so a
+    cached plan records the stem it was scored with."""
+    hp = hoist_sliced_program(sp)
+    return {
+        "prelude_steps": len(hp.prelude_steps),
+        "residual_steps": len(hp.residual.program.steps),
+        "invariant_flops": float(
+            steps_flops(ps.step for ps in hp.prelude_steps)
+        ),
+        "residual_flops": float(steps_flops(hp.residual.program.steps)),
+    }
+
+
 def hoist_step_flops(sp: SlicedProgram) -> tuple[float, float]:
     """(invariant_flops, per-slice residual_flops) of the compiled
     program, from the steps' dot shapes (naive multiply-add count per

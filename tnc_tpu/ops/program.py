@@ -658,6 +658,15 @@ class ContractionProgram:
         share a key."""
         return (self.num_inputs, self.steps, self.result_slot, self.result_shape)
 
+    def signature_digest(self) -> str:
+        """Stable hex digest of :meth:`signature` via the shared
+        canonical encoder — the form persisted by on-disk artifacts
+        (serving plan cache, checkpoint signatures) where the in-memory
+        tuple cannot be stored."""
+        from tnc_tpu.utils.digest import stable_digest
+
+        return stable_digest(self.signature())
+
 
 def build_program(tn: CompositeTensor, contract_path: ContractionPath) -> ContractionProgram:
     """Compile a (possibly nested) replace-left path over ``tn`` into a flat

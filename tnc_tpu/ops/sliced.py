@@ -55,6 +55,13 @@ class SlicedProgram:
     def signature(self) -> tuple:
         return (self.program.signature(), self.slicing, self.slot_slices)
 
+    def signature_digest(self) -> str:
+        """Stable hex digest of :meth:`signature` (shared canonical
+        encoder) — what sliced-plan artifacts persist on disk."""
+        from tnc_tpu.utils.digest import stable_digest
+
+        return stable_digest(self.signature())
+
 
 def build_sliced_program(
     tn: CompositeTensor, contract_path: ContractionPath, slicing: Slicing

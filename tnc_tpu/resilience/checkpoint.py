@@ -74,9 +74,14 @@ def resolve_ckpt(arg: str | None = None) -> str | None:
 
 def signature_hash(*parts: Any) -> str:
     """Stable digest of the program + execution parameters a checkpoint
-    is only valid for (repr-based: parts are ints/strs/program
-    signature tuples)."""
-    return hashlib.sha256(repr(parts).encode()).hexdigest()
+    is only valid for. Delegates to the shared canonical encoder
+    (:func:`tnc_tpu.utils.digest.stable_digest`) so checkpoint
+    signatures, benchmark cache keys, and the serving plan cache all
+    hash program state the same way — and the digest no longer depends
+    on ``repr`` (dict ordering / hash seeds)."""
+    from tnc_tpu.utils.digest import stable_digest
+
+    return stable_digest(*parts)
 
 
 def arrays_digest(arrays) -> str:

@@ -1,0 +1,37 @@
+"""tnc_tpu.serve — amplitude serving: plan cache, bra rebinding,
+batched queries, micro-batching front end.
+
+The serving pipeline, front to back:
+
+- :class:`ContractionService` (``service.py``) — async request queue,
+  micro-batching window, deadlines, admission control, retry +
+  batch→singleton degradation.
+- :class:`BoundProgram` / :func:`bind_circuit` (``rebind.py``) — one
+  compiled program per circuit *structure*; per-request bra leaf data
+  is rebound (and B requests batched into one dispatch) without
+  replanning or retracing.
+- :class:`PlanCache` (``plancache.py``) — persistent, LRU-bounded
+  ``{path, slicing, hoist split, executor config}`` store keyed by a
+  stable structure digest; repeat circuits skip the planner entirely.
+
+See ``docs/serving.md``.
+"""
+
+from tnc_tpu.serve.plancache import (  # noqa: F401
+    PlanCache,
+    network_structure_digest,
+)
+from tnc_tpu.serve.rebind import (  # noqa: F401
+    BoundProgram,
+    bind_circuit,
+    bind_template,
+    stacked_bras,
+    thread_batch,
+)
+from tnc_tpu.serve.service import (  # noqa: F401
+    ContractionService,
+    DeadlineExceededError,
+    QueueFullError,
+    ServeError,
+    ServiceClosedError,
+)

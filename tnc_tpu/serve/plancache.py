@@ -1,0 +1,245 @@
+"""Persistent, LRU-bounded plan cache for the serving path.
+
+Planning is the expensive, bitstring-independent part of an amplitude
+query: a path search over the circuit structure, optional
+slice-and-reconfigure, and the hoist split. This cache persists exactly
+that — ``{path, slicing, hoist split, executor config}`` as plain JSON
+(never pickle: a corrupted or adversarial entry must degrade to a
+replan, not arbitrary code) — keyed by a **structure digest** of the
+network's flat leaves (legs + bond dims), which every bitstring of a
+circuit shares. A repeat circuit therefore performs zero pathfinding
+(no ``plan.find_path`` span), and because the rebuilt
+:class:`~tnc_tpu.ops.program.ContractionProgram` has the same
+signature, a warm process-level jit cache also skips compilation.
+
+Discipline (shared with the other on-disk artifact stores):
+
+- digests come from the one canonical helper
+  (:func:`tnc_tpu.utils.digest.stable_digest` — also behind
+  ``resilience.checkpoint.signature_hash`` and
+  ``benchmark.cache.cache_key``), stable across hash seeds and dict
+  ordering;
+- every entry records ``program_sig`` = the rebuilt program's
+  ``signature_digest()``, validated after rebuild — a plan whose
+  compiler output drifted (planner/compiler version change) is
+  invalidated rather than trusted;
+- writes are atomic (temp file + ``os.replace``);
+- the cache is LRU-bounded by entry count (mtime = last use; loads
+  touch it), with corrupted entries deleted and counted, never raised.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from pathlib import Path
+
+from tnc_tpu import obs
+from tnc_tpu.contractionpath.contraction_path import ContractionPath
+from tnc_tpu.contractionpath.slicing import Slicing
+from tnc_tpu.tensornetwork.tensor import CompositeTensor
+from tnc_tpu.utils.digest import stable_digest
+
+logger = logging.getLogger(__name__)
+
+FORMAT_VERSION = 1
+
+
+def network_structure_digest(
+    tn: CompositeTensor, target_size: float | None = None
+) -> str:
+    """Stable digest of the network's contraction-relevant structure:
+    every flat leaf's (legs, dims), in slot order. Bitstring-independent
+    by construction — bra *values* never enter the digest — so all
+    2^n amplitude networks of one circuit share a key.
+
+    ``target_size`` (the caller's peak-memory budget) is part of the
+    key: a plan is only reusable under the budget it was made for — an
+    unsliced plan cached without a budget must never answer a
+    budget-constrained lookup (it would OOM the device the budget
+    modeled). Planner *identity* is deliberately not keyed: a cache
+    directory is assumed to serve one planner configuration, like the
+    benchmark plan cache's scheme prefix."""
+    from tnc_tpu.ops.program import flat_leaf_tensors
+
+    leaves = flat_leaf_tensors(tn)
+    return stable_digest(
+        "tnc-plan-v%d" % FORMAT_VERSION,
+        tuple((tuple(t.legs), tuple(t.bond_dims)) for t in leaves),
+        float(target_size) if target_size is not None else None,
+    )
+
+
+class PlanCache:
+    """On-disk plan store: ``<dir>/<structure-digest>.json`` entries.
+
+    >>> import tempfile
+    >>> cache = PlanCache(tempfile.mkdtemp(), max_entries=2)
+    >>> plan = {"version": 1, "pairs": [[0, 1]], "program_sig": "x"}
+    >>> cache.store("k1", plan)
+    >>> cache.load("k1")["pairs"]
+    [[0, 1]]
+    >>> cache.load("missing") is None
+    True
+    """
+
+    def __init__(self, directory: str | Path, max_entries: int = 256):
+        self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self.max_entries = max(1, int(max_entries))
+
+    def _path(self, key: str) -> Path:
+        return self.directory / f"{key}.json"
+
+    def key_for_network(
+        self, tn: CompositeTensor, target_size: float | None = None
+    ) -> str:
+        return network_structure_digest(tn, target_size)
+
+    # -- entries -----------------------------------------------------------
+
+    def record_for(
+        self,
+        path: ContractionPath,
+        program,
+        slicing: Slicing | None = None,
+        sliced_program=None,
+        executor: dict | None = None,
+        flops: float | None = None,
+        peak: float | None = None,
+    ) -> dict:
+        """Build the JSON plan record for a freshly planned structure:
+        path pairs, optional slicing + hoist split (computed from
+        ``sliced_program`` when given), executor config, and the
+        program-signature digest the entry is validated against."""
+        plan: dict = {
+            "version": FORMAT_VERSION,
+            "pairs": path.to_obj(),
+            "slicing": slicing.to_obj() if slicing is not None else None,
+            "hoist": None,
+            "executor": dict(executor) if executor else None,
+            "program_sig": program.signature_digest(),
+            "created_at": time.time(),
+        }
+        if sliced_program is not None:
+            from tnc_tpu.ops.hoist import hoist_split_counts
+
+            plan["hoist"] = hoist_split_counts(sliced_program)
+            plan["sliced_sig"] = sliced_program.signature_digest()
+        if flops is not None:
+            plan["flops"] = float(flops)
+        if peak is not None:
+            plan["peak"] = float(peak)
+        return plan
+
+    def validate(self, plan: dict, program) -> bool:
+        """True when ``program`` (rebuilt from the cached path) matches
+        the signature the plan was stored with."""
+        return plan.get("program_sig") == program.signature_digest()
+
+    @staticmethod
+    def plan_path(plan: dict) -> ContractionPath:
+        return ContractionPath.from_obj(plan["pairs"])
+
+    @staticmethod
+    def plan_slicing(plan: dict) -> Slicing | None:
+        obj = plan.get("slicing")
+        return Slicing.from_obj(obj) if obj else None
+
+    # -- storage -----------------------------------------------------------
+
+    def load(self, key: str) -> dict | None:
+        """The cached plan, or None (absent / corrupt / wrong version —
+        corruption is deleted and counted, never raised: a bad entry
+        degrades to a replan)."""
+        target = self._path(key)
+        try:
+            with open(target, "r", encoding="utf-8") as fh:
+                plan = json.load(fh)
+            if (
+                not isinstance(plan, dict)
+                or plan.get("version") != FORMAT_VERSION
+                or not isinstance(plan.get("pairs"), list)
+            ):
+                raise ValueError(f"unusable plan entry: {plan!r:.80}")
+        except FileNotFoundError:
+            obs.counter_add("serve.plan_cache.miss")
+            return None
+        except Exception as exc:  # noqa: BLE001 — any corruption → replan
+            logger.warning(
+                "plan cache entry %s unreadable (%s: %s); dropping it",
+                target, type(exc).__name__, exc,
+            )
+            obs.counter_add("serve.plan_cache.corrupt")
+            obs.counter_add("serve.plan_cache.miss")
+            try:
+                target.unlink(missing_ok=True)
+            except OSError:
+                pass
+            return None
+        obs.counter_add("serve.plan_cache.hit")
+        try:  # LRU touch: mtime records last use
+            os.utime(target)
+        except OSError:
+            pass
+        return plan
+
+    def store(self, key: str, plan: dict) -> None:
+        """Atomic write + LRU eviction down to ``max_entries``.
+
+        Best-effort, mirroring :meth:`load`: the cache is an
+        optimization, so a write failure (disk full, permissions, dir
+        removed) is logged and counted — never raised. The caller holds
+        the freshly planned program in memory either way."""
+        target = self._path(key)
+        tmp = target.with_suffix(".json.tmp")
+        try:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(plan, fh)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, target)
+        except OSError as exc:
+            logger.warning(
+                "plan cache store of %s failed (%s: %s); serving from "
+                "the in-memory plan", target, type(exc).__name__, exc,
+            )
+            obs.counter_add("serve.plan_cache.store_failed")
+            return
+        obs.counter_add("serve.plan_cache.store")
+        self._evict()
+
+    def invalidate(self, key: str) -> None:
+        try:
+            self._path(key).unlink(missing_ok=True)
+        except OSError:
+            pass
+        obs.counter_add("serve.plan_cache.invalidated")
+
+    def _entries(self) -> list[Path]:
+        return [
+            p for p in self.directory.glob("*.json") if p.is_file()
+        ]
+
+    def _evict(self) -> None:
+        entries = self._entries()
+        if len(entries) <= self.max_entries:
+            return
+        def mtime(p: Path) -> float:
+            try:
+                return p.stat().st_mtime
+            except OSError:
+                return 0.0
+        entries.sort(key=mtime)
+        for victim in entries[: len(entries) - self.max_entries]:
+            try:
+                victim.unlink(missing_ok=True)
+                obs.counter_add("serve.plan_cache.evicted")
+                logger.info("plan cache evicted %s (LRU)", victim.name)
+            except OSError:
+                pass
+
+    def __len__(self) -> int:
+        return len(self._entries())

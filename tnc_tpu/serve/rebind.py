@@ -1,0 +1,387 @@
+"""Bra rebinding: many bitstrings through one compiled program.
+
+An amplitude network's *structure* is bitstring-independent — the
+planner's path, the compiled :class:`~tnc_tpu.ops.program.
+ContractionProgram`, its signature (and therefore the jit cache key),
+and every gate leaf are shared by all ``2^n`` bitstrings; only the
+2-element ⟨0|/⟨1| bra leaves differ. This module treats the program as
+a reusable symbolic expression bound to fresh bra leaf data per request
+(the EinExprs view, arXiv:2403.18030): a :class:`BoundProgram` is built
+once per circuit structure and each query is O(contract-residual) — no
+replanning, no retracing.
+
+Batching: ``B`` bitstrings stack their one-hot bras along a new leading
+batch leg. The primary path *threads that leg through the affected
+PairSteps* — :func:`thread_batch` marks, per step, which operands carry
+it, and :func:`apply_step_batched` issues one batched matmul per
+touched step (``xp.matmul`` broadcasts the un-batched operand), so the
+whole batch is one dispatch and steps the batch leg never reaches run
+exactly once. Per-batch-entry GEMMs see the same operands in the same
+order as the singleton program, so on the numpy oracle a batch of B
+bit-compares to B sequential contractions (pinned by
+``tests/test_serve.py``). A step that cannot carry the leg (its
+batched operand has a staged device prep plan, whose op shapes are
+baked flat) degrades the whole program to the vmap/stacked-dispatch
+fallback (:meth:`JaxBackend.execute_batched` on device, a per-entry
+loop on the host oracle).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Iterable, Sequence
+
+import numpy as np
+
+from tnc_tpu import obs
+from tnc_tpu.builders.circuit_builder import AmplitudeTemplate
+from tnc_tpu.ops.backends import Backend, JaxBackend, NumpyBackend
+from tnc_tpu.ops.batched import (  # noqa: F401 — re-exported serving API
+    apply_step_batched,
+    run_steps_batched,
+    stacked_rows,
+    thread_batch,
+)
+from tnc_tpu.ops.program import (
+    ContractionProgram,
+    build_program,
+    flat_leaf_tensors,
+)
+from tnc_tpu.ops.sliced import build_sliced_program
+
+logger = logging.getLogger(__name__)
+
+_BRA = {
+    "0": np.array([1.0 + 0.0j, 0.0 + 0.0j]),
+    "1": np.array([0.0 + 0.0j, 1.0 + 0.0j]),
+}
+
+
+def stacked_bras(batch_bits: Sequence[str]) -> np.ndarray:
+    """One-hot bra values for a batch: ``(B, n_det, 2)``, qubit order.
+
+    >>> stacked_bras(["01"]).tolist()[0]
+    [[(1+0j), 0j], [0j, (1+0j)]]
+    """
+    return np.stack(
+        [np.stack([_BRA[c] for c in bits]) for bits in batch_bits]
+    )
+
+
+# One traced threaded-batch executable per (program, flags); retraces
+# per batch size like the vmap path. Locked: services dispatch from a
+# worker thread while tests touch the cache from the main thread.
+_THREADED_JIT_CACHE: "OrderedDict[tuple, Any]" = OrderedDict()
+_THREADED_JIT_CACHE_MAX = 128
+_THREADED_JIT_LOCK = threading.Lock()
+
+
+def _jit_threaded(program: ContractionProgram, flags) -> Any:
+    import jax
+    import jax.numpy as jnp
+
+    key = (program.signature(), flags)
+    with _THREADED_JIT_LOCK:
+        fn = _THREADED_JIT_CACHE.get(key)
+        if fn is not None:
+            _THREADED_JIT_CACHE.move_to_end(key)
+    obs.counter_add(
+        "jit_cache.hit" if fn is not None else "jit_cache.miss"
+    )
+    if fn is None:
+
+        def run(buffers):
+            return run_steps_batched(jnp, program, list(buffers), flags)
+
+        fn = jax.jit(run)
+        with _THREADED_JIT_LOCK:
+            _THREADED_JIT_CACHE[key] = fn
+            while len(_THREADED_JIT_CACHE) > _THREADED_JIT_CACHE_MAX:
+                _THREADED_JIT_CACHE.popitem(last=False)
+    return fn
+
+
+@dataclass
+class BoundProgram:
+    """A compiled amplitude program with rebindable bra leaves.
+
+    Built once per circuit *structure* (:func:`bind_template`); each
+    :meth:`amplitudes` call swaps per-request bra values into the bra
+    slots and dispatches — no replanning, no retracing (the program
+    signature, and therefore every jit cache key, is shared).
+    """
+
+    template: AmplitudeTemplate
+    program: ContractionProgram
+    arrays: list[np.ndarray]  # leaf data; bra slots hold placeholders
+    bra_slots: tuple[int, ...]  # one per determined qubit, qubit order
+    batch_flags: tuple[tuple[bool, bool], ...]
+    threadable: bool  # batch leg threads through every touched step
+    plan: dict = field(default_factory=dict)  # plan-cache record (if any)
+    # HBM-constrained structures carry a sliced plan: each request runs
+    # the slice loop (stacked dispatch; the batch leg stops here)
+    sliced: Any = None  # SlicedProgram | None
+    # device-resident bitstring-invariant leaves, keyed by
+    # (dtype, device): staged once, reused by every threaded-jax
+    # dispatch — only the (B, n_det, 2) bras transfer per batch
+    _resident: dict = field(default_factory=dict, repr=False, compare=False)
+
+    @property
+    def result_shape(self) -> tuple[int, ...]:
+        return tuple(self.program.result_shape)
+
+    def _batch_buffers(self, batch_bits: Sequence[str]) -> list[np.ndarray]:
+        bras = stacked_bras(batch_bits)  # (B, n_det, 2)
+        buffers = list(self.arrays)
+        for i, slot in enumerate(self.bra_slots):
+            buffers[slot] = np.ascontiguousarray(bras[:, i])
+        return buffers
+
+    def amplitudes(
+        self,
+        bitstrings: Sequence[str | Iterable],
+        backend: Backend | None = None,
+    ) -> np.ndarray:
+        """Amplitudes for a batch of request bitstrings, one dispatch.
+
+        Returns ``(B,) + result_shape`` (open-leg axes in the program's
+        result-leg order — scalar amplitudes for fully determined
+        templates). On the numpy backend the batched result
+        bit-compares to B sequential singleton contractions.
+        """
+        return self.amplitudes_det(
+            [self.template.request_bits(b) for b in bitstrings], backend
+        )
+
+    def amplitudes_det(
+        self,
+        batch_bits: Sequence[str],
+        backend: Backend | None = None,
+    ) -> np.ndarray:
+        """:meth:`amplitudes` over already-validated determined-position
+        bit strings (``template.request_bits`` output) — the service
+        dispatches these directly so per-request validation runs once,
+        at admission, not again on the batching hot path."""
+        if backend is None:
+            backend = NumpyBackend()
+        if not batch_bits:
+            return np.zeros((0,) + self.result_shape, dtype=np.complex128)
+        if not self.bra_slots:
+            # fully-open template: every request is the same statevector
+            out = np.asarray(backend.execute(self.program, list(self.arrays)))
+            return np.broadcast_to(out, (len(batch_bits),) + out.shape).copy()
+        buffers = self._batch_buffers(batch_bits)
+        b = len(batch_bits)
+
+        if self.sliced is not None:
+            # sliced structures: one slice-loop execution per request
+            # (stacked dispatch — the batch leg would multiply the
+            # already-HBM-bound per-slice peak)
+            obs.counter_add("serve.rebind.dispatch", mode="sliced")
+            return stacked_rows(
+                lambda per: backend.execute_sliced(self.sliced, per),
+                buffers, self.bra_slots, b, self.result_shape,
+            )
+
+        if isinstance(backend, NumpyBackend):
+            obs.counter_add(
+                "serve.rebind.dispatch",
+                mode="threaded" if self.threadable else "loop",
+            )
+            out = backend.execute_batched(self.program, buffers, self.bra_slots)
+            return out.reshape((b,) + self.result_shape)
+
+        if isinstance(backend, JaxBackend):
+            if self.threadable and not backend.split_complex:
+                from tnc_tpu.ops.backends import place_buffers
+
+                obs.counter_add("serve.rebind.dispatch", mode="threaded")
+                # bucket the batch axis to the next power of two (pad
+                # with copies of the last request, sliced off below):
+                # XLA compiles one executable per shape, and service
+                # traffic otherwise produces a fresh trace per distinct
+                # batch size
+                padded = 1 << (b - 1).bit_length()
+                if padded != b:
+                    obs.counter_add("serve.rebind.batch_padded")
+                    for slot in self.bra_slots:
+                        fill = np.broadcast_to(
+                            buffers[slot][-1], (padded - b, 2)
+                        )
+                        buffers[slot] = np.concatenate(
+                            [buffers[slot], fill]
+                        )
+                fn = _jit_threaded(self.program, self.batch_flags)
+                # gate leaves are bitstring-invariant: stage them to the
+                # device ONCE and reuse across dispatches (the jitted fn
+                # never donates); only the bras transfer per batch
+                res_key = (str(backend.dtype), backend.device)
+                resident = self._resident.get(res_key)
+                if resident is None:
+                    bra_set = set(self.bra_slots)
+                    resident = {
+                        s: buf
+                        for s, buf in enumerate(
+                            place_buffers(
+                                self.arrays, backend.dtype, False,
+                                backend.device,
+                            )
+                        )
+                        if s not in bra_set
+                    }
+                    self._resident[res_key] = resident
+                bra_dev = place_buffers(
+                    [buffers[s] for s in self.bra_slots],
+                    backend.dtype, False, backend.device,
+                )
+                bra_of = dict(zip(self.bra_slots, bra_dev))
+                dev = [
+                    bra_of[s] if s in bra_of else resident[s]
+                    for s in range(len(buffers))
+                ]
+                out = np.asarray(fn(dev))[:b]
+                return out.reshape((b,) + self.result_shape)
+            obs.counter_add("serve.rebind.dispatch", mode="vmap")
+            out = backend.execute_batched(
+                self.program, buffers, self.bra_slots
+            )
+            return np.asarray(out).reshape((b,) + self.result_shape)
+
+        # unknown backend: stacked dispatch (same results, B dispatches)
+        obs.counter_add("serve.rebind.dispatch", mode="loop")
+        return stacked_rows(
+            lambda per: backend.execute(self.program, per),
+            buffers, self.bra_slots, b, self.result_shape,
+        )
+
+
+def bind_template(
+    template: AmplitudeTemplate,
+    pathfinder=None,
+    plan_cache=None,
+    target_size: float | None = None,
+) -> BoundProgram:
+    """Plan (or load a cached plan for) ``template`` and compile it into
+    a :class:`BoundProgram`.
+
+    With a :class:`~tnc_tpu.serve.plancache.PlanCache`, a repeat
+    structure loads its path from disk and performs **zero pathfinding**
+    (no ``plan.find_path`` span) — and since the rebuilt program's
+    signature is unchanged, a warm process-level jit cache also skips
+    compilation.
+
+    ``target_size``: peak-intermediate budget (elements). When the
+    planned path exceeds it, the structure is sliced
+    (``slice_and_reconfigure``) and the slicing + hoist split persist
+    in the plan record; serving then runs the slice loop per request.
+    """
+    from tnc_tpu.contractionpath.contraction_path import ContractionPath
+
+    tn = template.network
+    leaves = flat_leaf_tensors(tn)
+    n_det = len(template.determined)
+    bra_slots = tuple(range(len(leaves) - n_det, len(leaves)))
+
+    plan: dict = {}
+    key = None
+    pairs = None
+    slicing = None
+    if plan_cache is not None:
+        # the budget is part of the key: a plan cached without (or with a
+        # different) target_size must not answer this lookup
+        key = plan_cache.key_for_network(tn, target_size)
+        plan = plan_cache.load(key) or {}
+        pairs = plan.get("pairs")
+    if pairs is None:
+        if pathfinder is None:
+            from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+
+            pathfinder = Greedy(OptMethod.GREEDY)
+        result = pathfinder.find_path(tn)
+        if target_size is not None and result.size > target_size:
+            from tnc_tpu.contractionpath.slicing import slice_and_reconfigure
+
+            replace_pairs, slicing = slice_and_reconfigure(
+                list(tn.tensors), result.ssa_path.toplevel, target_size
+            )
+            if slicing.num_slices <= 1:
+                slicing = None
+            path = ContractionPath.simple(list(replace_pairs))
+        else:
+            path = result.replace_path()
+        program = build_program(tn, path)
+        sliced = (
+            build_sliced_program(tn, path, slicing)
+            if slicing is not None
+            else None
+        )
+        if plan_cache is not None:
+            plan = plan_cache.record_for(
+                path,
+                program,
+                slicing=slicing,
+                sliced_program=sliced,
+                flops=result.flops,
+                peak=result.size,
+            )
+            plan_cache.store(key, plan)
+    else:
+        try:
+            path = ContractionPath.from_obj(pairs)
+            slicing = plan_cache.plan_slicing(plan)
+            program = build_program(tn, path)
+            valid = plan_cache.validate(plan, program)
+            sliced = (
+                build_sliced_program(tn, path, slicing)
+                if valid and slicing is not None and slicing.num_slices > 1
+                else None
+            )
+            if sliced is not None and plan.get("sliced_sig") not in (
+                None, sliced.signature_digest()
+            ):
+                # the sliced compilation drifted from what the plan was
+                # stored with (slicer/compiler version change)
+                valid = False
+        except Exception as exc:  # noqa: BLE001 — any bad entry → replan
+            # valid JSON but semantically corrupt (out-of-range pairs,
+            # planner drift): the cache contract is degrade-to-replan,
+            # never raise — and never leave the poison pill on disk
+            logger.warning(
+                "cached plan %s does not rebuild (%s: %s); replanning",
+                key, type(exc).__name__, exc,
+            )
+            valid = False
+        if not valid:
+            plan_cache.invalidate(key)
+            return bind_template(template, pathfinder, plan_cache, target_size)
+
+    arrays = [leaf.data.into_data() for leaf in leaves]
+    flags, threadable = thread_batch(program, bra_slots)
+    return BoundProgram(
+        template=template,
+        program=program,
+        arrays=arrays,
+        bra_slots=bra_slots,
+        batch_flags=flags,
+        threadable=threadable,
+        plan=plan,
+        sliced=sliced,
+    )
+
+
+def bind_circuit(
+    circuit,
+    mask: str | Iterable | None = None,
+    pathfinder=None,
+    plan_cache=None,
+    target_size: float | None = None,
+) -> BoundProgram:
+    """``into_amplitude_template`` + :func:`bind_template` in one call
+    (consumes ``circuit``, finalizer semantics)."""
+    return bind_template(
+        circuit.into_amplitude_template(mask), pathfinder, plan_cache,
+        target_size,
+    )

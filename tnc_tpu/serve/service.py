@@ -1,0 +1,402 @@
+"""Amplitude-serving front end: async queue + micro-batching dispatcher.
+
+:class:`ContractionService` turns a :class:`~tnc_tpu.serve.rebind.
+BoundProgram` into a request server. Callers submit bitstrings (from
+any thread, or ``await`` the asyncio facade); a dispatcher thread
+collects requests into micro-batches — up to ``max_batch`` riders or
+``max_wait_ms`` after the first arrival, whichever comes first — and
+issues ONE rebind dispatch per batch, the TPU-native shape for
+amplitude traffic (one compiled program, B bitstrings per dispatch).
+
+Production posture:
+
+- **admission control**: a bounded queue; submissions beyond
+  ``max_queue`` fail fast with :class:`QueueFullError` instead of
+  growing latency without bound;
+- **deadlines**: each request may carry a timeout; requests that
+  expire while queued are completed with
+  :class:`DeadlineExceededError` at batch assembly (they never waste a
+  dispatch);
+- **resilience**: the batch dispatch runs under the shared
+  :class:`~tnc_tpu.resilience.retry.RetryPolicy` (transient runtime
+  failures retry with backoff); a batch that still fails **degrades to
+  singleton requests** — each rider is re-dispatched alone, so one
+  poisoned request cannot fail its co-riders;
+- **observability**: ``serve.queue_depth`` gauge,
+  ``serve.batch_size``/``serve.latency_s``/``serve.wait_s``
+  histograms, ``serve.requests.*`` counters, plus the plan-cache
+  hit/miss counters from :mod:`tnc_tpu.serve.plancache`.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Iterable
+
+import numpy as np
+
+from tnc_tpu import obs
+from tnc_tpu.resilience import retry as _retry
+from tnc_tpu.serve.rebind import BoundProgram, bind_circuit
+
+logger = logging.getLogger(__name__)
+
+
+class ServeError(RuntimeError):
+    """Base class for serving-layer failures."""
+
+
+class QueueFullError(ServeError):
+    """Admission control rejected the request (queue at ``max_queue``)."""
+
+
+class DeadlineExceededError(ServeError):
+    """The request's deadline passed before it could be dispatched."""
+
+
+class ServiceClosedError(ServeError):
+    """The service is stopped and no longer accepts requests."""
+
+
+@dataclass
+class _Request:
+    bits: str | Iterable
+    future: concurrent.futures.Future
+    deadline: float | None  # absolute monotonic, None = no deadline
+    t_submit: float = field(default_factory=time.monotonic)
+
+
+_STATS_CAP = 4096  # bounded in-memory samples for stats()/bench
+
+
+class ContractionService:
+    """Micro-batching amplitude server over one bound program.
+
+    >>> from tnc_tpu.builders.circuit_builder import Circuit
+    >>> from tnc_tpu.tensornetwork.tensordata import TensorData
+    >>> c = Circuit(); reg = c.allocate_register(2)
+    >>> c.append_gate(TensorData.gate("h"), [reg.qubit(0)])
+    >>> c.append_gate(TensorData.gate("cx"), [reg.qubit(0), reg.qubit(1)])
+    >>> with ContractionService.from_circuit(c) as svc:
+    ...     amp = svc.amplitude("00")
+    >>> round(abs(amp), 6)
+    0.707107
+    """
+
+    def __init__(
+        self,
+        bound: BoundProgram,
+        backend=None,
+        max_batch: int = 32,
+        max_wait_ms: float = 2.0,
+        max_queue: int = 1024,
+        retry_policy: _retry.RetryPolicy | None = None,
+    ):
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self.bound = bound
+        self.backend = backend  # None → rebind's numpy default
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self.max_queue = int(max_queue)
+        self.retry_policy = retry_policy or _retry.default_policy()
+        self._queue: deque[_Request] = deque()
+        self._cond = threading.Condition()
+        self._running = False
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+        self._counts = {
+            "submitted": 0, "completed": 0, "failed": 0,
+            "expired": 0, "rejected": 0, "cancelled": 0,
+            "batches": 0, "degraded_batches": 0,
+        }
+        self._batch_sizes: deque[int] = deque(maxlen=_STATS_CAP)
+        self._latencies: deque[float] = deque(maxlen=_STATS_CAP)
+
+    @classmethod
+    def from_circuit(
+        cls,
+        circuit,
+        mask=None,
+        pathfinder=None,
+        plan_cache=None,
+        backend=None,
+        target_size=None,
+        **kwargs,
+    ) -> "ContractionService":
+        """Build (plan/compile once, plan cache honored) and start."""
+        bound = bind_circuit(circuit, mask, pathfinder, plan_cache, target_size)
+        svc = cls(bound, backend=backend, **kwargs)
+        svc.start()
+        return svc
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ContractionService":
+        with self._cond:
+            if self._running:
+                return self
+            self._running = True
+        self._thread = threading.Thread(
+            target=self._dispatch_loop, name="tnc-serve-dispatch", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        """Stop accepting requests; by default finish ('drain') what is
+        already queued, otherwise fail queued requests with
+        :class:`ServiceClosedError`."""
+        with self._cond:
+            if not self._running:
+                return
+            self._running = False
+            if not drain:
+                while self._queue:
+                    req = self._queue.popleft()
+                    self._complete(req, exc=ServiceClosedError("stopped"))
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=60.0)
+            self._thread = None
+
+    def __enter__(self) -> "ContractionService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- submission --------------------------------------------------------
+
+    def submit(
+        self, bitstring: str | Iterable, timeout_s: float | None = None
+    ) -> concurrent.futures.Future:
+        """Enqueue one amplitude request; returns a ``Future`` resolving
+        to the amplitude (complex scalar, or an ndarray over the
+        template's open legs). ``timeout_s`` arms a deadline."""
+        # validate at admission: a malformed request must fail alone,
+        # immediately — not poison a whole batch at dispatch time. The
+        # determined-position bits (not the raw object) are what gets
+        # queued: a one-shot iterable is consumed by this validation,
+        # and dispatch never re-validates
+        bitstring = self.bound.template.request_bits(bitstring)
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        deadline = (
+            time.monotonic() + float(timeout_s) if timeout_s is not None else None
+        )
+        with self._cond:
+            if not self._running:
+                self._count("rejected")
+                obs.counter_add("serve.requests.rejected", reason="closed")
+                raise ServiceClosedError("service is not running")
+            if len(self._queue) >= self.max_queue:
+                self._count("rejected")
+                obs.counter_add("serve.requests.rejected", reason="queue_full")
+                raise QueueFullError(
+                    f"queue at max_queue={self.max_queue}; retry later"
+                )
+            self._queue.append(_Request(bitstring, fut, deadline))
+            depth = len(self._queue)
+            self._cond.notify()
+        self._count("submitted")
+        obs.counter_add("serve.requests.submitted")
+        obs.gauge_set("serve.queue_depth", depth)
+        return fut
+
+    def amplitude(self, bitstring, timeout_s: float | None = None):
+        """Blocking single-amplitude query (deadline doubles as the
+        caller-side wait bound)."""
+        fut = self.submit(bitstring, timeout_s)
+        return fut.result(
+            timeout=None if timeout_s is None else float(timeout_s) + 60.0
+        )
+
+    async def amplitude_async(self, bitstring, timeout_s: float | None = None):
+        """Asyncio facade: ``await service.amplitude_async("0101")``."""
+        import asyncio
+
+        return await asyncio.wrap_future(self.submit(bitstring, timeout_s))
+
+    # -- dispatcher --------------------------------------------------------
+
+    def _collect_batch(self) -> list[_Request] | None:
+        """Block for the first request, then hold the window open up to
+        ``max_wait_s`` (or until ``max_batch`` riders); None = stopped
+        and drained."""
+        with self._cond:
+            while not self._queue:
+                if not self._running:
+                    return None
+                self._cond.wait(timeout=0.1)
+            t0 = time.monotonic()
+            deadline = t0 + self.max_wait_s
+            while (
+                len(self._queue) < self.max_batch
+                and time.monotonic() < deadline
+            ):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._cond.wait(timeout=remaining):
+                    break
+            batch = [
+                self._queue.popleft()
+                for _ in range(min(self.max_batch, len(self._queue)))
+            ]
+            obs.gauge_set("serve.queue_depth", len(self._queue))
+            return batch
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            batch = self._collect_batch()
+            if batch is None:
+                return
+            try:
+                self._run_batch(batch)
+            except Exception as exc:  # noqa: BLE001 — the dispatcher must survive
+                # _run_batch handles dispatch failures itself; anything
+                # reaching here is a bookkeeping bug — fail the batch,
+                # keep serving
+                logger.exception("dispatcher batch processing failed")
+                for req in batch:
+                    self._complete(req, exc=ServeError(f"dispatcher error: {exc}"))
+
+    def _complete(self, req: _Request, result=None, exc=None) -> bool:
+        """Resolve a request's future, tolerating caller-side
+        cancellation (``fut.cancel()`` / an abandoned asyncio await):
+        completing a cancelled future raises ``InvalidStateError``,
+        which must never kill the dispatcher thread."""
+        try:
+            if exc is not None:
+                req.future.set_exception(exc)
+            else:
+                req.future.set_result(result)
+            return True
+        except concurrent.futures.InvalidStateError:
+            self._count("cancelled")
+            obs.counter_add("serve.requests.cancelled")
+            return False
+
+    def _per_request(self, amps: np.ndarray, i: int):
+        out = amps[i]
+        # copy, not view: co-riders must never alias one mutable batch
+        # buffer (an in-place edit by one caller would corrupt another's
+        # already-delivered result)
+        return complex(out) if out.shape == () else np.array(out)
+
+    def _run_batch(self, batch: list[_Request]) -> None:
+        now = time.monotonic()
+        live: list[_Request] = []
+        for req in batch:
+            if req.deadline is not None and now > req.deadline:
+                self._count("expired")
+                obs.counter_add("serve.requests.expired")
+                self._complete(
+                    req,
+                    exc=DeadlineExceededError(
+                        f"deadline exceeded after "
+                        f"{now - req.t_submit:.3f}s in queue"
+                    ),
+                )
+            else:
+                live.append(req)
+        if not live:
+            return
+        self._count("batches")
+        with self._lock:
+            self._batch_sizes.append(len(live))
+        obs.observe("serve.batch_size", len(live))
+        for req in live:
+            obs.observe("serve.wait_s", now - req.t_submit)
+
+        bits = [req.bits for req in live]
+        try:
+            with obs.span("serve.dispatch", batch=len(live)):
+                amps = self.retry_policy.run(
+                    lambda: self.bound.amplitudes_det(bits, self.backend),
+                    label="serve.dispatch",
+                )
+        except Exception as exc:  # noqa: BLE001 — degrade to singletons
+            logger.warning(
+                "batch of %d failed (%s: %s); degrading to singleton "
+                "requests", len(live), type(exc).__name__, exc,
+            )
+            self._count("degraded_batches")
+            obs.counter_add("serve.batch_degraded")
+            self._run_singletons(live)
+            return
+        done = time.monotonic()
+        for i, req in enumerate(live):
+            if self._complete(req, result=self._per_request(amps, i)):
+                self._finish(req, done)
+
+    def _run_singletons(self, batch: list[_Request]) -> None:
+        """Degraded mode: each rider re-dispatched alone — one bad
+        request (or a transient that outlived its retries) fails only
+        itself."""
+        for req in batch:
+            try:
+                amps = self.bound.amplitudes_det([req.bits], self.backend)
+            except Exception as exc:  # noqa: BLE001 — per-request verdict
+                self._count("failed")
+                obs.counter_add("serve.requests.failed")
+                self._complete(req, exc=exc)
+                continue
+            if self._complete(req, result=self._per_request(amps, 0)):
+                self._finish(req, time.monotonic())
+
+    def _finish(self, req: _Request, done: float) -> None:
+        self._count("completed")
+        obs.counter_add("serve.requests.completed")
+        latency = done - req.t_submit
+        with self._lock:
+            self._latencies.append(latency)
+        obs.observe("serve.latency_s", latency)
+
+    # -- stats -------------------------------------------------------------
+
+    def _count(self, key: str) -> None:
+        with self._lock:
+            self._counts[key] += 1
+
+    def reset_stats(self) -> None:
+        """Zero the in-memory counts and samples — benchmarks call this
+        after their warmup so compile-time requests never skew the
+        published batch-size/latency distribution."""
+        with self._lock:
+            for key in self._counts:
+                self._counts[key] = 0
+            self._batch_sizes.clear()
+            self._latencies.clear()
+
+    def stats(self) -> dict:
+        """Snapshot for dashboards and ``bench.py --serve``: request
+        counts, batch-size distribution, and latency percentiles."""
+        with self._lock:
+            counts = dict(self._counts)
+            sizes = list(self._batch_sizes)
+            lats = sorted(self._latencies)
+
+        def pct(sorted_vals: list[float], q: float) -> float:
+            if not sorted_vals:
+                return 0.0
+            idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1)))
+            return float(sorted_vals[idx])
+
+        return {
+            "counts": counts,
+            "batch_size": {
+                "count": len(sizes),
+                "min": int(min(sizes)) if sizes else 0,
+                "max": int(max(sizes)) if sizes else 0,
+                "mean": float(np.mean(sizes)) if sizes else 0.0,
+            },
+            "latency_s": {
+                "p50": round(pct(lats, 0.50), 6),
+                "p99": round(pct(lats, 0.99), 6),
+                "max": round(lats[-1], 6) if lats else 0.0,
+            },
+        }
