@@ -57,6 +57,9 @@ TNC_TPU_PLATFORM=cpu python scripts/crash_resume_smoke.py
 echo "== serving smoke (concurrent queries vs oracle, plan-cache hit) =="
 TNC_TPU_PLATFORM=cpu python scripts/serve_smoke.py
 
+echo "== fused-chain smoke (multi-step Pallas kernel, interpret mode: dispatch spans drop) =="
+TNC_TPU_PLATFORM=cpu python scripts/chain_smoke.py
+
 echo "== examples =="
 # TNC_TPU_PLATFORM pins JAX to CPU via jax.config (env vars alone can be
 # overridden by interpreter startup hooks that pre-wire an accelerator);

@@ -46,6 +46,8 @@ REQUIRED_SUBPACKAGES = (
 REQUIRED_MODULES = (
     os.path.join("tnc_tpu", "obs", "calibrate.py"),
     os.path.join("tnc_tpu", "utils", "digest.py"),
+    os.path.join("tnc_tpu", "ops", "strassen.py"),
+    os.path.join("tnc_tpu", "ops", "pallas_complex.py"),
 )
 
 executed: set[tuple[str, int]] = set()

@@ -16,7 +16,12 @@ failures, unless ``--strict``):
   regression to planning / probe / oracle before anyone opens a trace;
 - the calibrated device model (``calibration.flops_per_s``) — a drop in
   achieved throughput with unchanged wall-clock means the run did less
-  work, not that the hardware got slower.
+  work, not that the hardware got slower;
+- per-shape-bucket throughput under the kernel promotion ladder
+  (``kernel_buckets.buckets.<small|medium|stem>``) — effective-flop-
+  credited MFU (or achieved FLOP/s) per bucket, so a regression in ONE
+  kernel rung (a chain that stopped fusing, a Strassen step that fell
+  back) is localized even when the headline wall-clock hides it.
 
 Exit codes: 0 pass, 1 regression, 2 unusable input (missing files,
 error records, mismatched metrics).
@@ -147,6 +152,24 @@ def compare(
             f"warning: calibrated throughput dropped "
             f"{bf / cf:.2f}x ({bf:.3g} -> {cf:.3g} FLOP/s)"
         )
+
+    # kernel-ladder per-bucket cross-check: effective-flop-credited MFU
+    # when both records carry it, achieved FLOP/s otherwise — a bucket
+    # whose kernel rung regressed (chain unfused, strassen fallen back)
+    # shows up here even when the headline wall-clock absorbs it
+    bkb = (base.get("kernel_buckets") or {}).get("buckets") or {}
+    ckb = (cand.get("kernel_buckets") or {}).get("buckets") or {}
+    for bucket in sorted(set(bkb) & set(ckb)):
+        for metric_key in ("mfu", "achieved_flops_per_s"):
+            bv = (bkb[bucket] or {}).get(metric_key)
+            cv = (ckb[bucket] or {}).get(metric_key)
+            if bv and cv:
+                if cv < bv / 1.5:
+                    msgs.append(
+                        f"warning: kernel bucket '{bucket}' {metric_key} "
+                        f"dropped {bv / cv:.2f}x ({bv:.3g} -> {cv:.3g})"
+                    )
+                break  # one metric per bucket: mfu preferred
     return verdict, msgs
 
 

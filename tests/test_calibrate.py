@@ -460,6 +460,52 @@ def test_perf_gate_warns_on_phase_regression():
     assert any("phase bench.warmup" in m for m in msgs)
 
 
+def test_perf_gate_warns_on_kernel_bucket_mfu_drop():
+    """The kernel-ladder cross-check: a bucket whose effective-flop-
+    credited MFU drops >1.5x warns (and fails under --strict), even
+    when the headline wall-clock is unchanged."""
+    gate = _perf_gate()
+    base = _record(0.01)
+    base["kernel_buckets"] = {
+        "source": "jax",
+        "buckets": {
+            "small": {"mfu": 0.05, "achieved_flops_per_s": 1e10},
+            "stem": {"mfu": 0.40, "achieved_flops_per_s": 1e14},
+        },
+    }
+    cand = _record(0.0101)
+    cand["kernel_buckets"] = {
+        "source": "jax",
+        "buckets": {
+            "small": {"mfu": 0.05, "achieved_flops_per_s": 1e10},
+            "stem": {"mfu": 0.20, "achieved_flops_per_s": 5e13},
+        },
+    }
+    code, msgs = gate.compare(base, cand)
+    assert code == 0
+    assert any("kernel bucket 'stem' mfu" in m for m in msgs)
+    assert not any("bucket 'small'" in m for m in msgs)
+
+
+def test_perf_gate_kernel_bucket_falls_back_to_flops():
+    """Records without MFU (no known device peak) gate on the bucket's
+    achieved FLOP/s instead."""
+    gate = _perf_gate()
+    base = _record(0.01)
+    base["kernel_buckets"] = {
+        "buckets": {"medium": {"achieved_flops_per_s": 1e12}}
+    }
+    cand = _record(0.0101)
+    cand["kernel_buckets"] = {
+        "buckets": {"medium": {"achieved_flops_per_s": 1e11}}
+    }
+    code, msgs = gate.compare(base, cand)
+    assert code == 0
+    assert any(
+        "kernel bucket 'medium' achieved_flops_per_s" in m for m in msgs
+    )
+
+
 # -- roofline + export satellites ---------------------------------------
 
 

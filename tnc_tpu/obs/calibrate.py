@@ -454,6 +454,19 @@ class CalibratedCostModel:
         )
         return cls.from_device_model(model) if model is not None else None
 
+    def dispatch_equivalent_flops(self) -> float:
+        """Flops whose predicted compute time equals ONE dispatch
+        overhead — the scale below which a step is dispatch-dominated.
+        The kernel promotion ladder's chain rung
+        (:func:`tnc_tpu.ops.split_complex.plan_kernels`) fuses runs of
+        such steps into one dispatch; a step several times this size
+        gains nothing from fusion.
+
+        >>> CalibratedCostModel(1e12, dispatch_s=2e-5).dispatch_equivalent_flops()
+        20000000.0
+        """
+        return self.dispatch_s * self.flops_per_s
+
     def op_seconds(
         self, flops: float, nbytes: float = 0.0, dispatches: float = 1.0
     ) -> float:

@@ -175,6 +175,7 @@ def run_steps_timed(
     split_complex: bool = False,
     precision: str | None = None,
     sync=None,
+    policy=None,
 ) -> Any:
     """Step-timed variant of :func:`_run_steps`: one obs span per
     :class:`~tnc_tpu.ops.program.PairStep`, named ``step[i] MxK·KxN``
@@ -191,24 +192,87 @@ def run_steps_timed(
 
     Each span is tagged ``executor="numpy"|"jax"`` so the calibration
     fit never blends host- and device-measured samples of the same step
-    into one "device" model.
+    into one "device" model, plus the step's shape ``bucket``
+    (small/medium/stem), kernel ``mode``, and mode-credited
+    ``flops_effective`` — the per-bucket MFU inputs.
+
+    ``policy`` (a :class:`tnc_tpu.ops.split_complex.KernelPolicy`,
+    split mode only): steps promote per the kernel ladder, and a fused
+    chain emits ONE ``step[s..e]`` span carrying the whole run's
+    summed predicted cost — the span count IS the dispatch count, so
+    chain fusion is directly visible as fewer step spans.
     """
     from tnc_tpu.ops.program import step_elems, step_flops, step_label
+    from tnc_tpu.ops.split_complex import (
+        effective_step_flops,
+        resolved_step_mode,
+        step_bucket,
+    )
 
     executor = "numpy" if xp is np else "jax"
+    if not split_complex:
+        policy = None
 
     if split_complex:
         from tnc_tpu.ops.split_complex import apply_step_split
 
-        def kernel(a, b, st):
-            return apply_step_split(xp, a, b, st, precision)
+        def kernel(a, b, st, mode=None):
+            return apply_step_split(xp, a, b, st, precision, mode=mode)
 
     else:
 
-        def kernel(a, b, st):
+        def kernel(a, b, st, mode=None):
             return apply_step(xp, a, b, st)
 
-    for i, step in enumerate(program.steps):
+    steps = program.steps
+    chain_end = {s: e for s, e in policy.chains} if policy is not None else {}
+    i = 0
+    while i < len(steps):
+        end = chain_end.get(i)
+        if end is not None:
+            from tnc_tpu.ops.split_complex import run_chain_split
+
+            group = steps[i:end]
+            # HBM traffic of ONE fused dispatch: the head's two
+            # operands plus each link's non-carried operand in, the
+            # final result out — carried intermediates live in VMEM
+            # and never touch HBM, so summing per-step elems would
+            # overstate the chain's bytes and bias the calibration fit
+            import math as _math
+
+            elems_in = float(
+                _math.prod(group[0].a_view) + _math.prod(group[0].b_view)
+            )
+            run_slot = group[0].lhs
+            for st in group[1:]:
+                view = st.b_view if st.lhs == run_slot else st.a_view
+                elems_in += float(_math.prod(view))
+                run_slot = st.lhs
+            with obs.span(
+                f"step[{i}..{end - 1}] chain x{len(group)}",
+                executor=executor,
+                flops=sum(step_flops(st) for st in group),
+                bytes_in=elems_in * dtype_bytes,
+                bytes_out=step_elems(group[-1])[1] * dtype_bytes,
+                bucket="small",
+                mode="chain",
+                flops_effective=sum(step_flops(st) for st in group),
+                steps=len(group),
+            ):
+                out = run_chain_split(xp, group, buffers, precision)
+                if sync is not None:
+                    sync(out)
+            i = end
+            continue
+        step = steps[i]
+        mode = policy.modes[i] if policy is not None else None
+        # tag + credit the arithmetic that actually runs: without a
+        # policy the split path executes the env default (gauss, 0.75x
+        # credit), never 'naive'; the complex (non-split) path is the
+        # naive lowering
+        resolved = (
+            resolved_step_mode(step, mode) if split_complex else "naive"
+        )
         elems_in, elems_out = step_elems(step)
         with obs.span(
             step_label(i, step),
@@ -216,12 +280,16 @@ def run_steps_timed(
             flops=step_flops(step),
             bytes_in=elems_in * dtype_bytes,
             bytes_out=elems_out * dtype_bytes,
+            bucket=step_bucket(step),
+            mode=resolved,
+            flops_effective=effective_step_flops(step, resolved),
         ):
-            out = kernel(buffers[step.lhs], buffers[step.rhs], step)
+            out = kernel(buffers[step.lhs], buffers[step.rhs], step, mode)
             if sync is not None:
                 sync(out)
         buffers[step.lhs] = out
         buffers[step.rhs] = None  # free eagerly
+        i += 1
     return buffers[program.result_slot]
 
 
@@ -248,6 +316,7 @@ def jit_program(
     precision: str | None = None,
     donate: bool = True,
     batched: frozenset[int] | None = None,
+    policy=None,
 ):
     """Program → jitted ``fn(buffers)`` with donated inputs; one traced
     function per (program, mode), one XLA executable per input placement.
@@ -257,21 +326,28 @@ def jit_program(
 
     ``batched``: slots whose buffers carry a leading batch axis — the
     whole path is ``jax.vmap``-ed over them (amplitude sweeps,
-    :meth:`JaxBackend.execute_batched`)."""
+    :meth:`JaxBackend.execute_batched`).
+
+    ``policy``: a :class:`tnc_tpu.ops.split_complex.KernelPolicy` —
+    the per-step kernel promotion ladder the trace bakes in (split
+    mode only). Part of the cache key: two policies over the same
+    program are different executables."""
     import jax
 
-    from tnc_tpu.ops.split_complex import complex_mult_env
+    from tnc_tpu.ops.split_complex import complex_mult_key
 
     if not split_complex:
         precision = None  # only the split path consumes it: one cache key
+        policy = None
     key = (
         program.signature(),
         split_complex,
         precision,
         donate,
         lanemix_env(),
-        complex_mult_env() if split_complex else None,
+        complex_mult_key() if split_complex else None,
         batched,
+        policy.signature() if policy is not None else None,
     )
     with _PROGRAM_JIT_CACHE_LOCK:
         fn = _PROGRAM_JIT_CACHE.get(key)
@@ -290,7 +366,9 @@ def jit_program(
             from tnc_tpu.ops.split_complex import run_steps_split
 
             def run(buffers):
-                return run_steps_split(jnp, program, list(buffers), precision)
+                return run_steps_split(
+                    jnp, program, list(buffers), precision, policy=policy
+                )
 
         else:
 
@@ -554,10 +632,41 @@ class JaxBackend(Backend):
         self.loop_unroll = loop_unroll
         self.hoist = hoist
         self._cache: dict[tuple, Any] = {}
+        self._policy_cache: dict[tuple, Any] = {}
+
+    def kernel_policy(self, program: ContractionProgram):
+        """The kernel promotion ladder for ``program`` (split mode
+        only; ``None`` otherwise): per-step naive/gauss/strassen modes
+        plus fused multi-step chains, planned once per (program, env
+        override) from the live calibrated cost model when one can be
+        fitted (:meth:`tnc_tpu.obs.calibrate.CalibratedCostModel.
+        from_registry`) and cached — the policy is part of the jit
+        key, so it must not flap between calls as new step samples
+        arrive."""
+        if not self.split_complex:
+            return None
+        from tnc_tpu.ops.split_complex import complex_mult_key, plan_kernels
+
+        key = (program.signature(), complex_mult_key())
+        policy = self._policy_cache.get(key)
+        if policy is None:
+            cost_model = None
+            try:
+                from tnc_tpu.obs.calibrate import CalibratedCostModel
+
+                cost_model = CalibratedCostModel.from_registry()
+            except Exception:  # noqa: BLE001 — planning must not fail
+                cost_model = None
+            policy = plan_kernels(program, cost_model=cost_model)
+            self._policy_cache[key] = policy
+        return policy
 
     def _compiled(self, program: ContractionProgram):
         precision = self.precision if self.split_complex else None
-        return jit_program(program, self.split_complex, precision, self.donate)
+        return jit_program(
+            program, self.split_complex, precision, self.donate,
+            policy=self.kernel_policy(program),
+        )
 
     def _device_buffers(self, arrays: Sequence[Any]) -> list[Any]:
         return place_buffers(arrays, self.dtype, self.split_complex, self.device)
@@ -589,6 +698,7 @@ class JaxBackend(Backend):
                 split_complex=self.split_complex,
                 precision=self.precision,
                 sync=jax.block_until_ready,
+                policy=self.kernel_policy(program),
             )
         return self._compiled(program)(buffers)
 
@@ -638,7 +748,7 @@ class JaxBackend(Backend):
                 hoist=hoist,
             )
 
-        from tnc_tpu.ops.split_complex import complex_mult_env
+        from tnc_tpu.ops.split_complex import complex_mult_key
 
         key = (
             "sliced",
@@ -649,7 +759,7 @@ class JaxBackend(Backend):
             self.loop_unroll,
             hoist,
             lanemix_env(),
-            complex_mult_env() if self.split_complex else None,
+            complex_mult_key() if self.split_complex else None,
         )
         fn = self._cache.get(key)
         if fn is None:
@@ -692,6 +802,7 @@ class JaxBackend(Backend):
             precision,
             self.donate,
             batched=frozenset(batched),
+            policy=self.kernel_policy(program),
         )
         buffers = self._device_buffers(arrays)
         result = fn(buffers)
@@ -727,7 +838,10 @@ class JaxBackend(Backend):
         (``benchmark/src/main.rs:355-405``).
         """
         precision = self.precision if self.split_complex else None
-        fn = jit_program(program, self.split_complex, precision, donate=False)
+        fn = jit_program(
+            program, self.split_complex, precision, donate=False,
+            policy=self.kernel_policy(program),
+        )
         buffers = self._device_buffers(arrays)
         return lambda: fn(buffers)
 

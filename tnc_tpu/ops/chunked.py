@@ -120,15 +120,35 @@ def _run_chunk(xp, chunk: ProgramChunk, state: dict[int, Any]) -> None:
 
 
 def _run_chunk_split(
-    xp, chunk: ProgramChunk, state: dict[int, Any], precision
+    xp, chunk: ProgramChunk, state: dict[int, Any], precision, policy=None
 ) -> None:
-    from tnc_tpu.ops.split_complex import apply_step_split
+    """``policy``: a per-chunk :class:`~tnc_tpu.ops.split_complex.
+    KernelPolicy` (spans indexed relative to the chunk) — small
+    consecutive residual steps fuse into single Pallas chain dispatches
+    and eligible steps promote; ``None`` runs every step under the env
+    mode."""
+    from tnc_tpu.ops.split_complex import apply_step_split, run_chain_split
 
-    for step in chunk.steps:
+    steps = chunk.steps
+    chain_end = {s: e for s, e in policy.chains} if policy is not None else {}
+    i = 0
+    while i < len(steps):
+        end = chain_end.get(i)
+        if end is not None:
+            group = steps[i:end]
+            run_chain_split(xp, group, state, precision)
+            for st in group:
+                if state.get(st.rhs) is None:  # consumed by the chain
+                    state.pop(st.rhs, None)
+            i = end
+            continue
+        step = steps[i]
         state[step.lhs] = apply_step_split(
-            xp, state[step.lhs], state[step.rhs], step, precision
+            xp, state[step.lhs], state[step.rhs], step, precision,
+            mode=policy.modes[i] if policy is not None else None,
         )
         del state[step.rhs]
+        i += 1
 
 
 # compiled plan cache: key -> (chunks, chunk_fns).
@@ -152,14 +172,14 @@ def _prelude_fn(hp, split_complex: bool, precision):
     import jax.numpy as jnp
 
     from tnc_tpu.ops.backends import lanemix_env
-    from tnc_tpu.ops.split_complex import complex_mult_env
+    from tnc_tpu.ops.split_complex import complex_mult_key
 
     key = (
         hp.signature(),
         split_complex,
         precision,
         lanemix_env(),
-        complex_mult_env() if split_complex else None,
+        complex_mult_key() if split_complex else None,
     )
     with _PLAN_CACHE_LOCK:
         fn = _PRELUDE_CACHE.get(key)
@@ -206,7 +226,7 @@ def _compiled_plan(
     import jax.numpy as jnp
 
     from tnc_tpu.ops.backends import lanemix_env
-    from tnc_tpu.ops.split_complex import complex_mult_env
+    from tnc_tpu.ops.split_complex import complex_mult_key
 
     key = (
         sp.signature(),
@@ -215,7 +235,7 @@ def _compiled_plan(
         split_complex,
         precision,
         lanemix_env(),
-        complex_mult_env() if split_complex else None,
+        complex_mult_key() if split_complex else None,
     )
     with _PLAN_CACHE_LOCK:
         hit = _PLAN_CACHE.get(key)
@@ -228,6 +248,18 @@ def _compiled_plan(
     _faults.fault_point("chunked.plan")
     chunks = split_program(sp.program, chunk_steps)
     num_inputs = sp.program.num_inputs
+
+    # kernel promotion ladder per chunk (split mode): chain spans and
+    # per-step modes planned over each chunk's step subsequence — a
+    # chain cannot cross a chunk boundary (the boundary is a dispatch
+    # anyway). Cached with the plan; the cache key carries
+    # complex_mult_key so forced/auto plans never collide.
+    if split_complex:
+        from tnc_tpu.ops.split_complex import plan_kernel_steps
+
+        chunk_policies = [plan_kernel_steps(c.steps) for c in chunks]
+    else:
+        chunk_policies = [None] * len(chunks)
 
     # which slots carry a batch axis (sliced leaves + anything computed
     # from a batched slot)
@@ -279,7 +311,10 @@ def _compiled_plan(
             ax = 0 if slot in post_batched else None
             out_axes_spec.append((ax, ax) if split_complex else ax)
 
-        def single(ins, idx1, _chunk=chunk, _leaf_in=leaf_in):
+        def single(
+            ins, idx1, _chunk=chunk, _leaf_in=leaf_in,
+            _policy=chunk_policies[ci],
+        ):
             state = {}
             for slot, val in zip(_chunk.in_slots, ins):
                 if slot in _leaf_in:
@@ -294,7 +329,7 @@ def _compiled_plan(
                 else:
                     state[slot] = val
             if split_complex:
-                _run_chunk_split(jnp, _chunk, state, precision)
+                _run_chunk_split(jnp, _chunk, state, precision, _policy)
             else:
                 _run_chunk(jnp, _chunk, state)
             return tuple(state[s] for s in _chunk.out_slots)

@@ -247,12 +247,24 @@ def run_prelude_steps(
     in split mode) and return the cached intermediates in the order the
     ``("cached", …)`` entries appear in ``hp.residual_sources``. Works
     under tracing (``xp = jnp`` inside a jit) and on the host oracle
-    (``xp = np``) alike."""
+    (``xp = np``) alike.
+
+    Split-mode prelude steps ride the kernel promotion ladder: the
+    slice-invariant stem GEMMs this pass isolates are exactly the big,
+    square-ish shapes one Strassen level pays off on, so each step over
+    the crossover runs gauss+strassen
+    (:func:`tnc_tpu.ops.split_complex.auto_step_mode`) unless a
+    ``TNC_TPU_COMPLEX_MULT`` forcing override pins the mode — which is
+    why the executors key their compiled-fn caches on
+    :func:`tnc_tpu.ops.split_complex.complex_mult_key`, not the env
+    default."""
     if split_complex:
-        from tnc_tpu.ops.split_complex import apply_step_split
+        from tnc_tpu.ops.split_complex import apply_step_split, auto_step_mode
 
         def kernel(a, b, step):
-            return apply_step_split(xp, a, b, step, precision)
+            return apply_step_split(
+                xp, a, b, step, precision, mode=auto_step_mode(step)
+            )
 
     else:
         from tnc_tpu.ops.backends import apply_step

@@ -1,4 +1,4 @@
-"""Fused split-complex matmul as a Pallas TPU kernel.
+"""Fused split-complex matmuls as Pallas TPU kernels.
 
 The split-complex step kernel lowers a complex contraction to 4 real
 dots (naive) or 3 dots + 5 elementwise passes (Gauss) — either way XLA
@@ -27,11 +27,26 @@ Selected with ``TNC_TPU_COMPLEX_MULT=fused``; correctness is pinned in
 interpret mode on CPU (tests/test_pallas_complex.py) and the hardware
 A/B runs in ``scripts/hw_campaign.sh``. Meant to be called inside an
 outer ``jax.jit`` (every executor's step kernel already is).
+
+This module also carries the **fused multi-step chain kernel**
+(:func:`fused_chain_kl`): a run of consecutive small residual PairSteps
+— grouped by :func:`tnc_tpu.ops.program.chain_groups` because each
+step's output feeds the next and everything fits VMEM — executes as ONE
+``pallas_call``, every intermediate living in VMEM values, so the chain
+pays the per-dispatch overhead (the calibrated ``dispatch_overhead_s``
+that dominates small networks) once instead of per step.
 """
 
 from __future__ import annotations
 
 MIN_FLOPS = 1 << 22  # below this the dispatch/grid overhead dominates
+
+#: VMEM budget for a fused chain, in float32 elements summed over every
+#: operand and intermediate the chain touches ((real, imag) pairs count
+#: double, so this bounds the real VMEM bytes at 4·CHAIN_MAX_ELEMS =
+#: 4 MiB of the ~16 MiB/core — generous headroom for the compiler's
+#: own staging).
+CHAIN_MAX_ELEMS = 1 << 20
 
 
 def _tile(dim: int, cap: int, floor: int) -> int | None:
@@ -44,6 +59,30 @@ def _tile(dim: int, cap: int, floor: int) -> int | None:
     return None
 
 
+def ineligible_reason(k: int, m: int, n: int) -> str | None:
+    """Why the single-step fused kernel cannot run a (K,M)x(K,N)
+    problem profitably — ``None`` when it can. The reason string is the
+    label the ``ops.fused_fallback`` counter and the fallback warning
+    carry, so bench records say *why* fused didn't fire.
+
+    >>> ineligible_reason(512, 1024, 1024) is None
+    True
+    >>> ineligible_reason(4, 4, 4)
+    'flop_floor'
+    >>> ineligible_reason(1024, 4, 1024)   # M below the f32 sublane tile
+    'tile_floor'
+    """
+    if 2 * k * m * n < MIN_FLOPS:
+        return "flop_floor"
+    if (
+        _tile(m, 128, 8) is None
+        or _tile(n, 128, 128) is None
+        or _tile(k, 512, 8) is None
+    ):
+        return "tile_floor"
+    return None
+
+
 def eligible(k: int, m: int, n: int) -> bool:
     """Can the fused kernel run this (K,M)x(K,N) problem profitably?
 
@@ -52,13 +91,7 @@ def eligible(k: int, m: int, n: int) -> bool:
     >>> eligible(4, 4, 4)           # under MIN_FLOPS and tile floors
     False
     """
-    if 2 * k * m * n < MIN_FLOPS:
-        return False
-    return (
-        _tile(m, 128, 8) is not None
-        and _tile(n, 128, 128) is not None
-        and _tile(k, 512, 8) is not None
-    )
+    return ineligible_reason(k, m, n) is None
 
 
 def fused_complex_dot_kl(ar, ai, br, bi, interpret: bool = False,
@@ -132,3 +165,166 @@ def _scratch(shape, dtype):
     from jax.experimental.pallas import tpu as pltpu
 
     return [pltpu.VMEM(shape, dtype), pltpu.VMEM(shape, dtype)]
+
+
+# -- fused multi-step residual chains -----------------------------------
+
+
+class ChainLink:
+    """Static metadata for one follow-on step of a fused chain: how the
+    carried value (the previous step's output, a 2-D VMEM array) enters
+    this step's dot against its pre-prepped ``(K, X)`` operand.
+
+    ``carried_shape``: the 2-D matrix the flat carried value regroups
+    to (a pure row-major reshape — :func:`tnc_tpu.ops.program.
+    chain_groups` only admits steps whose carried operand needs no
+    transpose). ``k_axis``: which axis of that matrix is the contract
+    dim (0 = contract-first, 1 = contract-last). ``carried_first``:
+    whether the carried value is the dot's first operand (its free axis
+    supplies the output rows) — the PairStep ``swap`` folded out.
+    """
+
+    __slots__ = ("carried_first", "carried_shape", "k_axis")
+
+    def __init__(
+        self,
+        carried_first: bool,
+        carried_shape: tuple[int, int],
+        k_axis: int,
+    ):
+        self.carried_first = bool(carried_first)
+        self.carried_shape = (int(carried_shape[0]), int(carried_shape[1]))
+        self.k_axis = int(k_axis)
+
+    def out_shape(self, link_free: int) -> tuple[int, int]:
+        free = self.carried_shape[1 - self.k_axis]
+        if self.carried_first:
+            return (free, link_free)
+        return (link_free, free)
+
+
+def chain_out_shape(
+    m0: int, n0: int, links, link_frees
+) -> tuple[int, int]:
+    """Final 2-D output shape of a chain starting at ``(m0, n0)``."""
+    shape = (m0, n0)
+    for link, free in zip(links, link_frees):
+        shape = link.out_shape(free)
+    return shape
+
+
+def _chain_compute(vals, links, precision):
+    """The chain's arithmetic on plain arrays — shared verbatim by the
+    Pallas kernel body (on VMEM-loaded values) and the bit-parity
+    reference (:func:`fused_chain_reference`), so the only thing the
+    kernel can add is dispatch fusion, never a numerical deviation."""
+    import jax
+
+    def cdot(xr, xi, yr, yi, xk, yk):
+        dims = (((xk,), (yk,)), ((), ()))
+
+        def dot(x, y):
+            # accumulate in the operand dtype (f32 on the MXU path;
+            # float64 split pairs — the complex128 CPU oracle — must
+            # NOT downcast through the chain)
+            return jax.lax.dot_general(
+                x, y, dims,
+                precision=precision,
+                preferred_element_type=x.dtype,
+            )
+
+        return (
+            dot(xr, yr) - dot(xi, yi),
+            dot(xr, yi) + dot(xi, yr),
+        )
+
+    zr, zi = cdot(vals[0], vals[1], vals[2], vals[3], 0, 0)
+    for i, link in enumerate(links):
+        cr = vals[4 + 2 * i]
+        ci = vals[5 + 2 * i]
+        zr = zr.reshape(link.carried_shape)
+        zi = zi.reshape(link.carried_shape)
+        if link.carried_first:
+            zr, zi = cdot(zr, zi, cr, ci, link.k_axis, 0)
+        else:
+            zr, zi = cdot(cr, ci, zr, zi, 0, link.k_axis)
+    return zr, zi
+
+
+def fused_chain_reference(first_ops, link_ops, links, precision=None):
+    """The chain computation as plain jax ops — no ``pallas_call``.
+    The bit-parity oracle for the interpret-mode tests: the kernel
+    must produce the identical bits, proving fusion changed dispatch
+    structure only."""
+    vals = list(first_ops)
+    for cr, ci in link_ops:
+        vals.extend((cr, ci))
+    return _chain_compute(vals, links, precision)
+
+
+def fused_chain_kl(
+    first_ops,
+    link_ops,
+    links,
+    interpret: bool = False,
+    precision=None,
+):
+    """Execute a whole residual chain as ONE Pallas dispatch.
+
+    ``first_ops = (fr, fi, sr, si)``: the head step's two operands,
+    pre-prepped to contract-dim-leading 2-D ``(K0, M0)`` / ``(K0, N0)``
+    float32 arrays, already in dot order (``swap`` folded out by the
+    caller). ``link_ops = [(cr, ci), ...]``: each follow-on step's
+    non-carried operand, pre-prepped to ``(K_i, X_i)``. ``links``: one
+    :class:`ChainLink` per follow-on step.
+
+    Every array is a full-array VMEM block (no grid): the chain-grouping
+    pass only admits runs whose combined operands and intermediates fit
+    :data:`CHAIN_MAX_ELEMS`, so small residual steps stream through VMEM
+    values with a single HBM round-trip at the chain boundary — the
+    chain pays one dispatch overhead instead of ``len(links) + 1``.
+    Arithmetic is the naive 4-real-dot complex lowering (same error
+    contract as the single-step fused kernel).
+
+    Returns the chain's final ``(re, im)`` 2-D float32 pair.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    fr, fi, sr, si = first_ops
+    n_links = len(links)
+    if n_links != len(link_ops):
+        raise ValueError("links and link_ops must pair up")
+
+    def kernel(*refs):
+        ins, outs = refs[: 4 + 2 * n_links], refs[4 + 2 * n_links:]
+        zr, zi = _chain_compute(
+            [r[:] for r in ins], links, precision
+        )
+        outs[0][:] = zr
+        outs[1][:] = zi
+
+    out_shape = chain_out_shape(
+        fr.shape[1], sr.shape[1], links, [c[0].shape[1] for c in link_ops]
+    )
+    flat_ins = [fr, fi, sr, si]
+    for cr, ci in link_ops:
+        flat_ins.extend((cr, ci))
+    out_dtype = jnp.asarray(fr).dtype  # f32 device path; f64 oracle
+    return pl.pallas_call(
+        kernel,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM) for _ in flat_ins
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(out_shape, out_dtype),
+            jax.ShapeDtypeStruct(out_shape, out_dtype),
+        ],
+        interpret=interpret,
+    )(*flat_ins)

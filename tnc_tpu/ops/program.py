@@ -135,6 +135,99 @@ def steps_bytes(steps, dtype_bytes: float = 16.0) -> float:
     return total
 
 
+def chain_groups(
+    steps,
+    max_flops: float | None = None,
+    max_elems: float | None = None,
+) -> tuple[tuple[int, int], ...]:
+    """Runs of consecutive steps executable as ONE fused Pallas chain
+    dispatch (:func:`tnc_tpu.ops.pallas_complex.fused_chain_kl`).
+
+    A step extends the running chain when it consumes the chain's
+    current value (its ``lhs`` or ``rhs`` is the chain's result slot —
+    replace-left semantics guarantee that slot still holds the chained
+    value), the carried operand's prep is a pure row-major regroup
+    (no macro transpose, no staged ops — the value must flow through
+    VMEM as a reshape), and the whole run stays small: every step
+    strictly under the ``max_flops`` floor in the fused kernel's
+    ``2*k*m*n`` units (default ``MIN_FLOPS`` — exactly the
+    dispatch-dominated steps the single-step kernel rejects AND the
+    ``small`` shape bucket of :func:`tnc_tpu.ops.split_complex.
+    step_bucket`, so every chained step provably reports in that
+    bucket) with all operands + intermediates summing under
+    ``max_elems`` float32 elements ((real, imag) pairs count double).
+
+    Returns ``(start, end)`` index spans, each covering ≥ 2 steps;
+    steps outside every span dispatch individually.
+
+    >>> from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+    >>> from tnc_tpu.contractionpath.contraction_path import ContractionPath
+    >>> tn = CompositeTensor([LeafTensor.from_const([0, 1], 4),
+    ...                       LeafTensor.from_const([1, 2], 4),
+    ...                       LeafTensor.from_const([2, 3], 4)])
+    >>> program = build_program(tn, ContractionPath.simple([(0, 1), (0, 2)]))
+    >>> chain_groups(program.steps)
+    ((0, 2),)
+    """
+    if max_flops is None:
+        from tnc_tpu.ops.pallas_complex import MIN_FLOPS
+
+        max_flops = float(MIN_FLOPS)
+    if max_elems is None:
+        from tnc_tpu.ops.pallas_complex import CHAIN_MAX_ELEMS
+
+        max_elems = float(CHAIN_MAX_ELEMS)
+
+    def step_cost_elems(st) -> float:
+        elems_in, elems_out = step_elems(st)
+        return 2.0 * (elems_in + elems_out)  # (real, imag) pairs
+
+    def small(st) -> bool:
+        # same 2*k*m*n units and strict bound as pallas eligibility
+        # and step_bucket's "small" — the three must agree
+        return 2.0 * step_flops(st) < max_flops
+
+    groups: list[tuple[int, int]] = []
+    start: int | None = None
+    run_slot = -1
+    run_elems = 0.0
+
+    def close(end: int) -> None:
+        nonlocal start
+        if start is not None and end - start >= 2:
+            groups.append((start, end))
+        start = None
+
+    for i, st in enumerate(steps):
+        cost = step_cost_elems(st)
+        if start is not None:
+            carried_a = st.lhs == run_slot
+            carried_b = st.rhs == run_slot
+            trivial = (
+                (st.a_perm is None and st.a_ops is None)
+                if carried_a
+                else (st.b_perm is None and st.b_ops is None)
+            )
+            if (
+                (carried_a or carried_b)
+                and trivial
+                and small(st)
+                and run_elems + cost <= max_elems
+            ):
+                run_slot = st.lhs
+                run_elems += cost
+                continue
+            close(i)
+        if small(st) and cost <= max_elems:
+            start = i
+            run_slot = st.lhs
+            run_elems = cost
+        else:
+            start = None
+    close(len(steps))
+    return tuple(groups)
+
+
 def _padded_elems(shape) -> float:
     """Tile-padded element count; single source of truth in
     :func:`tnc_tpu.ops.budget.padded_elems` (minor dim pads to 128; XLA

@@ -426,7 +426,13 @@ def make_jax_sliced_fn(
         return idx
 
     if split_complex:
-        from tnc_tpu.ops.split_complex import run_steps_split
+        from tnc_tpu.ops.split_complex import plan_kernels, run_steps_split
+
+        # the kernel promotion ladder over the per-slice loop body:
+        # residual chains fuse into single Pallas dispatches, eligible
+        # steps promote (the compiled-fn caches key on complex_mult_key,
+        # so forced/auto traces never collide)
+        loop_policy = plan_kernels(loop_sp.program)
 
         def one_slice(loop_buffers, s):
             indices = decompose(s)
@@ -437,7 +443,9 @@ def make_jax_sliced_fn(
                 )
                 for (re, im), info in zip(loop_buffers, loop_sp.slot_slices)
             ]
-            return run_steps_split(jnp, loop_sp.program, buffers, precision)
+            return run_steps_split(
+                jnp, loop_sp.program, buffers, precision, policy=loop_policy
+            )
 
         def add(acc, contrib):
             (sr, cr), (si, ci) = acc

@@ -19,17 +19,46 @@ happens at the host→device boundary.
 
 from __future__ import annotations
 
+import logging
+import math
 import os
+from dataclasses import dataclass
 from typing import Any
 
 import numpy as np
 
 from tnc_tpu.ops.program import ContractionProgram
 
+logger = logging.getLogger(__name__)
+
+#: kernel modes a single step can execute under. ``chain`` is not a
+#: per-step mode — chained steps run ``naive`` arithmetic inside one
+#: fused multi-step dispatch (see :class:`KernelPolicy`).
+KERNEL_MODES = ("naive", "gauss", "fused", "strassen", "chain", "auto")
+
+#: real-multiply credit of each kernel mode relative to the naive
+#: 4-dot complex lowering (the unit every flop count in the stack
+#: uses): Gauss runs 3 of the 4 dots, one Strassen level on top of
+#: Gauss runs 21 half-size sub-GEMMs against naive's 32 half-units.
+#: ``bench.py`` multiplies by these so per-bucket MFU stays comparable
+#: across kernel modes (effective-flop crediting).
+EFFECTIVE_FLOP_FACTOR = {
+    "naive": 1.0,
+    "fused": 1.0,  # naive arithmetic, fewer HBM passes
+    "gauss": 0.75,
+    "strassen": 21.0 / 32.0,  # gauss × one Strassen level
+}
+
 
 def complex_mult_env() -> str:
-    """Complex-multiply lowering, read at *trace* time (so compiled
-    executables must be keyed by it, like ``backends.lanemix_env``):
+    """The per-step complex-multiply base mode, read at *trace* time
+    (so compiled executables must be keyed by it, like
+    ``backends.lanemix_env``). **``gauss`` is the single tuned
+    default** — everywhere: here, in ``bench.py``'s seeding, and as
+    the :class:`KernelPolicy` base mode (the parity ladder pins it).
+    Setting ``TNC_TPU_COMPLEX_MULT`` is a *forcing override* for A/B
+    runs — it pins every step to one mode and disables the per-step
+    promotion ladder (see :func:`plan_kernels`):
 
     - ``gauss`` (default): 3 real dots via the Gauss/Karatsuba identity —
       25% fewer MXU flops, but the pre-dot operand sums (ar+ai, bi-br,
@@ -37,16 +66,80 @@ def complex_mult_env() -> str:
       rounding error is relative to the *larger* mixed intermediate
       (the classic Karatsuba instability).
     - ``naive``: 4 real dots (rr-ii, ri+ir) — each dot's error is
-      relative to its own product magnitude; measured the difference is
-      the missing half-digit to the 1e-5 parity target at f32
-      (VERDICT r3 #2).
+      relative to its own product magnitude (the half-digit-tighter
+      rung of the parity ladder, VERDICT r3 #2).
     - ``fused``: one Pallas kernel computing both outputs with each
       operand tile loaded once (:mod:`tnc_tpu.ops.pallas_complex`);
       naive-mode arithmetic, ~half the operand HBM traffic. Steps the
       kernel cannot take (non-cfirst orientation, ragged/small shapes)
       fall back to ``naive`` per step.
+    - ``strassen``: one Strassen recursion level composed with the
+      Gauss identity — 21 half-size real sub-GEMMs vs naive's 32
+      half-units (:mod:`tnc_tpu.ops.strassen`) — on steps whose
+      matricized shape clears the crossover; others run ``gauss``.
+    - ``chain``: consecutive small steps grouped by
+      :func:`tnc_tpu.ops.program.chain_groups` execute as ONE fused
+      multi-step Pallas dispatch (naive arithmetic); ungrouped steps
+      run ``gauss``.
+    - ``auto``: the explicit spelling of the unforced default — the
+      cost-model-driven promotion ladder.
     """
     return os.environ.get("TNC_TPU_COMPLEX_MULT", "gauss")
+
+
+def complex_mult_forced() -> str | None:
+    """The forcing override, or ``None`` when the env knob is unset
+    (the promotion ladder decides per step). ``auto`` explicitly
+    requests the ladder, so it is NOT a forced mode."""
+    mode = os.environ.get("TNC_TPU_COMPLEX_MULT")
+    if mode is None or mode == "auto":
+        return None
+    return mode
+
+
+def complex_mult_key() -> str:
+    """Trace-time *cache-key* form of the env knob: the forced mode, or
+    ``auto`` when unset. Distinct from :func:`complex_mult_env` because
+    an unset env lets the promotion ladder promote steps (prelude stem
+    GEMMs → strassen), so it must NOT share compiled executables with
+    an explicitly forced ``gauss``."""
+    return os.environ.get("TNC_TPU_COMPLEX_MULT", "auto")
+
+
+def auto_step_mode(step) -> str | None:
+    """Per-step promotion for executors outside a full
+    :class:`KernelPolicy` plan (the hoisted prelude, whose stem GEMMs
+    are exactly the Strassen regime): ``strassen`` when the step clears
+    the crossover and no forcing override is set; ``None`` defers to
+    the env default.
+
+    Eligibility-gated only — unlike the full ladder this does NOT
+    consult ``_strassen_pays``: the prelude executes inside traced
+    functions whose caches key on the env, not on a fitted cost model,
+    so a model-dependent decision here would silently serve stale
+    traces as calibration evolves. On a device where Strassen loses,
+    force ``TNC_TPU_COMPLEX_MULT=gauss`` (the A/B knob) to disable."""
+    if complex_mult_forced() is not None:
+        return None
+    if _strassen_step_eligible(step):
+        return "strassen"
+    return None
+
+
+def resolved_step_mode(step, mode: str | None = None) -> str:
+    """The arithmetic :func:`apply_step_split` actually runs for a
+    requested mode — the env/policy name folded through the per-step
+    fallbacks (``strassen`` below the crossover → gauss; ``chain`` /
+    ``auto`` outside a policy → gauss; unknown → gauss). The flop-
+    crediting rule (:data:`EFFECTIVE_FLOP_FACTOR`) must be looked up
+    on THIS name, never the raw request."""
+    if mode is None:
+        mode = complex_mult_env()
+    if mode == "strassen":
+        return "strassen" if _strassen_step_eligible(step) else "gauss"
+    if mode in ("naive", "fused"):
+        return mode
+    return "gauss"
 
 
 def split_array(array: np.ndarray, dtype: str = "float32") -> tuple[np.ndarray, np.ndarray]:
@@ -97,10 +190,41 @@ def gauss_matmul(xp, ar, ai, br, bi):
     return k1 - k3, k1 + k2
 
 
-def apply_step_split(xp, apair, bpair, step, precision=None):
+def _as_kl(xp, part, dot_shape, cfirst):
+    """Post-prep operand (shaped ``dot_shape``) → contract-dim-leading
+    2-D ``(k, frees)`` matrix, the layout the Strassen/fused kernels
+    share with the host oracle's ``as_km``."""
+    if cfirst:
+        return part.reshape(int(dot_shape[0]), -1)
+    k = int(dot_shape[-1])
+    flat = part.reshape(-1, k)
+    return flat.T if xp is np else xp.swapaxes(flat, 0, 1)
+
+
+def _strassen_step(xp, ar, ai, br, bi, step, precision):
+    """One step through the gauss+strassen kernel: matricize both
+    prepped operands to kl layout, fold ``swap``, run 21 half-size
+    sub-GEMMs (:mod:`tnc_tpu.ops.strassen`)."""
+    from tnc_tpu.ops.strassen import gauss_strassen_dot_kl
+
+    a2r = _as_kl(xp, ar, step.a_dot, step.a_cfirst)
+    a2i = _as_kl(xp, ai, step.a_dot, step.a_cfirst)
+    b2r = _as_kl(xp, br, step.b_dot, step.b_cfirst)
+    b2i = _as_kl(xp, bi, step.b_dot, step.b_cfirst)
+    if step.swap:
+        fr, fi, sr, si = b2r, b2i, a2r, a2i
+    else:
+        fr, fi, sr, si = a2r, a2i, b2r, b2i
+    re, im = gauss_strassen_dot_kl(xp, fr, fi, sr, si, precision=precision)
+    return re.reshape(step.out_store), im.reshape(step.out_store)
+
+
+def apply_step_split(xp, apair, bpair, step, precision=None, mode=None):
     """Split-complex analogue of ``backends.apply_step``: one pairwise
-    contraction of (real, imag) pairs via three real dots (Gauss). The
-    single source of truth shared by every split-mode executor."""
+    contraction of (real, imag) pairs. The single source of truth
+    shared by every split-mode executor. ``mode`` overrides the global
+    env mode for this step — the :class:`KernelPolicy` hook; ``None``
+    falls back to :func:`complex_mult_env` (``gauss``)."""
     from tnc_tpu.ops.backends import _prep_operand
 
     ar = _prep_operand(
@@ -115,8 +239,13 @@ def apply_step_split(xp, apair, bpair, step, precision=None):
     bi = _prep_operand(
         xp, bpair[1], step.b_view, step.b_perm, step.b_dot, step.b_ops
     )
-    mode = complex_mult_env()
+    if mode is None:
+        mode = complex_mult_env()
+    if mode == "strassen" and not _strassen_step_eligible(step):
+        mode = "gauss"  # forced-strassen steps below the crossover
     if xp is np:
+        if mode == "strassen":
+            return _strassen_step(np, ar, ai, br, bi, step, None)
 
         def as_km(part, mat, cfirst):
             return part.reshape(mat) if cfirst else part.reshape(mat[::-1]).T
@@ -136,9 +265,12 @@ def apply_step_split(xp, apair, bpair, step, precision=None):
             re, im = gauss_matmul(np, ar, ai, br, bi)
         return re.reshape(step.out_store), im.reshape(step.out_store)
 
+    import jax.numpy as jnp
     from jax import lax
 
     prec = _resolve_precision(precision)
+    if mode == "strassen":
+        return _strassen_step(jnp, ar, ai, br, bi, step, prec)
     ca = (0,) if step.a_cfirst else (len(step.a_dot) - 1,)
     cb = (0,) if step.b_cfirst else (len(step.b_dot) - 1,)
 
@@ -162,10 +294,43 @@ def apply_step_split(xp, apair, bpair, step, precision=None):
     return (k1 - k3).reshape(step.out_store), (k1 + k2).reshape(step.out_store)
 
 
+def _strassen_step_eligible(step) -> bool:
+    from tnc_tpu.ops.program import step_dims
+    from tnc_tpu.ops.strassen import strassen_eligible
+
+    m, k, n = step_dims(step)
+    return strassen_eligible(m, k, n)
+
+
+_FUSED_FALLBACK_WARNED: set[str] = set()
+
+
+def _note_fused_fallback(reason: str, k: int, m: int, n: int, detail=""):
+    """Count a per-step fused-kernel fallback with its reason (the
+    ``ops.fused_fallback`` counter bench records pick up) and warn —
+    once per reason per process; repeats go to debug so a small-step
+    program doesn't spam a warning per step."""
+    from tnc_tpu import obs
+
+    obs.counter_add("ops.fused_fallback", reason=reason)
+    msg = (
+        "fused complex kernel fell back to naive dots for step "
+        f"(K={k}, M={m}, N={n}): {reason}{': ' + detail if detail else ''}"
+    )
+    if reason in _FUSED_FALLBACK_WARNED:
+        logger.debug(msg)
+    else:
+        _FUSED_FALLBACK_WARNED.add(reason)
+        logger.warning(msg)
+
+
 def _try_fused_step(ar, ai, br, bi, step, precision):
     """Route one step through the fused Pallas kernel when its layout
     allows (both operands contract-dim-leading, tileable shapes, big
     enough to amortize the grid); None means 'use the naive dots'.
+    Every fallback is counted (``ops.fused_fallback``) with its
+    eligibility reason — layout vs dtype vs tile/flop floor vs a
+    kernel error — so bench records show *why* fused didn't fire.
 
     Caveat on failure surfaces: this runs at *trace* time under the
     executor's jit, so only trace-time errors can trigger the fallback
@@ -173,16 +338,25 @@ def _try_fused_step(ar, ai, br, bi, step, precision):
     the enclosing jit compiles — the campaign's fused A/B stage is
     self-contained so such a failure costs one stage, not the window.
     """
-    if not (step.a_cfirst and step.b_cfirst):
-        return None
-    from tnc_tpu.ops.pallas_complex import eligible, fused_complex_dot_kl
-
-    k = int(step.a_dot[0])
-    m = int(np.prod(step.a_dot[1:], dtype=np.int64)) if len(step.a_dot) > 1 else 1
-    n = int(np.prod(step.b_dot[1:], dtype=np.int64)) if len(step.b_dot) > 1 else 1
+    k = int(step.a_dot[0]) if step.a_cfirst else int(step.a_dot[-1])
+    m = int(np.prod(step.a_dot, dtype=np.int64)) // max(k, 1)
+    n = int(np.prod(step.b_dot, dtype=np.int64)) // max(k, 1)
     if step.swap:
         m, n = n, m
-    if not eligible(k, m, n):
+    if not (step.a_cfirst and step.b_cfirst):
+        _note_fused_fallback("layout", k, m, n)
+        return None
+    from tnc_tpu.ops.pallas_complex import (
+        fused_complex_dot_kl,
+        ineligible_reason,
+    )
+
+    if str(ar.dtype) != "float32":
+        _note_fused_fallback("dtype", k, m, n, str(ar.dtype))
+        return None
+    reason = ineligible_reason(k, m, n)
+    if reason is not None:
+        _note_fused_fallback(reason, k, m, n)
         return None
     import jax
 
@@ -199,14 +373,329 @@ def _try_fused_step(ar, ai, br, bi, step, precision):
                 a2r, a2i, b2r, b2i, interpret=interpret, precision=precision
             )
     except Exception as e:  # trace-time only; see docstring
-        import logging
-
-        logging.getLogger(__name__).warning(
-            "fused complex kernel fell back to naive dots for step "
-            "(K=%d, M=%d, N=%d): %s: %s", k, m, n, type(e).__name__, e,
-        )
+        _note_fused_fallback("kernel_error", k, m, n, f"{type(e).__name__}: {e}")
         return None
     return re.reshape(step.out_store), im.reshape(step.out_store)
+
+
+# -- kernel promotion ladder --------------------------------------------
+
+
+@dataclass(frozen=True)
+class KernelPolicy:
+    """Per-step kernel choice for one compiled program.
+
+    ``modes[i]`` is the lowering of step ``i`` (``naive`` / ``gauss`` /
+    ``fused`` / ``strassen``); ``chains`` are ``(start, end)`` step
+    spans that execute as ONE fused multi-step Pallas dispatch
+    (:func:`tnc_tpu.ops.pallas_complex.fused_chain_kl`). Chained steps
+    carry mode ``naive`` — the chain kernel's arithmetic — so the host
+    oracle and the per-step device fallback compute the identical
+    sequence. A policy is part of the jit cache key
+    (:func:`tnc_tpu.ops.backends.jit_program`): two policies over the
+    same program are different executables.
+    """
+
+    modes: tuple[str, ...]
+    chains: tuple[tuple[int, int], ...] = ()
+
+    def signature(self) -> tuple:
+        return (self.modes, self.chains)
+
+    def chained_steps(self) -> set[int]:
+        return {i for s, e in self.chains for i in range(s, e)}
+
+    def dispatch_count(self) -> int:
+        """Device dispatches this policy issues: one per unchained step,
+        one per chain."""
+        return len(self.modes) - len(self.chained_steps()) + len(self.chains)
+
+
+def _chain_pays(cost_model, steps) -> bool:
+    """Is fusing this run of steps into one dispatch a predicted win?
+    Saves ``len(steps) - 1`` dispatch overheads; costs the naive-vs-
+    gauss flop difference (the chain kernel runs 4 dots where the
+    default ladder would run 3). With no fitted model the grouping
+    pass's own size bound (steps under the fused kernel's flop floor)
+    already selects dispatch-dominated steps — accept."""
+    if cost_model is None:
+        return True
+    from tnc_tpu.ops.program import step_flops
+
+    flops = sum(step_flops(st) for st in steps)
+    # complex k*m*n units → real-multiply units: naive 8x, gauss 6x,
+    # so fusing costs 2 extra units per k*m*n; each saved dispatch is
+    # worth its flop-equivalent under the fitted model
+    extra_flops = 2.0 * flops
+    saved_flops = (
+        len(steps) - 1
+    ) * cost_model.dispatch_equivalent_flops()
+    return saved_flops > extra_flops
+
+
+def _strassen_pays(cost_model, m: int, k: int, n: int) -> bool:
+    """First-order win check for one Strassen level over gauss: the
+    saved multiplies (0.75 → 21/32 of naive) must beat the 15 extra
+    quadrant-sized elementwise passes per real GEMM (bandwidth)."""
+    if cost_model is None:
+        return True
+    from tnc_tpu.ops.strassen import GAUSS_STRASSEN_FLOP_FACTOR
+
+    naive_real_flops = 8.0 * m * k * n
+    saved_s = (
+        0.75 - GAUSS_STRASSEN_FLOP_FACTOR
+    ) * naive_real_flops / cost_model.flops_per_s
+    if not cost_model.bytes_per_s:
+        return saved_s > 0.0
+    # ~15 add/sub passes over (m/2, k/2)+(k/2, n/2) quadrants, 3 Gauss
+    # products, f32 in + out
+    quad_bytes = 4.0 * ((m * k + k * n) / 4.0) * 2.0
+    extra_s = 3.0 * 15.0 * quad_bytes / cost_model.bytes_per_s
+    return saved_s > extra_s
+
+
+def plan_kernels(
+    program: ContractionProgram,
+    cost_model=None,
+    force: str | None = None,
+    chain_max_flops: float | None = None,
+) -> KernelPolicy:
+    """Build the kernel promotion ladder for one program — the
+    per-step decision that replaced the global env mode. Thin wrapper
+    over :func:`plan_kernel_steps` (the chunked executor plans per
+    chunk-subsequence with the same rules).
+
+    ``force`` (default: the ``TNC_TPU_COMPLEX_MULT`` override via
+    :func:`complex_mult_forced`) pins the decision for A/B runs:
+    ``naive``/``gauss``/``fused`` uniformly; ``strassen`` promotes
+    every step over the crossover (others run gauss); ``chain`` fuses
+    every groupable run (others run gauss). Unforced, the ladder is
+    cost-model-driven (``cost_model``: a
+    :class:`tnc_tpu.obs.calibrate.CalibratedCostModel` or None):
+
+    - runs of small consecutive steps whose fusion saves more dispatch
+      overhead than the naive-vs-gauss flop difference costs → one
+      fused **chain** dispatch;
+    - steps whose matricized shape clears the Strassen crossover
+      (square-ish, ≥2^11 per dim) where the multiply saving beats the
+      extra passes → **strassen**;
+    - everything else → **gauss**, the tuned default.
+    """
+    return plan_kernel_steps(
+        program.steps, cost_model, force, chain_max_flops
+    )
+
+
+def plan_kernel_steps(
+    steps,
+    cost_model=None,
+    force: str | None = None,
+    chain_max_flops: float | None = None,
+) -> KernelPolicy:
+    """:func:`plan_kernels` over a bare step sequence — chain spans and
+    modes are indexed relative to ``steps[0]``."""
+    from tnc_tpu.ops.program import chain_groups, step_dims
+    from tnc_tpu.ops.strassen import strassen_eligible
+
+    steps = tuple(steps)
+    n = len(steps)
+    if force is None:
+        force = complex_mult_forced()
+    if force in ("naive", "gauss", "fused"):
+        return KernelPolicy((force,) * n)
+    if force == "strassen":
+        modes = tuple(
+            "strassen" if _strassen_step_eligible(st) else "gauss"
+            for st in steps
+        )
+        return KernelPolicy(modes)
+
+    chains = chain_groups(steps, max_flops=chain_max_flops)
+    if force != "chain":  # auto: keep only the chains the model likes
+        chains = tuple(
+            (s, e) for s, e in chains if _chain_pays(cost_model, steps[s:e])
+        )
+    chained = {i for s, e in chains for i in range(s, e)}
+    modes = []
+    for i, st in enumerate(steps):
+        if i in chained:
+            modes.append("naive")  # the chain kernel's arithmetic
+            continue
+        if force == "chain":
+            modes.append("gauss")
+            continue
+        m, k, nn = step_dims(st)
+        if strassen_eligible(m, k, nn) and _strassen_pays(cost_model, m, k, nn):
+            modes.append("strassen")
+        else:
+            modes.append("gauss")
+    return KernelPolicy(tuple(modes), chains)
+
+
+def step_bucket(step) -> str:
+    """Shape bucket of one step for MFU reporting — policy-independent
+    so buckets stay comparable across runs: ``stem`` (clears the
+    Strassen crossover), ``small`` (under the fused kernel's flop
+    floor, the dispatch-dominated regime), ``medium`` (the rest)."""
+    from tnc_tpu.ops.pallas_complex import MIN_FLOPS
+    from tnc_tpu.ops.program import step_dims, step_flops
+    from tnc_tpu.ops.strassen import strassen_eligible
+
+    m, k, n = step_dims(step)
+    if strassen_eligible(m, k, n):
+        return "stem"
+    if 2 * step_flops(step) < MIN_FLOPS:
+        return "small"
+    return "medium"
+
+
+def effective_step_flops(step, mode: str) -> float:
+    """A step's flop count credited for the kernel mode that ran it
+    (same ``k*m*n`` complex units as :func:`tnc_tpu.ops.program.
+    step_flops`, scaled by :data:`EFFECTIVE_FLOP_FACTOR`) — the number
+    MFU should divide by so algorithmically-cheaper kernels don't
+    inflate it."""
+    from tnc_tpu.ops.program import step_flops
+
+    return step_flops(step) * EFFECTIVE_FLOP_FACTOR.get(mode, 1.0)
+
+
+def kernel_plan_summary(
+    program: ContractionProgram, policy: KernelPolicy | None = None
+) -> dict:
+    """JSON-able per-bucket summary of a program under a policy: step
+    counts, naive vs effective (mode-credited) flops, the mode mix,
+    and the dispatch count (chains collapse to one). The static side
+    of ``bench.py``'s per-bucket MFU report."""
+    if policy is None:
+        policy = plan_kernels(program)
+    from tnc_tpu.ops.program import step_flops
+
+    buckets: dict[str, dict] = {}
+    for i, st in enumerate(program.steps):
+        b = buckets.setdefault(
+            step_bucket(st),
+            {"steps": 0, "flops": 0.0, "effective_flops": 0.0, "modes": {}},
+        )
+        mode = policy.modes[i]
+        b["steps"] += 1
+        b["flops"] += step_flops(st)
+        b["effective_flops"] += effective_step_flops(st, mode)
+        b["modes"][mode] = b["modes"].get(mode, 0) + 1
+    for b in buckets.values():
+        b["flops"] = float(f"{b['flops']:.4e}")
+        b["effective_flops"] = float(f"{b['effective_flops']:.4e}")
+    return {
+        "buckets": buckets,
+        "dispatches": policy.dispatch_count(),
+        "chains": len(policy.chains),
+        "chained_steps": len(policy.chained_steps()),
+    }
+
+
+def _run_chain_split(steps, buffers, precision):
+    """Execute a grouped run of steps as ONE fused Pallas dispatch.
+
+    Non-carried operands are prepped to contract-dim-leading 2-D
+    outside the kernel (XLA-land, where transposes are free to fuse);
+    the carried value flows through the kernel in VMEM. Returns the
+    final (re, im) pair reshaped to the last step's ``out_store``.
+    Raises on any trace-time problem — the caller falls back to the
+    sequential naive loop (same arithmetic)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tnc_tpu.ops.backends import _prep_operand
+    from tnc_tpu.ops.pallas_complex import ChainLink, fused_chain_kl
+
+    prec = _resolve_precision(precision)
+    interpret = jax.default_backend() != "tpu"
+
+    def prep_kl(pair, view, perm, dot_shape, ops, cfirst):
+        r = _prep_operand(jnp, pair[0], view, perm, dot_shape, ops)
+        i = _prep_operand(jnp, pair[1], view, perm, dot_shape, ops)
+        return _as_kl(jnp, r, dot_shape, cfirst), _as_kl(
+            jnp, i, dot_shape, cfirst
+        )
+
+    head = steps[0]
+    a = prep_kl(
+        buffers[head.lhs], head.a_view, head.a_perm, head.a_dot,
+        head.a_ops, head.a_cfirst,
+    )
+    b = prep_kl(
+        buffers[head.rhs], head.b_view, head.b_perm, head.b_dot,
+        head.b_ops, head.b_cfirst,
+    )
+    first, second = (b, a) if head.swap else (a, b)
+    first_ops = (first[0], first[1], second[0], second[1])
+
+    link_ops = []
+    links = []
+    run_slot = head.lhs
+    for st in steps[1:]:
+        carried_a = st.lhs == run_slot
+        if carried_a:
+            c_pair, c_view, c_perm, c_dot, c_ops, c_cfirst = (
+                buffers[st.rhs], st.b_view, st.b_perm, st.b_dot,
+                st.b_ops, st.b_cfirst,
+            )
+            carried_dot, carried_cfirst = st.a_dot, st.a_cfirst
+        else:
+            c_pair, c_view, c_perm, c_dot, c_ops, c_cfirst = (
+                buffers[st.lhs], st.a_view, st.a_perm, st.a_dot,
+                st.a_ops, st.a_cfirst,
+            )
+            carried_dot, carried_cfirst = st.b_dot, st.b_cfirst
+        link_ops.append(
+            prep_kl(c_pair, c_view, c_perm, c_dot, c_ops, c_cfirst)
+        )
+        k = int(carried_dot[0]) if carried_cfirst else int(carried_dot[-1])
+        f = int(math.prod(carried_dot)) // max(k, 1)
+        carried_shape = (k, f) if carried_cfirst else (f, k)
+        k_axis = 0 if carried_cfirst else 1
+        carried_first = (not carried_a) if st.swap else carried_a
+        links.append(ChainLink(carried_first, carried_shape, k_axis))
+        run_slot = st.lhs
+
+    re, im = fused_chain_kl(
+        first_ops, link_ops, links, interpret=interpret, precision=prec
+    )
+    out_store = steps[-1].out_store
+    return re.reshape(out_store), im.reshape(out_store)
+
+
+def run_chain_split(xp, steps, buffers, precision=None):
+    """Execute one chain group with full buffer bookkeeping — the
+    fused dispatch on device, the sequential naive loop on the host
+    oracle (bit-identical arithmetic) or when the kernel can't trace
+    (counted as ``ops.fused_chain_fallback``). Mutates ``buffers`` the
+    same way the sequential loop would."""
+    from tnc_tpu import obs
+
+    out = None
+    if xp is not np:
+        try:
+            out = _run_chain_split(steps, buffers, precision)
+        except Exception as e:  # trace-time only — same contract as fused
+            obs.counter_add("ops.fused_chain_fallback")
+            logger.warning(
+                "fused chain kernel fell back to the sequential loop "
+                "(%d steps): %s: %s", len(steps), type(e).__name__, e,
+            )
+            out = None
+    if out is None:
+        for st in steps:
+            buffers[st.lhs] = apply_step_split(
+                xp, buffers[st.lhs], buffers[st.rhs], st, precision,
+                mode="naive",
+            )
+            buffers[st.rhs] = None
+        return buffers[steps[-1].lhs]
+    for st in steps:
+        buffers[st.rhs] = None
+    buffers[steps[-1].lhs] = out
+    return out
 
 
 def run_steps_split(
@@ -214,13 +703,29 @@ def run_steps_split(
     program: ContractionProgram,
     buffers: list[tuple[Any, Any] | None],
     precision=None,
+    policy: KernelPolicy | None = None,
 ):
     """Split-complex analogue of ``backends._run_steps``; ``buffers`` are
     (real, imag) pairs and the result is a pair in **stored** shape
-    (callers reshape to ``result_shape`` on the host)."""
-    for step in program.steps:
+    (callers reshape to ``result_shape`` on the host). ``policy`` (a
+    :class:`KernelPolicy`) promotes steps per the kernel ladder; None
+    runs every step under the env mode (``gauss`` default)."""
+    steps = program.steps
+    chain_end = (
+        {s: e for s, e in policy.chains} if policy is not None else {}
+    )
+    i = 0
+    while i < len(steps):
+        end = chain_end.get(i)
+        if end is not None:
+            run_chain_split(xp, steps[i:end], buffers, precision)
+            i = end
+            continue
+        step = steps[i]
         buffers[step.lhs] = apply_step_split(
-            xp, buffers[step.lhs], buffers[step.rhs], step, precision
+            xp, buffers[step.lhs], buffers[step.rhs], step, precision,
+            mode=policy.modes[i] if policy is not None else None,
         )
         buffers[step.rhs] = None
+        i += 1
     return buffers[program.result_slot]

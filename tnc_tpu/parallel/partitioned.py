@@ -675,7 +675,7 @@ def partitioned_sliced_executor(
         build_sliced_program,
         index_buffer,
     )
-    from tnc_tpu.ops.split_complex import run_steps_split
+    from tnc_tpu.ops.split_complex import plan_kernels, run_steps_split
 
     if devices is None:
         devices = jax.devices()
@@ -729,7 +729,10 @@ def partitioned_sliced_executor(
                     )
                     for (re, im), info in zip(bufs, sp.slot_slices)
                 ]
-                return run_steps_split(jnp, sp.program, sliced, precision)
+                return run_steps_split(
+                    jnp, sp.program, sliced, precision,
+                    policy=plan_kernels(sp.program),
+                )
             sliced = [
                 index_buffer(jnp, arr, info, indices)
                 for arr, info in zip(bufs, sp.slot_slices)

@@ -157,7 +157,9 @@ def _make_spmd_fn(
         return view
 
     if split_complex:
-        from tnc_tpu.ops.split_complex import run_steps_split
+        from tnc_tpu.ops.split_complex import plan_kernels, run_steps_split
+
+        loop_policy = plan_kernels(loop_sp.program)  # kernel ladder
 
         def one_slice(loop_buffers, s):
             indices = decompose(s)
@@ -168,7 +170,9 @@ def _make_spmd_fn(
                 )
                 for (re, im), info in zip(loop_buffers, loop_sp.slot_slices)
             ]
-            return run_steps_split(jnp, loop_sp.program, buffers, precision)
+            return run_steps_split(
+                jnp, loop_sp.program, buffers, precision, policy=loop_policy
+            )
 
         def add(acc, contrib):
             return acc[0] + contrib[0], acc[1] + contrib[1]
@@ -240,11 +244,17 @@ _SPMD_FN_CACHE_MAX = 64
 
 def _spmd_fn_cached(sp, mesh, axis, dtype, split_complex, precision, unroll,
                     max_slices, hoist=False):
+    from tnc_tpu.ops.split_complex import complex_mult_key
+
     n_devices = mesh.shape[axis]
     chunk = _effective_chunk(sp.slicing.num_slices, n_devices, max_slices)
     key = (
         sp.signature(), tuple(mesh.devices.flat), axis, str(dtype),
         split_complex, precision, unroll, chunk, hoist,
+        # the split trace bakes in the kernel policy/env mode — a stale
+        # fn under a flipped TNC_TPU_COMPLEX_MULT would silently run
+        # the wrong kernels
+        complex_mult_key() if split_complex else None,
     )
     fn = _SPMD_FN_CACHE.get(key)
     obs.counter_add("spmd_fn_cache.hit" if fn is not None else
