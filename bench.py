@@ -512,19 +512,11 @@ def bench_sycamore_amplitude():
     per_slice_flops = total_flops / max(slicing.num_slices, 1)
     step_inv, step_res = hoist_step_flops(sp)
     scale = max(per_slice_flops, 1.0)
-    if slicing.num_slices <= 1:
-        # 1-slice plans: the compiled hoist deliberately degrades to a
-        # no-op (nothing loops, so nothing is worth caching) while the
-        # planner's metadata split counts every step invariant — both
-        # are right and the split comparison below is meaningless.
-        # Only the totals must still agree.
-        if abs((step_inv + step_res) - per_slice_flops) > 1e-6 * scale:
-            raise BenchCheckError(
-                "hoist flop accounting disagrees on a 1-slice plan: "
-                f"compiled total {step_inv + step_res:.6e} vs planner "
-                f"per-slice {per_slice_flops:.6e}"
-            )
-    elif (
+    # the split comparison holds for EVERY slice count — including the
+    # 1-slice plan, where both the compiled hoist pass and
+    # StemAccountant.hoist_split degrade to the same no-op (invariant
+    # 0, everything residual); PR 6's bench-side carve-out is gone
+    if (
         abs(step_inv - inv_flops) > 1e-6 * scale
         or abs((step_inv + step_res) - per_slice_flops) > 1e-6 * scale
         or res_flops > per_slice_flops * (1 + 1e-9)

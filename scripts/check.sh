@@ -51,6 +51,29 @@ if [ "$gate_rc" -ne 1 ]; then
   exit 1
 fi
 
+echo "== planner-quality gate (fast plan-cost set vs committed baseline + injected regression) =="
+# fresh measurement, gated against the COMMITTED artifact (a plan-cost
+# regression fails CI exactly like a runtime regression) ...
+TNC_TPU_PLATFORM=cpu python scripts/planner_quality.py \
+  --fast --out /tmp/tnc_tpu_planner_fresh.json
+python scripts/planner_quality.py --gate PLANNER_QUALITY.json \
+  --fresh /tmp/tnc_tpu_planner_fresh.json
+# ... and the injected 10x plan-cost blow-up must exit exactly 1
+python - <<'PY'
+import json
+rec = json.load(open("/tmp/tnc_tpu_planner_fresh.json"))
+net = sorted(rec["gate_networks"])[0]
+rec["gate_networks"][net]["hyper"]["flops"] *= 10
+json.dump(rec, open("/tmp/tnc_tpu_planner_slow.json", "w"))
+PY
+gate_rc=0
+python scripts/planner_quality.py --gate PLANNER_QUALITY.json \
+  --fresh /tmp/tnc_tpu_planner_slow.json || gate_rc=$?
+if [ "$gate_rc" -ne 1 ]; then
+  echo "planner gate did not flag the injected 10x plan-cost regression (rc=$gate_rc)" >&2
+  exit 1
+fi
+
 echo "== crash-resume smoke (SIGKILL mid-range, resume, compare to golden) =="
 TNC_TPU_PLATFORM=cpu python scripts/crash_resume_smoke.py
 

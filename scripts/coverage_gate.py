@@ -48,6 +48,8 @@ REQUIRED_MODULES = (
     os.path.join("tnc_tpu", "utils", "digest.py"),
     os.path.join("tnc_tpu", "ops", "strassen.py"),
     os.path.join("tnc_tpu", "ops", "pallas_complex.py"),
+    os.path.join("tnc_tpu", "contractionpath", "contraction_cost.py"),
+    os.path.join("tnc_tpu", "serve", "replan.py"),
 )
 
 executed: set[tuple[str, int]] = set()

@@ -1,10 +1,34 @@
 #!/usr/bin/env python
-"""Regenerate PLANNER_QUALITY.json: native Hyperoptimizer vs Greedy on
-the BASELINE north-star networks, plus slice-and-reconfigure overhead at
-the single-chip target. Timings use perf_counter (the round-2 artifact
-reported greedy "seconds": 0.0 from a too-coarse timer).
+"""Plan-quality artifact + regression gate.
 
-Usage: python scripts/planner_quality.py [--depths 14 20] [--out PLANNER_QUALITY.json]
+Two jobs:
+
+1. **Regenerate PLANNER_QUALITY.json**: native Hyperoptimizer vs Greedy
+   on the BASELINE north-star networks (plus slice-and-reconfigure
+   overhead at the single-chip target), and — on every run — the fast
+   ``gate_networks`` set: small CPU-sized circuits where each network
+   records greedy/hyper plan cost AND the calibrated-objective
+   comparison (the plan found when the Hyperoptimizer minimizes
+   predicted *seconds* under the pinned ``reference_model``, next to
+   the flops-objective plan priced under the same model). Timings use
+   perf_counter (the round-2 artifact reported greedy "seconds": 0.0
+   from a too-coarse timer).
+
+2. **``--gate``**: recompute the fast set and compare per-network plan
+   cost (flops, log2 peak, predicted seconds) against a committed
+   baseline with the same tolerance discipline as
+   ``scripts/perf_gate.py`` (a floor so jitter never fails, a cap so a
+   genuine blow-up always does) — plan regressions fail CI exactly
+   like runtime regressions. Plan search is deterministic (seeded), so
+   the floor mostly absorbs cross-platform numeric tie-breaks.
+
+Usage:
+    python scripts/planner_quality.py                      # full regen
+    python scripts/planner_quality.py --fast               # gate set only
+    python scripts/planner_quality.py --gate PLANNER_QUALITY.json --fast
+    python scripts/planner_quality.py --gate BASE.json --fresh FRESH.json
+
+Exit codes (gate mode): 0 pass, 1 plan regression, 2 unusable input.
 """
 
 from __future__ import annotations
@@ -19,8 +43,158 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+#: pinned pricing constants for the calibrated-objective comparison —
+#: a *reference* device (1e11 FLOP/s, 1e10 B/s, 20 us/dispatch), NOT a
+#: live fit: the artifact must be reproducible on any machine. Live
+#: fits belong to bench.py's ``calibration`` block.
+REFERENCE_MODEL = {
+    "flops_per_s": 1.0e11,
+    "bytes_per_s": 1.0e10,
+    "dispatch_overhead_s": 2.0e-5,
+}
+
+#: the fast, CPU-sized gate set: deterministic structures small enough
+#: for check.sh yet planner-discriminating (greedy vs hyper gaps exist)
+GATE_NETWORK_NAMES = ("line20_d12", "brickwork12_d8", "qaoa18_p4")
+
+#: gate-set hyper settings — bounded so one network plans in seconds
+GATE_NTRIALS = 4
+GATE_POLISH_ROUNDS = 1
+GATE_POLISH_STEPS = 500
+GATE_TARGET_LOG2 = 14.0
+
+
+def _gate_network(name: str):
+    from tnc_tpu.builders.connectivity import ConnectivityLayout
+    from tnc_tpu.builders.qaoa_circuit import qaoa_circuit
+    from tnc_tpu.builders.random_circuit import (
+        brickwork_circuit,
+        random_circuit,
+    )
+    from tnc_tpu.tensornetwork.simplify import simplify_network
+
+    if name == "line20_d12":
+        raw = random_circuit(
+            20, 12, 0.5, 0.5, np.random.default_rng(3),
+            ConnectivityLayout.LINE, bitstring="0" * 20,
+        )
+    elif name == "brickwork12_d8":
+        raw, _ = (
+            brickwork_circuit(12, 8, np.random.default_rng(1))
+            .into_amplitude_network("0" * 12)
+        )
+    elif name == "qaoa18_p4":
+        raw, _ = (
+            qaoa_circuit(18, 4, np.random.default_rng(7))
+            .into_amplitude_network("0" * 18)
+        )
+    else:
+        raise ValueError(f"unknown gate network {name!r}")
+    return simplify_network(raw)
+
+
+def _reference_cost_model():
+    from tnc_tpu.obs.calibrate import CalibratedCostModel
+
+    return CalibratedCostModel.from_report(REFERENCE_MODEL)
+
+
+def _plan_predicted_seconds(tn, result, target_size, objective) -> float:
+    """Price a finder's winning plan under ``objective``: sliced (via
+    the same work-bounded repair the finders' sliced scoring uses) when
+    it exceeds the budget, flat otherwise."""
+    import math
+
+    from tnc_tpu.contractionpath.slicing import slice_and_reconfigure
+    from tnc_tpu.serve.replan import plan_predicted_cost
+
+    inputs = list(tn.tensors)
+    if target_size is not None and result.size > target_size:
+        try:
+            pairs, slicing = slice_and_reconfigure(
+                inputs, result.ssa_path.toplevel, target_size,
+                reconf_rounds=1, step_budget=None,
+                final_rounds=2, final_budget=None,
+            )
+        except ValueError:
+            return math.inf
+        return plan_predicted_cost(inputs, pairs, slicing, objective)
+    return plan_predicted_cost(
+        inputs, result.replace_path().toplevel, None, objective
+    )
+
+
+def measure_gate_network(name: str) -> dict:
+    from tnc_tpu.contractionpath.contraction_cost import CalibratedObjective
+    from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+    from tnc_tpu.contractionpath.paths.hyper import Hyperoptimizer
+
+    tn = _gate_network(name)
+    target = 2.0**GATE_TARGET_LOG2
+    model = _reference_cost_model()
+    objective = CalibratedObjective(model)
+
+    def hyper(obj=None):
+        return Hyperoptimizer(
+            ntrials=GATE_NTRIALS,
+            seed=42,
+            target_size=target,
+            polish_rounds=GATE_POLISH_ROUNDS,
+            polish_steps=GATE_POLISH_STEPS,
+            reconfigure_budget=None,  # work-bounded: reproducible ranking
+            objective=obj,
+        )
+
+    t0 = time.perf_counter()
+    greedy = Greedy(OptMethod.GREEDY).find_path(tn)
+    greedy_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    flops_plan = hyper().find_path(tn)
+    hyper_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    cal_plan = hyper(objective).find_path(tn)
+    cal_s = time.perf_counter() - t0
+
+    flops_plan_seconds = _plan_predicted_seconds(
+        tn, flops_plan, target, objective
+    )
+    cal_plan_seconds = _plan_predicted_seconds(tn, cal_plan, target, objective)
+
+    return {
+        "cores": len(tn),
+        "target_log2": GATE_TARGET_LOG2,
+        "greedy": {
+            "flops": greedy.flops,
+            "log2_peak": float(np.log2(max(greedy.size, 1))),
+            "seconds": round(greedy_s, 3),
+        },
+        "hyper": {
+            "flops": flops_plan.flops,
+            "log2_peak": float(np.log2(max(flops_plan.size, 1))),
+            "predicted_seconds": flops_plan_seconds,
+            "seconds": round(hyper_s, 3),
+        },
+        "calibrated": {
+            "flops": cal_plan.flops,
+            "log2_peak": float(np.log2(max(cal_plan.size, 1))),
+            "predicted_seconds": cal_plan_seconds,
+            "seconds": round(cal_s, 3),
+        },
+    }
+
+
+def measure_gate_networks() -> dict:
+    out = {}
+    for name in GATE_NETWORK_NAMES:
+        print(f"measuring gate network {name} ...", flush=True)
+        out[name] = measure_gate_network(name)
+    return out
+
 
 def measure(depth: int, seed: int, ntrials: int, target_log2: float) -> dict:
+    """The full north-star measurement (slow: sycamore53 at 128 trials)."""
     from tnc_tpu.builders.sycamore_circuit import sycamore_circuit
     from tnc_tpu.contractionpath.contraction_path import ContractionPath
     from tnc_tpu.contractionpath.paths import Greedy, OptMethod
@@ -88,32 +262,192 @@ def measure(depth: int, seed: int, ntrials: int, target_log2: float) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# Gate mode
+
+
+def _allowed_ratio(min_tol: float, max_tol: float) -> float:
+    """perf_gate's tolerance discipline applied to deterministic plan
+    metrics: no rep spread exists, so the floor is the whole budget —
+    but the cap still documents that nothing excuses a blow-up."""
+    return 1.0 + min(max(min_tol, 0.0), max_tol)
+
+
+def compare_quality(
+    base: dict,
+    fresh: dict,
+    min_tol: float = 0.25,
+    max_tol: float = 0.60,
+    peak_tol_bits: float = 2.0,
+) -> tuple[int, list[str]]:
+    """Gate logic; returns (exit_code, messages). Pure on dicts so the
+    tests drive it without subprocesses.
+
+    Per network, the gated metrics are the planner outputs: greedy
+    flops, hyper flops, hyper log2 peak (additive bits tolerance), and
+    the calibrated plan's predicted seconds. Improvements always pass;
+    within-record, the calibrated plan must not predict worse than the
+    flops plan beyond the tolerance (the objective's whole point).
+    """
+    base_nets = base.get("gate_networks")
+    fresh_nets = fresh.get("gate_networks")
+    if not isinstance(base_nets, dict) or not base_nets:
+        return 2, ["baseline record has no gate_networks block"]
+    if not isinstance(fresh_nets, dict) or not fresh_nets:
+        return 2, ["fresh record has no gate_networks block"]
+    missing = sorted(set(base_nets) - set(fresh_nets))
+    if missing:
+        # a baseline network the fresh run failed to measure (builder
+        # break, rename) must not silently drop out of the gate
+        return 2, [
+            "fresh record is missing gate network(s): "
+            + ", ".join(missing)
+        ]
+    common = sorted(set(base_nets) & set(fresh_nets))
+    if not common:
+        return 2, ["no common gate networks between baseline and fresh"]
+
+    allowed = _allowed_ratio(min_tol, max_tol)
+    verdict = 0
+    msgs: list[str] = []
+
+    def ratio_check(net: str, label: str, b: float, f: float) -> None:
+        nonlocal verdict
+        if not b or b <= 0.0:
+            return
+        r = f / b
+        msgs.append(
+            f"{net}.{label}: baseline {b:.4g} -> fresh {f:.4g} "
+            f"(ratio {r:.3f}, allowed {allowed:.3f})"
+        )
+        if r > allowed:
+            verdict = 1
+            msgs.append(
+                f"PLAN REGRESSION: {net}.{label} is {r:.2f}x the "
+                f"committed baseline (allowed {allowed:.2f}x)"
+            )
+
+    for net in common:
+        b, f = base_nets[net], fresh_nets[net]
+        ratio_check(net, "greedy.flops", b["greedy"]["flops"], f["greedy"]["flops"])
+        ratio_check(net, "hyper.flops", b["hyper"]["flops"], f["hyper"]["flops"])
+        ratio_check(
+            net, "calibrated.predicted_seconds",
+            b["calibrated"]["predicted_seconds"],
+            f["calibrated"]["predicted_seconds"],
+        )
+        db = f["hyper"]["log2_peak"] - b["hyper"]["log2_peak"]
+        if db > peak_tol_bits:
+            verdict = 1
+            msgs.append(
+                f"PLAN REGRESSION: {net}.hyper.log2_peak grew "
+                f"{db:.2f} bits (allowed {peak_tol_bits:.2f})"
+            )
+        # within-record invariant: the seconds-objective plan must not
+        # predict worse than the flops-objective plan
+        cal = f["calibrated"]["predicted_seconds"]
+        flo = f["hyper"]["predicted_seconds"]
+        if flo and cal > flo * allowed:
+            verdict = 1
+            msgs.append(
+                f"PLAN REGRESSION: {net} calibrated-objective plan "
+                f"predicts {cal:.4g}s vs flops-objective {flo:.4g}s — "
+                "the calibrated objective stopped helping"
+            )
+    return verdict, msgs
+
+
 def main():
-    ap = argparse.ArgumentParser()
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--depths", nargs="+", type=int, default=[14, 20])
     ap.add_argument("--ntrials", type=int, default=128)
     ap.add_argument("--target-log2", type=float, default=28.0)
     ap.add_argument("--out", default="PLANNER_QUALITY.json")
+    ap.add_argument(
+        "--fast", action="store_true",
+        help="measure only the fast gate_networks set (check.sh / CI)",
+    )
+    ap.add_argument(
+        "--gate", metavar="BASELINE",
+        help="compare fresh plan metrics against this committed record; "
+             "exit 1 on a plan-cost regression",
+    )
+    ap.add_argument(
+        "--fresh", metavar="RECORD",
+        help="(gate mode) use this previously written record instead of "
+             "recomputing — lets one measurement drive several gates",
+    )
+    ap.add_argument("--min-tol", type=float, default=0.25)
+    ap.add_argument("--max-tol", type=float, default=0.60)
     args = ap.parse_args()
+
+    if args.gate:
+        try:
+            with open(args.gate, encoding="utf-8") as fh:
+                base = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"planner gate: cannot load baseline: {e}", file=sys.stderr)
+            return 2
+        if args.fresh:
+            try:
+                with open(args.fresh, encoding="utf-8") as fh:
+                    fresh = json.load(fh)
+            except (OSError, json.JSONDecodeError) as e:
+                print(
+                    f"planner gate: cannot load fresh record: {e}",
+                    file=sys.stderr,
+                )
+                return 2
+        else:
+            fresh = {"gate_networks": measure_gate_networks()}
+        code, msgs = compare_quality(
+            base, fresh, min_tol=args.min_tol, max_tol=args.max_tol
+        )
+        for m in msgs:
+            print(
+                f"planner gate: {m}", file=sys.stderr if code else sys.stdout
+            )
+        print(
+            "planner gate: FAILED" if code else "planner gate: OK",
+            file=sys.stderr if code else sys.stdout,
+        )
+        return code
 
     out = {
         "description": (
-            "Planner quality on the BASELINE north-star networks: native "
-            "Hyperoptimizer (128 trials, seed 42) vs Greedy, and "
-            "slice-and-reconfigure overhead at the single-chip HBM target. "
-            "Reference comparator: cotengra HyperOptimizer bridge "
-            "(paths/hyperoptimization.rs:66-73). Regenerate with "
-            "scripts/planner_quality.py."
-        )
+            "Planner quality: native Hyperoptimizer (128 trials, seed 42) "
+            "vs Greedy on the BASELINE north-star networks, "
+            "slice-and-reconfigure overhead at the single-chip HBM "
+            "target, and the fast gate_networks set (greedy / "
+            "flops-objective hyper / calibrated-objective hyper, priced "
+            "under reference_model) gated in CI by "
+            "scripts/planner_quality.py --gate. Regenerate with "
+            "scripts/planner_quality.py [--fast]."
+        ),
+        "reference_model": dict(REFERENCE_MODEL),
     }
-    for depth in args.depths:
-        key = f"sycamore53_m{depth}"
-        print(f"measuring {key} ...", flush=True)
-        out[key] = measure(depth, 42, args.ntrials, args.target_log2)
+    if args.fast and os.path.exists(args.out):
+        # --fast refreshes only the gate set; carry the existing (slow)
+        # north-star entries forward untouched
+        with open(args.out, encoding="utf-8") as fh:
+            try:
+                prev = json.load(fh)
+            except json.JSONDecodeError:
+                prev = {}
+        for key, value in prev.items():
+            if key.startswith("sycamore"):
+                out[key] = value
+    if not args.fast:
+        for depth in args.depths:
+            key = f"sycamore53_m{depth}"
+            print(f"measuring {key} ...", flush=True)
+            out[key] = measure(depth, 42, args.ntrials, args.target_log2)
+    out["gate_networks"] = measure_gate_networks()
     with open(args.out, "w") as f:
         json.dump(out, f, indent=1)
     print(f"wrote {args.out}")
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main())

@@ -13,6 +13,7 @@ import concurrent.futures
 import os
 import sys
 import tempfile
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
@@ -100,6 +101,52 @@ def main() -> int:
         print(
             "[serve_smoke] repeat structure: plan-cache hit, zero "
             "plan.find_path spans"
+        )
+
+        # anytime replanner: requests stream while the background
+        # worker swaps in an improved plan — nothing drops, every
+        # amplitude (before, during, after) matches the oracle (the
+        # improved plan is a different contraction ORDER, so the
+        # guarantee across the swap is tight closeness; the bitwise
+        # before/after pin — on an exact-permutation circuit — lives in
+        # tests/test_serve.py)
+        from tnc_tpu.serve import BackgroundReplanner
+
+        def check(bits: str, amp: complex, where: str) -> None:
+            want = oracle(bits)
+            assert abs(amp - want) <= 1e-9 * max(1.0, abs(want)), (
+                f"{where} mismatch on {bits}: {amp} != {want}"
+            )
+
+        replan_cache = PlanCache(cache_dir + "/replan")
+        with ContractionService.from_circuit(
+            make_circuit(), plan_cache=replan_cache,
+            max_batch=8, max_wait_ms=2.0,
+        ) as svc3:
+            rp = BackgroundReplanner(
+                svc3, replan_cache, margin=100.0, poll_interval_s=0.005,
+            ).start()
+            deadline = time.monotonic() + 120.0
+            served = 0
+            while rp.stats["swaps"] == 0 and time.monotonic() < deadline:
+                bits = queries[served % len(queries)]
+                check(bits, svc3.amplitude(bits, timeout_s=60), "mid-replan")
+                served += 1
+            assert rp.stats["swaps"] == 1, (
+                f"replanner never swapped: {rp.stats}"
+            )
+            for bits in queries[:8]:
+                check(bits, svc3.amplitude(bits, timeout_s=60), "post-swap")
+            stats3 = svc3.stats()
+            assert stats3["counts"]["plan_swaps"] == 1, stats3
+            assert stats3["counts"]["failed"] == 0, stats3
+        replans = obs.counters_by_prefix("serve.replan.")
+        assert replans.get("serve.replan.swap", 0) == 1, replans
+        assert replans.get("serve.replan.adopted", 0) == 1, replans
+        print(
+            f"[serve_smoke] background replan: swap adopted after "
+            f"{served} in-flight requests, amplitudes oracle-stable, "
+            f"counters {replans}"
         )
     print("[serve_smoke] OK")
     return 0

@@ -1,0 +1,650 @@
+"""Pluggable planning objectives: greedy cost-function variants,
+the calibrated (seconds-domain) objective, and their threading through
+the pathfinders and communication schemes.
+
+Pins:
+
+- the improved greedy cost functions (arXiv:2405.09644) reach
+  known-optimal paths on small networks and are monotone in the
+  quantities they claim to score;
+- ``CalibratedObjective`` ranks a dispatch-heavy sliced plan WORSE than
+  a flop-heavier unsliced plan exactly when the fitted per-dispatch
+  constant says so (and not when it is zero);
+- a ``CalibratedObjective`` built from a synthetic model CHANGES path
+  selection on a pinned 5-tensor network (bytes-dominated device:
+  branch-and-bound trades 2.8x more flops for less memory traffic);
+- latency-aware communication scheduling receives calibrated
+  *seconds* on the partitioned path (never ``None``/empty latencies);
+- ``StemAccountant.hoist_split`` mirrors the compiled hoist pass's
+  no-op degradation, so bench's accounting cross-check holds on
+  1-slice plans without a carve-out;
+- ``planner_quality.py --gate`` passes on identical records and fails
+  on an injected plan-cost regression.
+"""
+
+import os
+import random
+import sys
+
+import numpy as np
+import pytest
+
+from tnc_tpu.contractionpath.contraction_cost import (
+    CalibratedObjective,
+    FlopsObjective,
+    PathObjective,
+    SizeObjective,
+    contract_op_cost_tensors,
+    greedy_cost_fn,
+    resolve_objective,
+)
+from tnc_tpu.contractionpath.contraction_path import ContractionPath
+from tnc_tpu.contractionpath.paths.branchbound import (
+    BranchBound,
+    WeightedBranchBound,
+)
+from tnc_tpu.contractionpath.paths.greedy import Greedy, OptMethod
+from tnc_tpu.contractionpath.paths.hyper import Hyperoptimizer
+from tnc_tpu.contractionpath.paths.optimal import Optimal
+from tnc_tpu.contractionpath.slicing import Slicing, StemAccountant
+from tnc_tpu.obs.calibrate import CalibratedCostModel
+from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+
+sys.path.insert(
+    0,
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+)
+
+
+# ---------------------------------------------------------------------------
+# greedy cost-function variants
+
+
+class TestGreedyCostFns:
+    def test_memory_removed_default_matches_classic(self):
+        fn = greedy_cost_fn("memory-removed")
+        assert fn(16.0, 8.0, 4.0) == 4.0
+
+    def test_alpha_weighting(self):
+        fn = greedy_cost_fn("memory-removed", alpha=2.0)
+        assert fn(16.0, 8.0, 4.0) == 16.0 - 2.0 * 12.0
+
+    def test_log_variant_monotone_in_out_size(self):
+        fn = greedy_cost_fn("memory-removed-log")
+        assert fn(64.0, 8.0, 8.0) > fn(16.0, 8.0, 8.0)
+
+    def test_size_variant_ignores_inputs(self):
+        fn = greedy_cost_fn("size")
+        assert fn(16.0, 8.0, 4.0) == fn(16.0, 1e9, 1e9) == 16.0
+
+    def test_memory_removed_monotone(self):
+        # larger output ranks strictly worse, freeing more ranks better
+        fn = greedy_cost_fn("memory-removed")
+        assert fn(32.0, 8.0, 8.0) > fn(16.0, 8.0, 8.0)
+        assert fn(16.0, 32.0, 8.0) < fn(16.0, 8.0, 8.0)
+
+    def test_unknown_kind_raises(self):
+        with pytest.raises(ValueError, match="unknown greedy cost"):
+            greedy_cost_fn("bogus")
+
+    @pytest.mark.parametrize(
+        "kind", ["memory-removed", "memory-removed-log", "size"]
+    )
+    def test_variants_reach_optimal_on_small_networks(self, kind):
+        """On small networks every variant's greedy path must match the
+        exhaustive-optimal flop count (the variants differ on large
+        graphs; tiny ones have a single sensible schedule)."""
+        tn = CompositeTensor(
+            [
+                LeafTensor([0, 1], [4, 8]),
+                LeafTensor([1, 2], [8, 2]),
+                LeafTensor([2, 3], [2, 4]),
+            ]
+        )
+        got = Greedy(OptMethod.GREEDY, cost_fn=kind).find_path(tn)
+        best = Optimal().find_path(
+            CompositeTensor([t.copy() for t in tn.tensors])
+        )
+        assert got.flops == best.flops
+
+    @pytest.mark.parametrize(
+        "kind", ["memory-removed", "memory-removed-log", "size"]
+    )
+    def test_variants_produce_valid_paths(self, kind):
+        """Every variant fully contracts a mixed random network."""
+        rng = random.Random(5)
+        tensors = [
+            LeafTensor([i, i + 1, 20 + i], [2, 2, rng.choice([2, 4])])
+            for i in range(6)
+        ]
+        tn = CompositeTensor(tensors)
+        result = Greedy(OptMethod.GREEDY, cost_fn=kind, alpha=1.5).find_path(tn)
+        assert len(result.replace_path().toplevel) == len(tensors) - 1
+
+    def test_default_cost_fn_unchanged(self):
+        """No cost_fn argument → byte-identical behavior to the classic
+        memory-removed finder (the fixture flops from test_paths)."""
+        tn = CompositeTensor(
+            [
+                LeafTensor([0, 1], [4, 4]),
+                LeafTensor([1, 2], [4, 4]),
+                LeafTensor([2, 0], [4, 4]),
+            ]
+        )
+        base = Greedy(OptMethod.GREEDY).find_path(tn)
+        explicit = Greedy(
+            OptMethod.GREEDY, cost_fn="memory-removed"
+        ).find_path(CompositeTensor([t.copy() for t in tn.tensors]))
+        assert base.ssa_path.toplevel == explicit.ssa_path.toplevel
+
+    def test_random_greedy_objective_ranking(self):
+        """RANDOM_GREEDY keeps the best trial under the provided
+        objective (here: a size objective picks a peak-minimizing
+        path, possibly different from the flops pick)."""
+        rng = random.Random(11)
+        tensors = [
+            LeafTensor(
+                sorted(rng.sample(range(10), 3)),
+                [rng.choice([2, 4, 8]) for _ in range(3)],
+            )
+            for _ in range(7)
+        ]
+        # normalize shared-leg dims (legs must agree across tensors)
+        dims = {}
+        for t in tensors:
+            for leg, d in t.edges():
+                dims.setdefault(leg, d)
+        tensors = [
+            LeafTensor(list(t.legs), [dims[l] for l in t.legs])
+            for t in tensors
+        ]
+        tn = CompositeTensor([t.copy() for t in tensors])
+        flops_pick = Greedy(OptMethod.RANDOM_GREEDY, ntrials=8).find_path(tn)
+        tn2 = CompositeTensor([t.copy() for t in tensors])
+        size_pick = Greedy(
+            OptMethod.RANDOM_GREEDY, ntrials=8, objective=SizeObjective()
+        ).find_path(tn2)
+        # the size-ranked winner's peak can never exceed the flops-ranked
+        # winner's peak (it minimizes exactly that over the same trials)
+        assert size_pick.size <= flops_pick.size
+
+
+# ---------------------------------------------------------------------------
+# objective layer
+
+
+class TestObjectives:
+    def test_resolve(self):
+        assert resolve_objective(None).name == "flops"
+        assert resolve_objective("flops").name == "flops"
+        assert resolve_objective("size").name == "size"
+        obj = CalibratedObjective(CalibratedCostModel(1e9))
+        assert resolve_objective(obj) is obj
+        with pytest.raises(ValueError):
+            resolve_objective("bogus")
+
+    def test_flops_objective_matches_contract_path_cost(self):
+        tensors = [
+            LeafTensor([0, 1], [4, 8]),
+            LeafTensor([1, 2], [8, 2]),
+            LeafTensor([2, 3], [2, 4]),
+        ]
+        path = ContractionPath.simple([(0, 1), (0, 2)])
+        from tnc_tpu.contractionpath.contraction_cost import (
+            contract_path_cost,
+        )
+
+        want, _ = contract_path_cost(tensors, path, True)
+        assert FlopsObjective().path_cost(tensors, path) == want
+
+    def test_calibrated_pair_cost_charges_dispatch(self):
+        a, b = LeafTensor([0, 1], [2, 3]), LeafTensor([1, 2], [3, 4])
+        model = CalibratedCostModel(flops_per_s=1e9, dispatch_s=1e-3)
+        got = CalibratedObjective(model).pair_cost(a, b)
+        assert got == pytest.approx(1e-3 + 24.0 / 1e9)
+
+    def test_calibrated_requires_model(self):
+        with pytest.raises(ValueError):
+            CalibratedObjective(None)
+
+    def test_dispatch_heavy_sliced_plan_ranked_worse(self):
+        """THE pin: under a synthetic model with a real per-dispatch
+        constant, a deeply sliced (dispatch-heavy) plan prices worse
+        than a flop-heavier unsliced plan — and the ranking flips back
+        when the constant is zero. Reuses the synthetic-constant style
+        of tests/test_calibrate.py (known F, c → exact expectations)."""
+        ts = [
+            LeafTensor.from_const([0, 1], 4),
+            LeafTensor.from_const([1, 2], 4),
+            LeafTensor.from_const([2, 0], 4),
+        ]
+        pairs = [(0, 1), (0, 2)]
+        deep = Slicing((0, 1, 2), (4, 4, 4))  # 64 slices, tiny residuals
+        flat = Slicing((), ())
+
+        free_dispatch = CalibratedObjective(
+            CalibratedCostModel(flops_per_s=1e9, dispatch_s=0.0)
+        )
+        real_dispatch = CalibratedObjective(
+            CalibratedCostModel(flops_per_s=1e9, dispatch_s=1e-3)
+        )
+        # with the fitted constant, the 64-dispatch plan is an order of
+        # magnitude worse than the 2-dispatch plan
+        deep_cost = real_dispatch.sliced_path_cost(ts, pairs, deep)
+        flat_cost = real_dispatch.sliced_path_cost(ts, pairs, flat)
+        assert deep_cost > 10 * flat_cost
+        # with the constant at zero the same plans are within ~2x of
+        # each other (sliced residuals shrink) — the per-dispatch term
+        # is what flips the scale, not the flop totals
+        free_deep = free_dispatch.sliced_path_cost(ts, pairs, deep)
+        free_flat = free_dispatch.sliced_path_cost(ts, pairs, flat)
+        assert free_deep < 2 * free_flat
+
+    def test_flops_vs_calibrated_ordering_flip(self):
+        """Two plans for the same work: A (fewer flops, sliced 64-way)
+        vs B (4x the flops, unsliced). Flops objective prefers A;
+        a dispatch-heavy calibrated objective prefers B."""
+        ts = [
+            LeafTensor.from_const([0, 1], 4),
+            LeafTensor.from_const([1, 2], 4),
+            LeafTensor.from_const([2, 0], 4),
+        ]
+        pairs = [(0, 1), (0, 2)]
+        deep = Slicing((0, 1, 2), (4, 4, 4))
+        flops_obj = FlopsObjective()
+        cal_obj = CalibratedObjective(
+            CalibratedCostModel(flops_per_s=1e12, dispatch_s=1e-2)
+        )
+        # under flops, the sliced plan totals 64 * residual — here the
+        # residual is so small that it stays below 4x the flat plan
+        flat_flops = flops_obj.sliced_path_cost(ts, pairs, Slicing((), ()))
+        deep_flops = flops_obj.sliced_path_cost(ts, pairs, deep)
+        flat_seconds = cal_obj.sliced_path_cost(ts, pairs, Slicing((), ()))
+        deep_seconds = cal_obj.sliced_path_cost(ts, pairs, deep)
+        assert deep_flops < 4 * flat_flops
+        # the calibrated model charges 64 dispatches: ~0.64 s vs ~0.02 s
+        assert deep_seconds > flat_seconds * 4
+
+
+# the pinned 5-tensor network (found by seeded search, then frozen):
+# under a bytes-dominated device model, branch-and-bound accepts 2.8x
+# more flops to cut memory traffic
+_PINNED_TENSORS = (
+    ((3, 5, 6, 7), (8, 4, 8, 4)),
+    ((0, 1, 2, 4), (2, 4, 16, 8)),
+    ((2, 3, 5, 6), (16, 8, 4, 8)),
+    ((7,), (4,)),
+    ((0, 1, 4), (2, 4, 8)),
+)
+
+
+def _pinned_network():
+    return [
+        LeafTensor(list(legs), list(dims)) for legs, dims in _PINNED_TENSORS
+    ]
+
+
+class TestCalibratedChangesPathSelection:
+    def test_branchbound_path_flips(self):
+        """Acceptance pin: a CalibratedObjective from a synthetic model
+        changes the selected path, and each winner is the better plan
+        under its own objective."""
+        model = CalibratedCostModel(
+            flops_per_s=1e12, dispatch_s=0.0, bytes_per_s=1e3
+        )
+        flops_path = (
+            BranchBound(nbranch=None, objective=FlopsObjective())
+            .find_path(CompositeTensor(_pinned_network()))
+            .replace_path()
+            .toplevel
+        )
+        cal_path = (
+            BranchBound(nbranch=None, objective=CalibratedObjective(model))
+            .find_path(CompositeTensor(_pinned_network()))
+            .replace_path()
+            .toplevel
+        )
+        assert flops_path != cal_path
+
+        tensors = _pinned_network()
+        fo, co = FlopsObjective(), CalibratedObjective(model)
+        fp = ContractionPath.simple(list(flops_path))
+        cp = ContractionPath.simple(list(cal_path))
+        assert fo.path_cost(tensors, fp) < fo.path_cost(tensors, cp)
+        assert co.path_cost(tensors, cp) < co.path_cost(tensors, fp)
+
+    def test_hyper_accepts_objective(self):
+        """Hyperoptimizer threads the objective through trial ranking
+        (smoke: same winner as flops on a trivially small net, but the
+        parameter path is exercised end to end)."""
+        tn = CompositeTensor(_pinned_network())
+        model = CalibratedCostModel(flops_per_s=1e9, dispatch_s=1e-5)
+        result = Hyperoptimizer(
+            ntrials=2, polish_rounds=0, reconfigure_rounds=0,
+            objective=CalibratedObjective(model),
+        ).find_path(tn)
+        assert len(result.replace_path().toplevel) == len(_PINNED_TENSORS) - 1
+
+
+# ---------------------------------------------------------------------------
+# calibrated communication scheduling
+
+
+class TestCalibratedCommunication:
+    def test_weighted_branchbound_seconds_latencies(self):
+        """Seconds-domain latencies + seconds-domain step costs: the
+        busy partition's tensor is still deferred."""
+        from tnc_tpu.contractionpath.communication_schemes import (
+            CommunicationScheme,
+        )
+
+        parts = [
+            LeafTensor([0, 1], [4, 4]),
+            LeafTensor([1, 2], [4, 4]),
+            LeafTensor([2, 0], [4, 4]),
+        ]
+        model = CalibratedCostModel(flops_per_s=1e9, dispatch_s=1e-6)
+        path = CommunicationScheme.WEIGHTED_BRANCH_BOUND.communication_path(
+            parts, {0: 10.0, 1: 0.0, 2: 0.0}, cost_model=model
+        )
+        assert path[0] == (1, 2)
+
+    def test_calibrated_latency_map_never_none(self):
+        from tnc_tpu.contractionpath.communication_schemes import (
+            calibrated_latency_map,
+        )
+
+        model = CalibratedCostModel(flops_per_s=1e9, dispatch_s=1e-3)
+        out = calibrated_latency_map({0: 1e6, 1: 0.0}, model, {0: 2.0, 1: 1.0})
+        assert out[0] == pytest.approx(2e-3 + 1e-3)
+        assert out[1] == pytest.approx(1e-3)
+
+    def test_partition_latency_map_flops_and_seconds(self):
+        import random as pyrandom
+
+        from tnc_tpu.contractionpath.repartitioning import compute_solution
+        from tnc_tpu.parallel.partitioned import partition_latency_map
+
+        tn = CompositeTensor(
+            [
+                LeafTensor([0, 1], [4, 4]),
+                LeafTensor([1, 2], [4, 4]),
+                LeafTensor([2, 3], [4, 4]),
+                LeafTensor([3, 0], [4, 4]),
+            ]
+        )
+        ptn, ppath, _, _ = compute_solution(
+            tn, [0, 0, 1, 1], rng=pyrandom.Random(0)
+        )
+        flops_lat = partition_latency_map(ptn, ppath)
+        assert all(v is not None and v > 0 for v in flops_lat.values())
+        model = CalibratedCostModel(flops_per_s=1e9, dispatch_s=1e-3)
+        sec_lat = partition_latency_map(ptn, ppath, model)
+        for i, flops in flops_lat.items():
+            assert sec_lat[i] == pytest.approx(
+                model.op_seconds(
+                    flops, dispatches=len(ppath.nested[i].toplevel)
+                )
+            )
+
+    def test_replan_fanin_keeps_nested_paths(self):
+        import random as pyrandom
+
+        from tnc_tpu.contractionpath.communication_schemes import (
+            CommunicationScheme,
+        )
+        from tnc_tpu.contractionpath.repartitioning import compute_solution
+        from tnc_tpu.parallel.partitioned import replan_fanin
+
+        tn = CompositeTensor(
+            [
+                LeafTensor([0, 1], [4, 4]),
+                LeafTensor([1, 2], [4, 4]),
+                LeafTensor([2, 3], [4, 4]),
+                LeafTensor([3, 0], [4, 4]),
+            ]
+        )
+        ptn, ppath, _, _ = compute_solution(
+            tn, [0, 0, 1, 1], rng=pyrandom.Random(0)
+        )
+        model = CalibratedCostModel(flops_per_s=1e9, dispatch_s=1e-6)
+        new_path = replan_fanin(
+            ptn, ppath, CommunicationScheme.WEIGHTED_BRANCH_BOUND, model
+        )
+        assert new_path.nested == ppath.nested
+        assert len(new_path.toplevel) == len(ppath.toplevel)
+
+    def test_compute_solution_seconds_domain(self):
+        import random as pyrandom
+
+        from tnc_tpu.contractionpath.repartitioning import compute_solution
+
+        tn = CompositeTensor(
+            [
+                LeafTensor([0, 1], [4, 4]),
+                LeafTensor([1, 2], [4, 4]),
+                LeafTensor([2, 3], [4, 4]),
+                LeafTensor([3, 0], [4, 4]),
+            ]
+        )
+        model = CalibratedCostModel(flops_per_s=1e9, dispatch_s=1e-3)
+        _, _, par_flops, _ = compute_solution(
+            tn, [0, 0, 1, 1], rng=pyrandom.Random(0)
+        )
+        _, _, par_sec, ser_sec = compute_solution(
+            tn, [0, 0, 1, 1], rng=pyrandom.Random(0), cost_model=model
+        )
+        # seconds, not op counts: a handful of 4x4 contractions under a
+        # 1 GFLOP/s + 1 ms/dispatch model lands in milliseconds
+        assert 0.0 < par_sec < 1.0 < par_flops
+        assert par_sec <= ser_sec
+
+
+# ---------------------------------------------------------------------------
+# hoist-split agreement (the 1-slice carve-out fix)
+
+
+class TestHoistSplitAgreement:
+    def _ring_program(self, slicing):
+        from tnc_tpu.contractionpath.contraction_path import ContractionPath
+        from tnc_tpu.ops.sliced import build_sliced_program
+        from tnc_tpu.tensornetwork.tensordata import TensorData
+
+        rng = np.random.default_rng(0)
+        mk = lambda legs: LeafTensor(  # noqa: E731
+            legs,
+            [4] * len(legs),
+            TensorData.matrix(rng.standard_normal([4] * len(legs))),
+        )
+        tn = CompositeTensor([mk([0, 1]), mk([1, 2]), mk([2, 3]), mk([3, 0])])
+        path = ContractionPath.simple([(0, 3), (0, 1), (0, 2)])
+        return tn, path, build_sliced_program(tn, path, slicing)
+
+    def test_one_slice_split_agrees_with_compiled(self):
+        """The fixed contract: on a 1-slice plan BOTH sides report
+        (invariant=0, residual=total) — no bench carve-out needed."""
+        from tnc_tpu.ops.hoist import hoist_step_flops
+
+        tn, path, sp = self._ring_program(Slicing((), ()))
+        inputs = [t for t in tn.tensors]
+        step_inv, step_res = hoist_step_flops(sp)
+        acct = StemAccountant(inputs, path.toplevel)
+        inv, res = acct.hoist_split(set(), acct.total_flops)
+        assert inv == step_inv == 0.0
+        assert res == pytest.approx(step_res)
+
+    def test_partial_split_still_agrees(self):
+        from tnc_tpu.contractionpath.slicing import hoisted_sliced_flops
+        from tnc_tpu.ops.hoist import hoist_step_flops
+
+        s = Slicing((2,), (4,))
+        tn, path, sp = self._ring_program(s)
+        inputs = [t for t in tn.tensors]
+        step_inv, step_res = hoist_step_flops(sp)
+        inv, res, _total = hoisted_sliced_flops(inputs, path.toplevel, s)
+        assert inv == pytest.approx(step_inv)
+        assert res == pytest.approx(step_res)
+        assert inv > 0.0  # (0, 3) really is hoistable
+
+    def test_all_variant_split_is_noop(self):
+        ts = [
+            LeafTensor.from_const([0, 1], 4),
+            LeafTensor.from_const([1, 2], 4),
+            LeafTensor.from_const([2, 0], 4),
+        ]
+        pairs = [(0, 1), (0, 2)]
+        acct = StemAccountant(ts, pairs)
+        inv, res = acct.hoist_split({0, 1, 2}, 100.0)
+        assert (inv, res) == (0.0, 100.0)
+
+    def test_untouched_leg_split_is_noop(self):
+        """A removal set that touches no step must charge the full
+        per-slice cost every slice (matching the executor, which CAN'T
+        hoist anything it would then re-run per slice)."""
+        ts = [
+            LeafTensor.from_const([0, 1], 4),
+            LeafTensor.from_const([1, 2], 4),
+            LeafTensor.from_const([2, 0], 4),
+        ]
+        pairs = [(0, 1), (0, 2)]
+        acct = StemAccountant(ts, pairs)
+        inv, res = acct.hoist_split({9999}, acct.total_flops)
+        assert inv == 0.0
+        assert res == acct.total_flops
+
+
+# ---------------------------------------------------------------------------
+# planner-quality gate logic
+
+
+class TestPlannerQualityGate:
+    def _record(self, **over):
+        net = {
+            "greedy": {"flops": 1e6, "log2_peak": 20.0},
+            "hyper": {
+                "flops": 1e5, "log2_peak": 18.0, "predicted_seconds": 0.5,
+            },
+            "calibrated": {
+                "flops": 1.2e5, "log2_peak": 18.0, "predicted_seconds": 0.4,
+            },
+        }
+        net.update(over)
+        return {"gate_networks": {"netA": net}}
+
+    def _compare(self, base, fresh, **kw):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "planner_quality",
+            os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "scripts",
+                "planner_quality.py",
+            ),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.compare_quality(base, fresh, **kw)
+
+    def test_identical_records_pass(self):
+        code, msgs = self._compare(self._record(), self._record())
+        assert code == 0, msgs
+
+    def test_regressed_hyper_flops_fails(self):
+        bad = self._record(
+            hyper={
+                "flops": 1e7, "log2_peak": 18.0, "predicted_seconds": 0.5,
+            }
+        )
+        code, msgs = self._compare(self._record(), bad)
+        assert code == 1
+        assert any("hyper.flops" in m for m in msgs)
+
+    def test_regressed_predicted_seconds_fails(self):
+        bad = self._record(
+            calibrated={
+                "flops": 1.2e5, "log2_peak": 18.0, "predicted_seconds": 40.0,
+            }
+        )
+        code, _ = self._compare(self._record(), bad)
+        assert code == 1
+
+    def test_peak_growth_fails(self):
+        bad = self._record(
+            hyper={
+                "flops": 1e5, "log2_peak": 23.0, "predicted_seconds": 0.5,
+            }
+        )
+        code, msgs = self._compare(self._record(), bad)
+        assert code == 1
+        assert any("log2_peak" in m for m in msgs)
+
+    def test_calibrated_worse_than_flops_plan_fails(self):
+        bad = self._record(
+            calibrated={
+                "flops": 1.2e5, "log2_peak": 18.0, "predicted_seconds": 2.0,
+            }
+        )
+        code, msgs = self._compare(self._record(), bad)
+        assert code == 1
+        assert any("stopped helping" in m for m in msgs)
+
+    def test_improvement_passes(self):
+        good = self._record(
+            hyper={
+                "flops": 1e4, "log2_peak": 15.0, "predicted_seconds": 0.05,
+            },
+            calibrated={
+                "flops": 1e4, "log2_peak": 15.0, "predicted_seconds": 0.04,
+            },
+        )
+        code, _ = self._compare(self._record(), good)
+        assert code == 0
+
+    def test_unusable_records(self):
+        code, _ = self._compare({}, self._record())
+        assert code == 2
+        code, _ = self._compare(self._record(), {"gate_networks": {}})
+        assert code == 2
+
+    def test_missing_baseline_network_fails(self):
+        # a baseline network absent from the fresh record must not be
+        # silently dropped from the gate (renamed/broken builder)
+        fresh = self._record()
+        fresh["gate_networks"]["netB"] = fresh["gate_networks"].pop("netA")
+        code, msgs = self._compare(self._record(), fresh)
+        assert code == 2
+        assert any("missing gate network" in m and "netA" in m for m in msgs)
+
+
+# ---------------------------------------------------------------------------
+# objective interface misuse
+
+
+def test_path_objective_is_abstract():
+    with pytest.raises(NotImplementedError):
+        PathObjective().pair_cost(
+            LeafTensor([0], [2]), LeafTensor([0], [2])
+        )
+
+
+def test_weighted_branchbound_objective_domain_consistency():
+    """With a calibrated objective the doctest fixture still defers the
+    high-latency input when latencies are seconds of the same scale."""
+    parts = [
+        LeafTensor([0, 1], [4, 4]),
+        LeafTensor([1, 2], [4, 4]),
+        LeafTensor([2, 0], [4, 4]),
+    ]
+    model = CalibratedCostModel(flops_per_s=1e9, dispatch_s=0.0)
+    finder = WeightedBranchBound(
+        {0: 100.0, 1: 0.0, 2: 0.0},
+        objective=CalibratedObjective(model),
+    )
+    got = finder.find_path(CompositeTensor(parts)).replace_path().toplevel
+    assert got[0] == (1, 2)
+
+
+def test_greedy_pair_cost_sanity():
+    a, b = LeafTensor([0, 1], [2, 3]), LeafTensor([1, 2], [3, 4])
+    assert contract_op_cost_tensors(a, b) == 24.0

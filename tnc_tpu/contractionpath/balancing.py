@@ -92,6 +92,9 @@ class BalanceSettings:
         default=_default_objective
     )
     weighted_random_top: int | None = None  # pick randomly among top-N moves
+    # a CalibratedCostModel: fan-in latencies and the iteration score
+    # move to predicted seconds (dispatch overhead per local step)
+    cost_model: object | None = None
 
 
 # ---------------------------------------------------------------------------
@@ -652,12 +655,28 @@ def balance_partitions_iter(
     def score(current: list[_PartitionData]) -> tuple[float, list[tuple[int, int]], float]:
         children = [p.local_tensor for p in current]
         latency = {i: p.flop_cost for i, p in enumerate(current)}
+        fanin_cost = None
+        if settings.cost_model is not None:
+            from tnc_tpu.contractionpath.communication_schemes import (
+                calibrated_latency_map,
+            )
+            from tnc_tpu.contractionpath.contraction_cost import (
+                CalibratedObjective,
+            )
+
+            latency = calibrated_latency_map(
+                latency,
+                settings.cost_model,
+                {i: float(len(p.contraction)) for i, p in enumerate(current)},
+            )
+            fanin_cost = CalibratedObjective(settings.cost_model).pair_cost
         communication_path = settings.communication_scheme.communication_path(
-            children, latency, rng
+            children, latency, rng, cost_model=settings.cost_model
         )
         costs = [latency[i] for i in range(len(current))]
         (parallel, _), mem = communication_path_op_costs(
-            children, communication_path, True, costs
+            children, communication_path, True, costs,
+            cost_function=fanin_cost,
         )
         return parallel, communication_path, mem
 

@@ -29,13 +29,43 @@ import enum
 import random
 from typing import Sequence
 
-from tnc_tpu.contractionpath.contraction_cost import communication_path_cost
+from tnc_tpu.contractionpath.contraction_cost import (
+    CalibratedObjective,
+    communication_path_cost,
+)
 from tnc_tpu.contractionpath.contraction_path import SimplePath  # noqa: F401
 from tnc_tpu.contractionpath.paths.branchbound import WeightedBranchBound
 from tnc_tpu.contractionpath.paths.greedy import Greedy, OptMethod
 from tnc_tpu.partitioning.bisect import bisect
 from tnc_tpu.partitioning.hypergraph import hypergraph_from_tensors
 from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+
+
+def calibrated_latency_map(
+    local_flops: dict[int, float],
+    cost_model,
+    local_steps: dict[int, float] | None = None,
+) -> dict[int, float]:
+    """Per-partition fan-in latencies in predicted **seconds**.
+
+    ``local_flops[i]`` is partition ``i``'s local contraction op count
+    and ``local_steps[i]`` its step count (dispatch overhead is charged
+    per step; defaults to 1). The result is what the latency-aware
+    schemes should receive instead of raw flop counts once a
+    :class:`~tnc_tpu.obs.calibrate.CalibratedCostModel` is available —
+    mixing flop latencies with seconds step costs (or vice versa) makes
+    the critical path meaningless.
+
+    >>> from tnc_tpu.obs.calibrate import CalibratedCostModel
+    >>> m = CalibratedCostModel(flops_per_s=1e9, dispatch_s=1e-3)
+    >>> calibrated_latency_map({0: 1e6, 1: 0.0}, m)[0]
+    0.002
+    """
+    out: dict[int, float] = {}
+    for i, flops in local_flops.items():
+        steps = 1.0 if local_steps is None else max(local_steps.get(i, 1.0), 1.0)
+        out[i] = cost_model.op_seconds(flops, dispatches=steps)
+    return out
 
 
 class CommunicationScheme(enum.Enum):
@@ -51,8 +81,15 @@ class CommunicationScheme(enum.Enum):
         children_tensors: Sequence[LeafTensor],
         latency_map: dict[int, float] | None = None,
         rng: random.Random | None = None,
+        cost_model=None,
     ) -> list[tuple[int, int]]:
         """Replace-format fan-in path over the partition tensors.
+
+        ``cost_model`` (a :class:`~tnc_tpu.obs.calibrate.
+        CalibratedCostModel`) switches the latency-aware schemes to the
+        seconds domain: fan-in steps are priced as predicted step
+        seconds, and ``latency_map`` is expected in seconds too
+        (:func:`calibrated_latency_map`).
 
         >>> from tnc_tpu.tensornetwork.tensor import LeafTensor
         >>> parts = [LeafTensor([0, 1], [4, 4]), LeafTensor([1, 2], [4, 4]),
@@ -77,12 +114,16 @@ class CommunicationScheme(enum.Enum):
         if self is CommunicationScheme.BIPARTITION_SWEEP:
             if rng is None:
                 raise ValueError("BIPARTITION_SWEEP requires a random generator")
-            return _bipartition_sweep(children_tensors, latency_map, rng)
+            return _bipartition_sweep(
+                children_tensors, latency_map, rng, cost_model=cost_model
+            )
         if self is CommunicationScheme.WEIGHTED_BRANCH_BOUND:
-            return _branchbound_path(children_tensors, latency_map)
+            return _branchbound_path(
+                children_tensors, latency_map, cost_model
+            )
         if self is CommunicationScheme.BRANCH_BOUND:
             zero = {i: 0.0 for i in range(len(children_tensors))}
-            return _branchbound_path(children_tensors, zero)
+            return _branchbound_path(children_tensors, zero, cost_model)
         raise ValueError(self)  # pragma: no cover
 
 
@@ -95,10 +136,17 @@ def _greedy_path(
 
 
 def _branchbound_path(
-    children_tensors: Sequence[LeafTensor], latency_map: dict[int, float]
+    children_tensors: Sequence[LeafTensor],
+    latency_map: dict[int, float],
+    cost_model=None,
 ) -> list[tuple[int, int]]:
     tn = CompositeTensor([t.copy() for t in children_tensors])
-    finder = WeightedBranchBound(latency_map, nbranch=10, cutoff_flops_factor=5.0)
+    objective = (
+        CalibratedObjective(cost_model) if cost_model is not None else None
+    )
+    finder = WeightedBranchBound(
+        latency_map, nbranch=10, cutoff_flops_factor=5.0, objective=objective
+    )
     return finder.find_path(tn).replace_path().toplevel
 
 
@@ -107,18 +155,25 @@ def _bipartition_sweep(
     latency_map: dict[int, float],
     rng: random.Random,
     sweeps: int = 20,
+    cost_model=None,
 ) -> list[tuple[int, int]]:
     latencies = [latency_map[i] for i in sorted(latency_map)]
-    best_flops = float("inf")
+    pair_cost = (
+        CalibratedObjective(cost_model).pair_cost
+        if cost_model is not None
+        else None
+    )
+    best_cost = float("inf")
     best_path: list[tuple[int, int]] = []
     for _ in range(sweeps):
         imbalance = 0.01 + rng.random() * 0.49
         path = _tensor_bipartition(list(enumerate(children_tensors)), imbalance, rng)
-        flops, _ = communication_path_cost(
-            children_tensors, path, True, True, latencies
+        cost, _ = communication_path_cost(
+            children_tensors, path, True, True, latencies,
+            cost_function=pair_cost,
         )
-        if flops < best_flops:
-            best_flops = flops
+        if cost < best_cost:
+            best_cost = cost
             best_path = path
     return best_path
 

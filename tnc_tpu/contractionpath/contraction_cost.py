@@ -21,10 +21,28 @@ Path-level aggregation walks nested paths (accumulating op cost, maxing
 memory) then the toplevel replace-left pairs; the communication variant
 adds per-input start latencies and supports critical-path vs sum metrics
 (``contraction_cost.rs:156-244``).
+
+Beyond the fixed cost functions, this module defines the **pluggable
+objective layer** every pathfinder minimizes:
+
+- :func:`greedy_cost_fn` — the local pair-scoring heuristics of the
+  greedy finder (the improved cost functions of arXiv:2405.09644:
+  memory-removed with a tunable ``alpha``, log-domain memory-removed,
+  and plain output size), consumed by
+  :class:`~tnc_tpu.contractionpath.paths.greedy.Greedy`;
+- :class:`PathObjective` / :class:`FlopsObjective` /
+  :class:`SizeObjective` — the path-level ranking the trial-based
+  finders (random-greedy, hyper, branch-and-bound) minimize;
+- :class:`CalibratedObjective` — the same interface priced in
+  **predicted seconds** under a fitted
+  :class:`~tnc_tpu.obs.calibrate.CalibratedCostModel` (per-step flops /
+  bytes / dispatch-constant pricing), so planning optimizes what the
+  hardware charges instead of a flop proxy.
 """
 
 from __future__ import annotations
 
+import math
 from typing import Callable, Sequence
 
 from tnc_tpu.contractionpath.contraction_path import ContractionPath
@@ -128,14 +146,22 @@ def communication_path_cost(
     only_count_ops: bool = False,
     only_critical_path: bool = True,
     tensor_cost: Sequence[float] | None = None,
+    cost_function: CostFn | None = None,
 ) -> tuple[float, float]:
     """Cost of a flat (communication) path with per-input start latencies.
 
     With ``only_critical_path`` the accumulated cost of a contraction is
     ``cost(i,j) + max(latency_i, latency_j)`` — the parallel makespan;
     otherwise latencies add — the serial sum (``contraction_cost.rs:178-244``).
+
+    ``cost_function`` overrides the per-pair cost (e.g. a
+    :class:`CalibratedObjective`'s seconds-domain ``pair_cost``, with
+    ``tensor_cost`` latencies in seconds to match).
     """
-    cost_function = contract_op_cost_tensors if only_count_ops else contract_cost_tensors
+    if cost_function is None:
+        cost_function = (
+            contract_op_cost_tensors if only_count_ops else contract_cost_tensors
+        )
     if tensor_cost is not None:
         if len(tensor_cost) != len(inputs):
             raise ValueError("tensor_cost length must match inputs")
@@ -167,15 +193,18 @@ def communication_path_op_costs(
     contract_path: Sequence[tuple[int, int]],
     only_count_ops: bool = False,
     tensor_cost: Sequence[float] | None = None,
+    cost_function: CostFn | None = None,
 ) -> tuple[tuple[float, float], float]:
     """((critical-path cost, sum cost), peak memory)
     (``contraction_cost.rs:156-167``).
     """
     parallel_cost, _ = communication_path_cost(
-        inputs, contract_path, only_count_ops, True, tensor_cost
+        inputs, contract_path, only_count_ops, True, tensor_cost,
+        cost_function,
     )
     serial_cost, mem_cost = communication_path_cost(
-        inputs, contract_path, only_count_ops, False, tensor_cost
+        inputs, contract_path, only_count_ops, False, tensor_cost,
+        cost_function,
     )
     return (parallel_cost, serial_cost), mem_cost
 
@@ -194,3 +223,220 @@ def compute_memory_requirements(
 
     _, mem = _contract_path_custom_cost(inputs, contract_path, zero, memory_estimator)
     return mem
+
+
+# ---------------------------------------------------------------------------
+# Greedy pair-scoring cost functions (arXiv:2405.09644)
+
+
+#: registry of greedy pair heuristics: name -> factory(alpha) -> fn.
+#: Each fn maps (out_size, size_a, size_b) to a score; the greedy finder
+#: repeatedly contracts the minimum-score pair.
+GREEDY_COST_KINDS = ("memory-removed", "memory-removed-log", "size")
+
+
+def greedy_cost_fn(
+    kind: str = "memory-removed", alpha: float = 1.0
+) -> Callable[[float, float, float], float]:
+    """A pair-scoring function for the greedy finder.
+
+    The improved greedy cost functions of arXiv:2405.09644 generalize
+    cotengra's memory-removed heuristic: ``alpha`` weights how strongly
+    freeing the input tensors is rewarded, and the log-domain variant
+    compares tensor *ranks* instead of raw sizes (robust when bond
+    dimensions span orders of magnitude).
+
+    - ``memory-removed``: ``size(out) - alpha * (size(a) + size(b))``
+      (``alpha=1`` is the classic default the reference reaches through
+      cotengrust);
+    - ``memory-removed-log``: ``log2(1+size(out)) - alpha *
+      log2(1 + size(a) + size(b))``;
+    - ``size``: ``size(out)`` — greedily keep intermediates small,
+      ignoring what is freed.
+
+    >>> fn = greedy_cost_fn("memory-removed")
+    >>> fn(16.0, 8.0, 8.0)
+    0.0
+    >>> greedy_cost_fn("size")(16.0, 8.0, 8.0)
+    16.0
+    """
+    if kind == "memory-removed":
+        if alpha == 1.0:
+            return lambda out, a, b: out - a - b
+        return lambda out, a, b: out - alpha * (a + b)
+    if kind == "memory-removed-log":
+        return lambda out, a, b: (
+            math.log2(1.0 + out) - alpha * math.log2(1.0 + a + b)
+        )
+    if kind == "size":
+        return lambda out, a, b: out
+    raise ValueError(
+        f"unknown greedy cost function {kind!r}; expected one of "
+        f"{GREEDY_COST_KINDS}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Pluggable path objectives
+
+
+class PathObjective:
+    """What a trial-based pathfinder minimizes, as a pluggable strategy.
+
+    Implementations supply :meth:`pair_cost` — the cost charged for one
+    pairwise contraction — and inherit path-level aggregation. The
+    *domain* of the returned numbers is the implementation's choice
+    (flop counts, predicted seconds); finders only compare candidates
+    under ONE objective, so any monotone scale works.
+    """
+
+    #: short name recorded in plan artifacts (plan cache, bench JSON)
+    name = "abstract"
+
+    def pair_cost(self, t1: LeafTensor, t2: LeafTensor) -> float:
+        raise NotImplementedError
+
+    def path_cost(
+        self, inputs: Sequence[Tensor], contract_path: ContractionPath
+    ) -> float:
+        """Total cost of a (possibly nested) replace path."""
+        cost, _ = _contract_path_custom_cost(
+            inputs, contract_path, self.pair_cost, contract_size_tensors
+        )
+        return cost
+
+    def ssa_path_cost(
+        self, inputs: Sequence[Tensor], ssa_pairs: Sequence[tuple[int, int]]
+    ) -> float:
+        """Total cost of a flat SSA pair path (the finders' native
+        candidate format)."""
+        from tnc_tpu.contractionpath.contraction_path import (
+            ssa_replace_ordering,
+        )
+
+        return self.path_cost(
+            inputs,
+            ssa_replace_ordering(ContractionPath.simple(list(ssa_pairs))),
+        )
+
+    def sliced_path_cost(
+        self,
+        inputs: Sequence[LeafTensor],
+        replace_pairs: Sequence[tuple[int, int]],
+        slicing,
+    ) -> float:
+        """Cost of a flat path executed as a slice loop. The base
+        implementation charges the naive ``num_slices x per-slice`` flop
+        total (the historical slicing-aware score, valid for the flops
+        and size objectives alike since both rank by the same slicing
+        overhead); :class:`CalibratedObjective` overrides with the
+        hoist-aware seconds formula."""
+        from tnc_tpu.contractionpath.slicing import sliced_flops
+
+        return sliced_flops(inputs, list(replace_pairs), slicing)
+
+
+class FlopsObjective(PathObjective):
+    """Minimize naive op counts — the historical default everywhere.
+
+    >>> a, b = LeafTensor([0, 1], [2, 3]), LeafTensor([1, 2], [3, 4])
+    >>> FlopsObjective().pair_cost(a, b)
+    24.0
+    """
+
+    name = "flops"
+
+    def pair_cost(self, t1: LeafTensor, t2: LeafTensor) -> float:
+        return contract_op_cost_tensors(t1, t2)
+
+
+class SizeObjective(PathObjective):
+    """Minimize the peak intermediate size (elements). ``path_cost``
+    returns the peak, not a sum — candidates still compare correctly
+    because every finder only ranks under one objective at a time."""
+
+    name = "size"
+
+    def pair_cost(self, t1: LeafTensor, t2: LeafTensor) -> float:
+        return contract_size_tensors(t1, t2)
+
+    def path_cost(
+        self, inputs: Sequence[Tensor], contract_path: ContractionPath
+    ) -> float:
+        _, mem = _contract_path_custom_cost(
+            inputs, contract_path, self.pair_cost, contract_size_tensors
+        )
+        return mem
+
+
+class CalibratedObjective(PathObjective):
+    """Predicted **seconds** under a fitted device model — the
+    plan→measure→replan loop's objective.
+
+    Each pairwise contraction is priced as one dispatched step:
+    ``flops / flops_per_s + bytes / bytes_per_s + dispatch_s`` (the
+    per-step constant raw flop counts are blind to, cf.
+    :meth:`~tnc_tpu.obs.calibrate.CalibratedCostModel.
+    dispatch_equivalent_flops`). A path of many tiny steps therefore
+    correctly loses to a path of few large ones even at equal flops,
+    and sliced plans are priced with the hoisted
+    ``prelude + num_slices x residual`` seconds formula.
+
+    >>> from tnc_tpu.obs.calibrate import CalibratedCostModel
+    >>> m = CalibratedCostModel(flops_per_s=1e9, dispatch_s=1e-3)
+    >>> obj = CalibratedObjective(m)
+    >>> a, b = LeafTensor([0, 1], [2, 3]), LeafTensor([1, 2], [3, 4])
+    >>> round(obj.pair_cost(a, b), 9)   # 24 flops + one dispatch
+    0.001000024
+    """
+
+    name = "calibrated"
+
+    def __init__(self, cost_model, bytes_per_elem: float = COMPLEX_BYTES):
+        if cost_model is None:
+            raise ValueError("CalibratedObjective requires a cost model")
+        self.cost_model = cost_model
+        self.bytes_per_elem = float(bytes_per_elem)
+
+    def pair_cost(self, t1: LeafTensor, t2: LeafTensor) -> float:
+        flops = contract_op_cost_tensors(t1, t2)
+        nbytes = contract_size_tensors(t1, t2) * self.bytes_per_elem
+        return self.cost_model.op_seconds(flops, nbytes)
+
+    def sliced_path_cost(
+        self,
+        inputs: Sequence[LeafTensor],
+        replace_pairs: Sequence[tuple[int, int]],
+        slicing,
+    ) -> float:
+        from tnc_tpu.contractionpath.slicing import (
+            StemAccountant,
+            _make_replayer,
+        )
+
+        pairs = list(replace_pairs)
+        acct = StemAccountant(inputs, pairs, cost_model=self.cost_model)
+        removed = set(slicing.legs)
+        per_slice = _make_replayer(inputs, pairs).flops(removed)
+        return acct.hoisted_cost(removed, per_slice, slicing.num_slices)
+
+
+def resolve_objective(minimize) -> PathObjective:
+    """Normalize a ``minimize`` argument — an objective instance, or the
+    legacy strings ``"flops"`` / ``"size"`` — to a :class:`PathObjective`.
+
+    >>> resolve_objective("flops").name
+    'flops'
+    >>> resolve_objective(SizeObjective()).name
+    'size'
+    """
+    if isinstance(minimize, PathObjective):
+        return minimize
+    if minimize in (None, "flops"):
+        return FlopsObjective()
+    if minimize == "size":
+        return SizeObjective()
+    raise ValueError(
+        f"unknown objective {minimize!r}; expected 'flops', 'size', or a "
+        "PathObjective instance"
+    )

@@ -26,6 +26,7 @@ import math
 from dataclasses import dataclass
 
 from tnc_tpu.contractionpath.contraction_cost import (
+    PathObjective,
     contract_cost_tensors,
     contract_op_cost_tensors,
     contract_size_tensors,
@@ -55,11 +56,16 @@ class _BranchSearch:
         cutoff_flops_factor: float,
         minimize: CostType,
         latencies: dict[int, float] | None,
+        objective: PathObjective | None = None,
     ) -> None:
         self.nbranch = nbranch
         self.cutoff_flops_factor = cutoff_flops_factor
         self.minimize = minimize
         self.latencies = latencies  # None -> plain flops accumulation
+        # objective overrides the per-pair cost (e.g. predicted seconds
+        # under a CalibratedObjective); the accumulated "flops" and any
+        # latencies are then in that objective's domain
+        self.objective = objective
 
     def search(self, inputs: list[LeafTensor]) -> list[tuple[int, int]]:
         n = len(inputs)
@@ -96,7 +102,9 @@ class _BranchSearch:
         if cached is None:
             k12 = len(self.tensors)
             ti, tj = self.tensors[i], self.tensors[j]
-            if self.latencies is not None:
+            if self.objective is not None:
+                flops_12 = self.objective.pair_cost(ti, tj)
+            elif self.latencies is not None:
                 flops_12 = contract_op_cost_tensors(ti, tj)
             else:
                 flops_12 = contract_cost_tensors(ti, tj)
@@ -183,14 +191,17 @@ class BranchBound(Pathfinder):
         nbranch: int | None = 10,
         cutoff_flops_factor: float = 4.0,
         minimize: CostType = CostType.FLOPS,
+        objective: PathObjective | None = None,
     ) -> None:
         self.nbranch = nbranch
         self.cutoff_flops_factor = cutoff_flops_factor
         self.minimize = minimize
+        self.objective = objective
 
     def _solve_toplevel(self, inputs: list[LeafTensor]) -> list[tuple[int, int]]:
         search = _BranchSearch(
-            self.nbranch, self.cutoff_flops_factor, self.minimize, None
+            self.nbranch, self.cutoff_flops_factor, self.minimize, None,
+            self.objective,
         )
         return search.search(list(inputs))
 
@@ -213,16 +224,25 @@ class WeightedBranchBound(Pathfinder):
         nbranch: int | None = 10,
         cutoff_flops_factor: float = 5.0,
         minimize: CostType = CostType.FLOPS,
+        objective: PathObjective | None = None,
     ) -> None:
+        """``objective`` prices each fan-in contraction (default: naive
+        op count). With a :class:`~tnc_tpu.contractionpath.
+        contraction_cost.CalibratedObjective` the step costs are
+        predicted seconds — ``latency_map`` must then be in seconds too
+        (the partitions' predicted local completion times), making the
+        accumulated critical path a real makespan estimate."""
         self.latency_map = dict(latency_map)
         self.nbranch = nbranch
         self.cutoff_flops_factor = cutoff_flops_factor
         self.minimize = minimize
+        self.objective = objective
 
     def _solve_toplevel(self, inputs: list[LeafTensor]) -> list[tuple[int, int]]:
         if len(self.latency_map) != len(inputs):
             raise ValueError("latency_map must cover every input tensor")
         search = _BranchSearch(
-            self.nbranch, self.cutoff_flops_factor, self.minimize, self.latency_map
+            self.nbranch, self.cutoff_flops_factor, self.minimize,
+            self.latency_map, self.objective,
         )
         return search.search(list(inputs))

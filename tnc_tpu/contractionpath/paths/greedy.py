@@ -6,13 +6,19 @@ reaches through the ``cotengrust`` crate
 
 - Score every leg-sharing pair by the **memory-removed** heuristic
   ``size(out) - size(a) - size(b)`` and repeatedly contract the minimum
-  (ties broken by insertion order).
+  (ties broken by insertion order). The heuristic is pluggable
+  (``cost_fn=`` / ``alpha=``): the improved greedy cost functions of
+  arXiv:2405.09644 — alpha-weighted memory-removed, its log-domain
+  variant, and plain output size — come from
+  :func:`~tnc_tpu.contractionpath.contraction_cost.greedy_cost_fn`.
 - When no connected pairs remain, combine the surviving components by
   outer products, smallest first (ties: larger ssa id first — matches the
   reference's observed path output on the outer-product fixtures).
 - ``RANDOM_GREEDY`` runs ``ntrials`` jittered repetitions (Gumbel noise on
   the pair score at a fixed temperature) with a deterministic seed and
-  keeps the lowest-flops path.
+  keeps the best path under the trial ``objective`` (default: lowest
+  flops; a :class:`~tnc_tpu.contractionpath.contraction_cost.
+  CalibratedObjective` ranks trials by predicted seconds instead).
 
 Nested composites get their own recursive ``find_path`` and are replaced
 by their external tensor for the top-level search, exactly as the
@@ -30,7 +36,11 @@ import math
 import random
 from typing import Sequence
 
-from tnc_tpu.contractionpath.contraction_cost import contract_path_cost
+from tnc_tpu.contractionpath.contraction_cost import (
+    PathObjective,
+    contract_path_cost,
+    greedy_cost_fn,
+)
 from tnc_tpu.contractionpath.contraction_path import (
     ContractionPath,
     ssa_replace_ordering,
@@ -50,8 +60,13 @@ def _ssa_greedy(
     inputs: Sequence[LeafTensor],
     rng: random.Random | None = None,
     temperature: float = 0.0,
+    cost_fn=None,
 ) -> list[tuple[int, int]]:
-    """Core greedy over flat leaf tensors; returns an SSA pair path."""
+    """Core greedy over flat leaf tensors; returns an SSA pair path.
+
+    ``cost_fn(out_size, size_a, size_b)`` scores candidate pairs
+    (minimum contracts first); ``None`` keeps the classic
+    memory-removed heuristic."""
     n = len(inputs)
     if n <= 1:
         return []
@@ -73,9 +88,12 @@ def _ssa_greedy(
             s *= dims[leg]
         return s
 
+    if cost_fn is None:
+        cost_fn = greedy_cost_fn("memory-removed")
+
     def pair_score(i: int, j: int) -> float:
         out = legs[i] ^ legs[j]
-        score = out_size(out) - sizes[i] - sizes[j]
+        score = cost_fn(out_size(out), sizes[i], sizes[j])
         if temperature > 0.0 and rng is not None:
             # Gumbel perturbation: subtract T * log(-log u)
             u = rng.random()
@@ -163,32 +181,48 @@ class Greedy(Pathfinder):
         ntrials: int = 32,
         seed: int = DEFAULT_SEED,
         temperature: float = 1.0,
+        cost_fn: str | None = None,
+        alpha: float = 1.0,
+        objective: PathObjective | None = None,
     ) -> None:
+        """``cost_fn``/``alpha`` select the pair heuristic
+        (:func:`~tnc_tpu.contractionpath.contraction_cost.greedy_cost_fn`
+        names, default memory-removed); ``objective`` ranks
+        ``RANDOM_GREEDY`` trials (default: naive-op flops, the
+        historical behavior — a calibrated objective keeps the trial
+        whose *predicted seconds* are lowest)."""
         self.method = method
         self.ntrials = ntrials
         self.seed = seed
         self.temperature = temperature
+        self.cost_fn = (
+            greedy_cost_fn(cost_fn, alpha) if cost_fn is not None else None
+        )
+        self.objective = objective
 
     def _solve_toplevel(self, inputs: list[LeafTensor]) -> list[tuple[int, int]]:
         if self.method is OptMethod.GREEDY:
-            return _ssa_greedy(inputs)
+            return _ssa_greedy(inputs, cost_fn=self.cost_fn)
         return self._random_greedy(inputs)
 
     def _random_greedy(self, inputs: Sequence[LeafTensor]) -> list[tuple[int, int]]:
         best_path: list[tuple[int, int]] | None = None
-        best_flops = math.inf
+        best_cost = math.inf
         leaf_tensors = list(inputs)
         for trial in range(self.ntrials):
             rng = random.Random(self.seed + trial)
             temp = 0.0 if trial == 0 else self.temperature
-            candidate = _ssa_greedy(leaf_tensors, rng, temp)
-            flops, _ = contract_path_cost(
-                leaf_tensors,
-                ssa_replace_ordering(ContractionPath.simple(candidate)),
-                True,
-            )
-            if flops < best_flops:
-                best_flops = flops
+            candidate = _ssa_greedy(leaf_tensors, rng, temp, self.cost_fn)
+            if self.objective is not None:
+                cost = self.objective.ssa_path_cost(leaf_tensors, candidate)
+            else:
+                cost, _ = contract_path_cost(
+                    leaf_tensors,
+                    ssa_replace_ordering(ContractionPath.simple(candidate)),
+                    True,
+                )
+            if cost < best_cost:
+                best_cost = cost
                 best_path = candidate
         assert best_path is not None
         return best_path

@@ -25,7 +25,10 @@ import math
 import os
 import random
 
-from tnc_tpu.contractionpath.contraction_cost import contract_path_cost
+from tnc_tpu.contractionpath.contraction_cost import (
+    PathObjective,
+    contract_path_cost,
+)
 from tnc_tpu.contractionpath.contraction_path import (
     ContractionPath,
     ssa_replace_ordering,
@@ -68,8 +71,20 @@ class Hyperoptimizer(Pathfinder):
         polish_rounds: int = 12,
         polish_steps: int = 8000,
         polish_temps: tuple[float, float] = (0.3, 0.01),
+        objective: PathObjective | None = None,
     ) -> None:
-        """``target_size``: when set, the final candidate selection is
+        """``objective``: a :class:`~tnc_tpu.contractionpath.
+        contraction_cost.PathObjective` that overrides ``minimize`` for
+        candidate ranking and final selection — a
+        ``CalibratedObjective`` ranks every trial, refinement result and
+        polish snapshot by *predicted seconds* (and, with
+        ``target_size``, prices sliced candidates with the hoist-aware
+        seconds formula, dispatch overhead included). Tree-internal
+        moves (reconfigure/anneal) keep minimizing ``minimize`` — the
+        search heuristics stay in the cheap flop domain; the objective
+        decides which resulting tree wins.
+
+        ``target_size``: when set, the final candidate selection is
         slicing-aware — candidates are scored by their *total sliced
         flops* after greedy slicing to ``target_size`` peak elements,
         not by raw flops (a slightly worse raw path that slices well is
@@ -99,6 +114,7 @@ class Hyperoptimizer(Pathfinder):
         self.polish_rounds = polish_rounds
         self.polish_steps = polish_steps
         self.polish_temps = polish_temps
+        self.objective = objective
 
     def _solve_toplevel(self, inputs: list[LeafTensor]) -> list[tuple[int, int]]:
         n = len(inputs)
@@ -127,6 +143,8 @@ class Hyperoptimizer(Pathfinder):
             candidates.append(prefix + path)
 
         def evaluate(candidate: list[tuple[int, int]]) -> float:
+            if self.objective is not None:
+                return self.objective.ssa_path_cost(inputs, candidate)
             flops, size = contract_path_cost(
                 inputs,
                 ssa_replace_ordering(ContractionPath.simple(candidate)),
@@ -135,11 +153,13 @@ class Hyperoptimizer(Pathfinder):
             return flops if self.minimize == "flops" else size
 
         def sliced_score(candidate: list[tuple[int, int]]) -> float:
-            """Total flops after slicing to the HBM target *with repair*:
-            a light slice-and-reconfigure pass. Plain greedy slicing
-            without repair wildly misranks low-flops candidates (their
-            naive slicing overhead is enormous, but reconfiguration
-            recovers most of it)."""
+            """Cost after slicing to the HBM target *with repair*: a
+            light slice-and-reconfigure pass, scored under the active
+            objective (total sliced flops by default; hoist-aware
+            predicted seconds under a calibrated objective). Plain
+            greedy slicing without repair wildly misranks low-flops
+            candidates (their naive slicing overhead is enormous, but
+            reconfiguration recovers most of it)."""
             from tnc_tpu.contractionpath.slicing import (
                 slice_and_reconfigure,
                 sliced_flops,
@@ -161,6 +181,10 @@ class Hyperoptimizer(Pathfinder):
                 )
             except ValueError:
                 return math.inf
+            if self.objective is not None:
+                return self.objective.sliced_path_cost(
+                    inputs, replace, slicing
+                )
             return sliced_flops(inputs, replace, slicing)
 
         ranked = sorted(candidates, key=evaluate)

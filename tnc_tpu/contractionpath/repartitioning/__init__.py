@@ -23,14 +23,33 @@ from tnc_tpu.tensornetwork.partitioning import partition_tensor_network
 from tnc_tpu.tensornetwork.tensor import CompositeTensor
 
 
+def _fanin_cost_function(cost_model):
+    """Per-pair fan-in cost in the latency domain: predicted seconds
+    under a calibrated model, naive op counts otherwise (None selects
+    the default inside :func:`communication_path_op_costs`)."""
+    if cost_model is None:
+        return None
+    from tnc_tpu.contractionpath.contraction_cost import CalibratedObjective
+
+    return CalibratedObjective(cost_model).pair_cost
+
+
 def compute_solution(
     tensor: CompositeTensor,
     partitioning: Sequence[int],
     communication_scheme: CommunicationScheme = CommunicationScheme.GREEDY,
     rng: random.Random | None = None,
+    cost_model=None,
 ) -> tuple[CompositeTensor, ContractionPath, float, float]:
     """(partitioned network, full path, parallel cost, serial cost)
-    for a partition assignment (``repartitioning.rs:25-76``)."""
+    for a partition assignment (``repartitioning.rs:25-76``).
+
+    ``cost_model`` (a :class:`~tnc_tpu.obs.calibrate.
+    CalibratedCostModel`) moves the whole evaluation into the seconds
+    domain: per-partition latencies become predicted local completion
+    times (dispatch overhead charged per local step), the scheme
+    schedules against them, and the returned parallel/serial costs are
+    predicted seconds instead of op counts."""
     partitioned = partition_tensor_network(
         CompositeTensor(list(tensor.tensors)), partitioning
     )
@@ -39,18 +58,29 @@ def compute_solution(
     path = result.replace_path()
 
     latency_map = {i: 0.0 for i in range(len(partitioned))}
+    local_steps = {i: 0.0 for i in range(len(partitioned))}
     for i, local_path in path.nested.items():
         child = partitioned[i]
         local_cost, _ = contract_path_cost(child.tensors, local_path, True)
         latency_map[i] = local_cost
+        local_steps[i] = float(len(local_path.toplevel))
+    if cost_model is not None:
+        from tnc_tpu.contractionpath.communication_schemes import (
+            calibrated_latency_map,
+        )
+
+        latency_map = calibrated_latency_map(
+            latency_map, cost_model, local_steps
+        )
 
     children_tensors = [child.external_tensor() for child in partitioned]
     communication_path = communication_scheme.communication_path(
-        children_tensors, latency_map, rng
+        children_tensors, latency_map, rng, cost_model=cost_model
     )
     tensor_costs = [latency_map[i] for i in range(len(children_tensors))]
     (parallel_cost, sum_cost), _ = communication_path_op_costs(
-        children_tensors, communication_path, True, tensor_costs
+        children_tensors, communication_path, True, tensor_costs,
+        cost_function=_fanin_cost_function(cost_model),
     )
 
     final_path = ContractionPath(path.nested, communication_path)
@@ -64,6 +94,7 @@ def compute_solution_with_paths(
     communication_scheme: CommunicationScheme = CommunicationScheme.GREEDY,
     rng: random.Random | None = None,
     communication_path: Sequence[tuple[int, int]] | None = None,
+    cost_model=None,
 ) -> tuple[CompositeTensor, ContractionPath, float, float]:
     """Like :func:`compute_solution`, but reuses caller-maintained local
     paths instead of re-running Greedy on every partition.
@@ -82,6 +113,9 @@ def compute_solution_with_paths(
     tree-cut plans guarantee) — skips the scheme. The path is validated
     fully: exactly ``k-1`` pairs forming a replace-left sequence over
     the ``k`` compacted blocks, every referenced slot still live.
+
+    ``cost_model``: as in :func:`compute_solution` — latencies and the
+    returned costs move to predicted seconds.
     """
     blocks: dict[int, list] = {}
     for t, b in zip(tensor.tensors, partitioning):
@@ -90,6 +124,7 @@ def compute_solution_with_paths(
 
     nested: dict[int, ContractionPath] = {}
     latency_map: dict[int, float] = {}
+    local_steps: dict[int, float] = {}
     children = []
     children_tensors = []
     for idx, b in enumerate(present):
@@ -100,10 +135,19 @@ def compute_solution_with_paths(
         nested[idx] = local
         local_cost, _ = contract_path_cost(child.tensors, local, True)
         latency_map[idx] = local_cost
+        local_steps[idx] = float(len(local.toplevel))
+    if cost_model is not None:
+        from tnc_tpu.contractionpath.communication_schemes import (
+            calibrated_latency_map,
+        )
+
+        latency_map = calibrated_latency_map(
+            latency_map, cost_model, local_steps
+        )
 
     if communication_path is None:
         communication_path = communication_scheme.communication_path(
-            children_tensors, latency_map, rng
+            children_tensors, latency_map, rng, cost_model=cost_model
         )
     else:
         communication_path = list(communication_path)
@@ -139,7 +183,8 @@ def compute_solution_with_paths(
             live.discard(b)
     tensor_costs = [latency_map[i] for i in range(len(children_tensors))]
     (parallel_cost, sum_cost), _ = communication_path_op_costs(
-        children_tensors, communication_path, True, tensor_costs
+        children_tensors, communication_path, True, tensor_costs,
+        cost_function=_fanin_cost_function(cost_model),
     )
 
     partitioned = CompositeTensor(children)

@@ -209,24 +209,45 @@ class StemAccountant:
             return self.total_flops
         return float(self._costs[~variant].sum())
 
+    def hoist_split(
+        self, removed, per_slice_flops: float
+    ) -> tuple[float, float]:
+        """(invariant, per-slice residual) flops, mirroring the compiled
+        hoist pass exactly: :func:`tnc_tpu.ops.hoist.
+        hoist_sliced_program` degrades to a no-op — nothing cached,
+        everything in the per-slice residual — when NO step is variant
+        (1-slice plans: empty removal set) or when EVERY step is, and
+        this accounting degrades identically. Keeping the two
+        implementations in lockstep is what lets bench.py cross-check
+        them without special-casing the 1-slice plan."""
+        variant = self._variant_mask(removed)
+        n_var = 0 if variant is None else int(variant.sum())
+        if n_var == 0 or n_var == len(self._costs):
+            return 0.0, per_slice_flops
+        inv = float(self._costs[~variant].sum())
+        return inv, max(per_slice_flops - inv, 0.0)
+
     def hoisted_cost(
         self, removed, per_slice_flops: float, num_slices: int
     ) -> float:
         """``invariant + num_slices * residual`` given the replayer's
-        per-slice total ``per_slice_flops`` for the same removal set.
+        per-slice total ``per_slice_flops`` for the same removal set
+        (split per :meth:`hoist_split`, so a removal set the hoist pass
+        would no-op on is charged the full per-slice cost every slice).
         With a calibrated ``cost_model`` the same split is priced in
         predicted seconds (residual dispatches included) instead of raw
         flops — both are valid scoring keys (monotone in the work), so
         callers compare candidates without caring which one is active.
         """
-        inv = self.invariant_flops(removed)
-        residual = max(per_slice_flops - inv, 0.0)
+        inv, residual = self.hoist_split(removed, per_slice_flops)
         if self._cost_model is not None:
             # the fitted dispatch overhead is per STEP: a slice runs
             # every variant step, the prelude every invariant one
             variant = self._variant_mask(removed)
             n = len(self._costs)
             n_var = 0 if variant is None else int(variant.sum())
+            if n_var == 0 or n_var == n:  # no-op hoist: all steps loop
+                n_var = n
             return self._cost_model.sliced_cost(
                 inv,
                 residual,
@@ -246,7 +267,9 @@ def hoisted_sliced_flops(
     of a sliced path under stem-hoisting execution. The naive executor
     pays ``num_slices * (invariant + residual)`` =
     :func:`sliced_flops`; the hoisted one ``invariant + num_slices *
-    residual``.
+    residual``. The split follows :meth:`StemAccountant.hoist_split`,
+    so plans the compiled hoist pass no-ops on (1-slice plans, or
+    all-variant step lists) report ``invariant == 0`` here too.
 
     >>> from tnc_tpu.tensornetwork.tensor import LeafTensor
     >>> ts = [LeafTensor.from_const([0, 1], 4), LeafTensor.from_const([1, 2], 4),
@@ -256,12 +279,13 @@ def hoisted_sliced_flops(
     >>> inv, res, total = hoisted_sliced_flops(ts, path, s)
     >>> inv > 0 and total < sliced_flops(ts, path, s)
     True
+    >>> hoisted_sliced_flops(ts, path, Slicing((), ()))[0]  # 1-slice: no-op
+    0.0
     """
     removed = set(slicing.legs)
     acct = StemAccountant(inputs, replace_path)
-    inv = acct.invariant_flops(removed)
     per_slice = _make_replayer(inputs, replace_path).flops(removed)
-    residual = max(per_slice - inv, 0.0)
+    inv, residual = acct.hoist_split(removed, per_slice)
     return inv, residual, inv + slicing.num_slices * residual
 
 

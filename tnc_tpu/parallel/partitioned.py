@@ -71,6 +71,72 @@ class PartitionExecutionError(RuntimeError):
         self.device = device
         self.original = original
 
+def partition_latency_map(
+    tn: CompositeTensor,
+    contract_path: ContractionPath,
+    cost_model=None,
+) -> dict[int, float]:
+    """Per-partition local completion latencies for fan-in scheduling —
+    never ``None``-filled: predicted seconds under ``cost_model`` (a
+    :class:`~tnc_tpu.obs.calibrate.CalibratedCostModel`, dispatch
+    overhead charged per local step), raw local op counts otherwise.
+
+    This is what the latency-aware communication schemes
+    (``WEIGHTED_BRANCH_BOUND``, ``BIPARTITION_SWEEP``) must receive on
+    the partitioned path: with an empty latency map every partition
+    looks instantly available and the "latency-aware" schedule
+    degenerates to a plain flops fan-in.
+    """
+    from tnc_tpu.contractionpath.contraction_cost import contract_path_cost
+
+    latency: dict[int, float] = {}
+    steps: dict[int, float] = {}
+    for i, child in enumerate(tn.tensors):
+        if not isinstance(child, CompositeTensor):
+            raise TypeError(f"top-level child {i} is not a partition composite")
+        if i not in contract_path.nested:
+            raise ValueError(f"partition {i} has no nested contraction path")
+        local = contract_path.nested[i]
+        flops, _ = contract_path_cost(child.tensors, local, True)
+        latency[i] = flops
+        steps[i] = float(len(local.toplevel))
+    if cost_model is not None:
+        from tnc_tpu.contractionpath.communication_schemes import (
+            calibrated_latency_map,
+        )
+
+        latency = calibrated_latency_map(latency, cost_model, steps)
+    return latency
+
+
+def replan_fanin(
+    tn: CompositeTensor,
+    contract_path: ContractionPath,
+    communication_scheme,
+    cost_model=None,
+    rng=None,
+) -> ContractionPath:
+    """Re-derive the toplevel fan-in schedule of a partitioned path with
+    a latency-aware communication scheme, keeping the nested local
+    paths. The latency map comes from :func:`partition_latency_map` —
+    calibrated seconds when a ``cost_model`` is given — so deferring a
+    slow partition's tensor is priced against real completion times.
+    """
+    import random as _random
+
+    latency = partition_latency_map(tn, contract_path, cost_model)
+    children = [
+        child.external_tensor() for child in tn.tensors
+    ]  # type: ignore[union-attr]
+    toplevel = communication_scheme.communication_path(
+        children,
+        latency,
+        rng if rng is not None else _random.Random(42),
+        cost_model=cost_model,
+    )
+    return ContractionPath(dict(contract_path.nested), list(toplevel))
+
+
 def _fanin_survivor(k: int, toplevel: Sequence[tuple[int, int]]) -> int:
     """Index that holds the final tensor after a replace-left fan-in."""
     alive = [True] * k
@@ -456,6 +522,8 @@ def distributed_partitioned_contraction(
     slice_batch: int = 8,
     chunk_steps: int = 64,
     hoist: bool = False,
+    communication_scheme=None,
+    cost_model=None,
 ) -> LeafTensor:
     """Contract a partitioned network with one partition per device.
 
@@ -470,9 +538,19 @@ def distributed_partitioned_contraction(
     path on real TPUs — or 'loop', one dispatch per partition, fine on
     virtual CPU meshes); ``hoist=True`` additionally runs each sliced
     partition's slice-invariant stem once (:mod:`tnc_tpu.ops.hoist`).
+
+    ``communication_scheme`` (a :class:`~tnc_tpu.contractionpath.
+    communication_schemes.CommunicationScheme`): re-derive the fan-in
+    schedule here via :func:`replan_fanin` — with per-partition
+    latencies always populated (calibrated seconds under ``cost_model``)
+    — instead of trusting ``contract_path.toplevel``.
     """
     import jax
 
+    if communication_scheme is not None:
+        contract_path = replan_fanin(
+            tn, contract_path, communication_scheme, cost_model
+        )
     if devices is None:
         devices = jax.devices()
         if n_devices is not None:
@@ -802,14 +880,47 @@ def partitioned_sliced_executor(
     return run, slicing, final_meta
 
 
+# process-local counter giving every broadcast_object call a unique,
+# deterministic KV key. broadcast_object is a collective: all processes
+# call it the same number of times in the same order, so their counters
+# agree by construction.
+_KV_BCAST_SEQ = 0
+_KV_BCAST_TIMEOUT_MS = 120_000
+
+
+def _coordination_client():
+    """The jax distributed coordination-service client (the same TCP
+    channel ``jax.distributed.initialize`` already established), or
+    ``None`` when unavailable (old jaxlib, or no distributed runtime).
+    Private-API access is isolated here on purpose."""
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client
+    except Exception:  # noqa: BLE001 — any API drift → collective fallback
+        return None
+
+
 def broadcast_object(obj, root: int = 0):
     """Broadcast any picklable object from host process ``root`` to all
     processes — the generic transport under :func:`broadcast_path` and
     the cross-process fan-in (the reference's serialized MPI broadcast,
-    ``mpi/communication.rs:14-28``: length-prefix phase, then payload).
+    ``mpi/communication.rs:14-28``).
 
     Identity when running single-process; non-root processes pass any
     value (it is ignored) and receive root's object.
+
+    Transport: the distributed **coordination-service KV store** (root
+    ``key_value_set``s the pickled payload under a per-call sequence
+    key; everyone else blocks on it) — control-plane metadata rides the
+    same reliable TCP channel ``jax.distributed.initialize`` set up,
+    not the accelerator data plane. The previous transport
+    (``multihost_utils.broadcast_one_to_all``, a device psum) was
+    observed to silently return ZEROS for the payload phase on
+    oversubscribed CPU/gloo test clusters — a corrupted path, not an
+    error — which is exactly the failure mode a control channel must
+    not have. The collective path is kept as a verified fallback for
+    environments without a coordination client.
     """
     import jax
 
@@ -818,9 +929,39 @@ def broadcast_object(obj, root: int = 0):
 
     import pickle
 
+    global _KV_BCAST_SEQ
+    is_root = jax.process_index() == root
+
+    client = _coordination_client()
+    if client is not None:
+        import base64
+
+        seq = _KV_BCAST_SEQ
+        _KV_BCAST_SEQ += 1
+        key = f"tnc_tpu/bcast/{root}/{seq}"
+        if is_root:
+            client.key_value_set(
+                key, base64.b64encode(pickle.dumps(obj)).decode("ascii")
+            )
+        blob = client.blocking_key_value_get(key, _KV_BCAST_TIMEOUT_MS)
+        out = pickle.loads(base64.b64decode(blob))
+        # reclaim the key: a barrier proves every process has read it,
+        # then the root deletes — without this, a long-running job's
+        # pickled payloads accumulate in the coordination service
+        # forever. Best-effort: on any barrier/delete hiccup the key
+        # simply stays resident (leak-not-break).
+        try:
+            client.wait_at_barrier(
+                f"tnc_tpu/bcast_done/{root}/{seq}", _KV_BCAST_TIMEOUT_MS
+            )
+            if is_root:
+                client.key_value_delete(key)
+        except Exception:  # noqa: BLE001 — cleanup must never fail a bcast
+            logger.debug("bcast key cleanup skipped for %s", key)
+        return out
+
     from jax.experimental import multihost_utils
 
-    is_root = jax.process_index() == root
     payload = pickle.dumps(obj) if is_root else b""
     # length-prefix phase (the reference broadcasts the length first)
     length = int(
@@ -830,7 +971,18 @@ def broadcast_object(obj, root: int = 0):
     )
     buf = np.frombuffer(payload.ljust(length, b"\0"), dtype=np.uint8)
     data = multihost_utils.broadcast_one_to_all(buf, is_source=is_root)
-    return pickle.loads(np.asarray(data).tobytes())
+    raw = np.asarray(data).tobytes()
+    try:
+        return pickle.loads(raw)
+    except Exception as exc:
+        # turn the silent-zeros corruption mode into a diagnosable error
+        raise RuntimeError(
+            "collective object broadcast returned a corrupt payload "
+            f"({len(raw)} bytes, {sum(b != 0 for b in raw[:64])} non-zero "
+            "of the first 64) — the CPU/gloo collective backend on this "
+            "host is unreliable; jax's coordination-service client was "
+            "unavailable for the KV fallback"
+        ) from exc
 
 
 def broadcast_path(path_: ContractionPath, root: int = 0) -> ContractionPath:

@@ -13,8 +13,12 @@ The serving pipeline, front to back:
 - :class:`PlanCache` (``plancache.py``) — persistent, LRU-bounded
   ``{path, slicing, hoist split, executor config}`` store keyed by a
   stable structure digest; repeat circuits skip the planner entirely.
+- :class:`BackgroundReplanner` (``replan.py``) — anytime improvement:
+  cache misses serve from a fast greedy plan, a low-priority worker
+  hyper-optimizes hot structures between requests and atomically swaps
+  in plans whose predicted cost wins.
 
-See ``docs/serving.md``.
+See ``docs/serving.md`` and ``docs/planning.md``.
 """
 
 from tnc_tpu.serve.plancache import (  # noqa: F401
@@ -25,9 +29,11 @@ from tnc_tpu.serve.rebind import (  # noqa: F401
     BoundProgram,
     bind_circuit,
     bind_template,
+    plan_structure,
     stacked_bras,
     thread_batch,
 )
+from tnc_tpu.serve.replan import BackgroundReplanner  # noqa: F401
 from tnc_tpu.serve.service import (  # noqa: F401
     ContractionService,
     DeadlineExceededError,

@@ -33,6 +33,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import threading
 import time
 from pathlib import Path
 
@@ -89,6 +90,11 @@ class PlanCache:
         self.directory = Path(directory)
         self.directory.mkdir(parents=True, exist_ok=True)
         self.max_entries = max(1, int(max_entries))
+        # explicit per-key hit counts (process-local; the on-disk LRU
+        # touch only *implies* heat via mtime): what the background
+        # replanner reads to pick which entries deserve hyper-time
+        self._hits: dict[str, int] = {}
+        self._hits_lock = threading.Lock()
 
     def _path(self, key: str) -> Path:
         return self.directory / f"{key}.json"
@@ -109,11 +115,18 @@ class PlanCache:
         executor: dict | None = None,
         flops: float | None = None,
         peak: float | None = None,
+        finder: str | None = None,
+        target_size: float | None = None,
+        predicted_seconds: float | None = None,
     ) -> dict:
         """Build the JSON plan record for a freshly planned structure:
         path pairs, optional slicing + hoist split (computed from
         ``sliced_program`` when given), executor config, and the
-        program-signature digest the entry is validated against."""
+        program-signature digest the entry is validated against.
+        ``finder``/``predicted_seconds`` record plan *provenance* — the
+        background replanner only spends hyper-optimizer time on entries
+        a fast greedy planner produced, and swaps strictly on a
+        predicted-cost win."""
         plan: dict = {
             "version": FORMAT_VERSION,
             "pairs": path.to_obj(),
@@ -122,7 +135,13 @@ class PlanCache:
             "executor": dict(executor) if executor else None,
             "program_sig": program.signature_digest(),
             "created_at": time.time(),
+            "finder": finder,
+            "target_size": (
+                float(target_size) if target_size is not None else None
+            ),
         }
+        if predicted_seconds is not None:
+            plan["predicted_seconds"] = float(predicted_seconds)
         if sliced_program is not None:
             from tnc_tpu.ops.hoist import hoist_split_counts
 
@@ -180,11 +199,36 @@ class PlanCache:
                 pass
             return None
         obs.counter_add("serve.plan_cache.hit")
+        with self._hits_lock:
+            self._hits[key] = self._hits.get(key, 0) + 1
         try:  # LRU touch: mtime records last use
             os.utime(target)
         except OSError:
             pass
         return plan
+
+    def hits(self, key: str) -> int:
+        """Process-local hit count for ``key`` (successful loads)."""
+        with self._hits_lock:
+            return self._hits.get(key, 0)
+
+    def hot_keys(self, limit: int = 8) -> list[str]:
+        """Keys by descending hit count — the explicit heat ranking the
+        LRU mtimes only imply. The single-structure
+        :class:`~tnc_tpu.serve.replan.BackgroundReplanner` gates on
+        per-key :meth:`hits` (``min_hits``); this ranking is the hook
+        for multi-structure deployments and dashboards.
+
+        >>> import tempfile
+        >>> c = PlanCache(tempfile.mkdtemp())
+        >>> c.store("a", {"version": 1, "pairs": []})
+        >>> _ = c.load("a"); _ = c.load("a"); _ = c.load("missing")
+        >>> c.hot_keys()
+        ['a']
+        """
+        with self._hits_lock:
+            ranked = sorted(self._hits.items(), key=lambda kv: (-kv[1], kv[0]))
+        return [k for k, n in ranked[: max(limit, 0)] if n > 0]
 
     def store(self, key: str, plan: dict) -> None:
         """Atomic write + LRU eviction down to ``max_entries``.
@@ -216,6 +260,8 @@ class PlanCache:
             self._path(key).unlink(missing_ok=True)
         except OSError:
             pass
+        with self._hits_lock:
+            self._hits.pop(key, None)
         obs.counter_add("serve.plan_cache.invalidated")
 
     def _entries(self) -> list[Path]:
@@ -239,7 +285,12 @@ class PlanCache:
                 obs.counter_add("serve.plan_cache.evicted")
                 logger.info("plan cache evicted %s (LRU)", victim.name)
             except OSError:
-                pass
+                continue
+            # heat follows the entry out: hits()/hot_keys() must not
+            # rank keys the cache no longer holds, and the dict must
+            # not grow one entry per structure ever served
+            with self._hits_lock:
+                self._hits.pop(victim.stem, None)
 
     def __len__(self) -> int:
         return len(self._entries())

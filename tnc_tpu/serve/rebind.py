@@ -121,6 +121,10 @@ class BoundProgram:
     batch_flags: tuple[tuple[bool, bool], ...]
     threadable: bool  # batch leg threads through every touched step
     plan: dict = field(default_factory=dict)  # plan-cache record (if any)
+    # the budget this structure was planned under (part of the cache
+    # key): a replanner must re-plan under the SAME budget for the swap
+    # to be safe
+    target_size: float | None = None
     # HBM-constrained structures carry a sliced plan: each request runs
     # the slice loop (stacked dispatch; the batch leg stops here)
     sliced: Any = None  # SlicedProgram | None
@@ -258,6 +262,43 @@ class BoundProgram:
         )
 
 
+def plan_structure(
+    tn, pathfinder=None, target_size: float | None = None
+):
+    """Plan one amplitude structure: find a path, slice to the budget
+    when needed, compile. Returns ``(path, slicing, program,
+    sliced_program, result)`` — the shared planning step behind
+    :func:`bind_template`'s cache-miss branch and the background
+    replanner (:mod:`tnc_tpu.serve.replan`), so both produce plans with
+    identical semantics and cache records."""
+    from tnc_tpu.contractionpath.contraction_path import ContractionPath
+
+    if pathfinder is None:
+        from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+
+        pathfinder = Greedy(OptMethod.GREEDY)
+    result = pathfinder.find_path(tn)
+    slicing = None
+    if target_size is not None and result.size > target_size:
+        from tnc_tpu.contractionpath.slicing import slice_and_reconfigure
+
+        replace_pairs, slicing = slice_and_reconfigure(
+            list(tn.tensors), result.ssa_path.toplevel, target_size
+        )
+        if slicing.num_slices <= 1:
+            slicing = None
+        path = ContractionPath.simple(list(replace_pairs))
+    else:
+        path = result.replace_path()
+    program = build_program(tn, path)
+    sliced = (
+        build_sliced_program(tn, path, slicing)
+        if slicing is not None
+        else None
+    )
+    return path, slicing, program, sliced, result
+
+
 def bind_template(
     template: AmplitudeTemplate,
     pathfinder=None,
@@ -296,27 +337,8 @@ def bind_template(
         plan = plan_cache.load(key) or {}
         pairs = plan.get("pairs")
     if pairs is None:
-        if pathfinder is None:
-            from tnc_tpu.contractionpath.paths import Greedy, OptMethod
-
-            pathfinder = Greedy(OptMethod.GREEDY)
-        result = pathfinder.find_path(tn)
-        if target_size is not None and result.size > target_size:
-            from tnc_tpu.contractionpath.slicing import slice_and_reconfigure
-
-            replace_pairs, slicing = slice_and_reconfigure(
-                list(tn.tensors), result.ssa_path.toplevel, target_size
-            )
-            if slicing.num_slices <= 1:
-                slicing = None
-            path = ContractionPath.simple(list(replace_pairs))
-        else:
-            path = result.replace_path()
-        program = build_program(tn, path)
-        sliced = (
-            build_sliced_program(tn, path, slicing)
-            if slicing is not None
-            else None
+        path, slicing, program, sliced, result = plan_structure(
+            tn, pathfinder, target_size
         )
         if plan_cache is not None:
             plan = plan_cache.record_for(
@@ -326,6 +348,12 @@ def bind_template(
                 sliced_program=sliced,
                 flops=result.flops,
                 peak=result.size,
+                finder=(
+                    type(pathfinder).__name__
+                    if pathfinder is not None
+                    else "Greedy"
+                ),
+                target_size=target_size,
             )
             plan_cache.store(key, plan)
     else:
@@ -369,6 +397,7 @@ def bind_template(
         threadable=threadable,
         plan=plan,
         sliced=sliced,
+        target_size=target_size,
     )
 
 

@@ -25,7 +25,14 @@ Production posture:
 - **observability**: ``serve.queue_depth`` gauge,
   ``serve.batch_size``/``serve.latency_s``/``serve.wait_s``
   histograms, ``serve.requests.*`` counters, plus the plan-cache
-  hit/miss counters from :mod:`tnc_tpu.serve.plancache`.
+  hit/miss counters from :mod:`tnc_tpu.serve.plancache`;
+- **anytime replanning**: a cache-missed structure serves from its
+  fast greedy plan immediately; a
+  :class:`~tnc_tpu.serve.replan.BackgroundReplanner` may later
+  :meth:`~ContractionService.swap_bound` in a hyper-optimized
+  :class:`BoundProgram` for the SAME structure — the dispatcher adopts
+  it atomically between batches (every batch runs wholly under one
+  bound, so in-flight requests are never split across plans).
 """
 
 from __future__ import annotations
@@ -113,10 +120,14 @@ class ContractionService:
         self._counts = {
             "submitted": 0, "completed": 0, "failed": 0,
             "expired": 0, "rejected": 0, "cancelled": 0,
-            "batches": 0, "degraded_batches": 0,
+            "batches": 0, "degraded_batches": 0, "plan_swaps": 0,
         }
         self._batch_sizes: deque[int] = deque(maxlen=_STATS_CAP)
         self._latencies: deque[float] = deque(maxlen=_STATS_CAP)
+        # an improved BoundProgram staged by the background replanner;
+        # the dispatcher adopts it at the next batch boundary
+        self._pending_bound: BoundProgram | None = None
+        self._replanner = None  # attached BackgroundReplanner, if any
 
     @classmethod
     def from_circuit(
@@ -127,12 +138,35 @@ class ContractionService:
         plan_cache=None,
         backend=None,
         target_size=None,
+        background_replan: bool = False,
+        replan_options: dict | None = None,
         **kwargs,
     ) -> "ContractionService":
-        """Build (plan/compile once, plan cache honored) and start."""
+        """Build (plan/compile once, plan cache honored) and start.
+
+        ``background_replan=True`` (requires ``plan_cache``) attaches a
+        :class:`~tnc_tpu.serve.replan.BackgroundReplanner`: a cache miss
+        is answered from the fast greedy plan immediately, and the
+        worker hyper-optimizes the structure between requests, swapping
+        in the improved plan when its predicted cost wins.
+        ``replan_options`` are its constructor kwargs."""
+        if background_replan and plan_cache is None:
+            raise ValueError("background_replan requires a plan_cache")
         bound = bind_circuit(circuit, mask, pathfinder, plan_cache, target_size)
         svc = cls(bound, backend=backend, **kwargs)
         svc.start()
+        if background_replan:
+            from tnc_tpu.serve.replan import BackgroundReplanner
+
+            try:
+                BackgroundReplanner(
+                    svc, plan_cache, **(replan_options or {})
+                ).start()
+            except Exception:
+                # a bad replan_options kwarg must not leak a running
+                # dispatcher thread the caller has no handle to
+                svc.stop()
+                raise
         return svc
 
     # -- lifecycle ---------------------------------------------------------
@@ -151,7 +185,11 @@ class ContractionService:
     def stop(self, drain: bool = True) -> None:
         """Stop accepting requests; by default finish ('drain') what is
         already queued, otherwise fail queued requests with
-        :class:`ServiceClosedError`."""
+        :class:`ServiceClosedError`. An attached background replanner
+        is stopped first (it must not swap into a closing service)."""
+        replanner, self._replanner = self._replanner, None
+        if replanner is not None:
+            replanner.stop()
         with self._cond:
             if not self._running:
                 return
@@ -164,6 +202,57 @@ class ContractionService:
         if self._thread is not None:
             self._thread.join(timeout=60.0)
             self._thread = None
+
+    # -- plan swap (anytime replanning) ------------------------------------
+
+    def swap_bound(self, bound: BoundProgram) -> None:
+        """Stage an improved :class:`BoundProgram` for the SAME circuit
+        structure (the background replanner's entry point). The
+        dispatcher adopts it at the next batch boundary — batches are
+        dispatched wholly under one bound, so no in-flight request ever
+        mixes plans. Amplitude *values* are plan-independent (both
+        programs contract the same network), so co-existing old-plan
+        and new-plan responses are equally correct."""
+        from tnc_tpu.serve.plancache import network_structure_digest
+
+        if bound.template is not self.bound.template:
+            # same structure digest (legs/dims/budget) AND same leaf
+            # values: the digest is value-blind by design (all
+            # bitstrings share it), but a swap with different gate
+            # VALUES would silently serve another circuit's amplitudes
+            if network_structure_digest(
+                bound.template.network, bound.target_size
+            ) != network_structure_digest(
+                self.bound.template.network, self.bound.target_size
+            ) or not all(
+                np.array_equal(a, b)
+                for a, b in zip(bound.arrays, self.bound.arrays)
+            ):
+                raise ValueError(
+                    "swap_bound: replacement program was bound for a "
+                    "different structure or different leaf values — "
+                    "not a plan for this service's circuit/budget"
+                )
+        with self._lock:
+            self._pending_bound = bound
+
+    def _current_bound(self) -> BoundProgram:
+        """The bound to dispatch the NEXT batch under, adopting any
+        staged replacement first."""
+        with self._lock:
+            pending, self._pending_bound = self._pending_bound, None
+            if pending is not None:
+                self.bound = pending
+                self._counts["plan_swaps"] += 1
+        if pending is not None:
+            obs.counter_add("serve.replan.adopted")
+            logger.info("adopted replanned program for serving")
+        return self.bound
+
+    def queue_depth(self) -> int:
+        """Instantaneous queue length (the replanner's idleness probe)."""
+        with self._cond:
+            return len(self._queue)
 
     def __enter__(self) -> "ContractionService":
         return self.start()
@@ -313,10 +402,14 @@ class ContractionService:
             obs.observe("serve.wait_s", now - req.t_submit)
 
         bits = [req.bits for req in live]
+        # one bound per batch: adopt a staged replan at this boundary,
+        # then every rider of the batch (including singleton-degrade
+        # re-dispatches) runs under the SAME program
+        bound = self._current_bound()
         try:
             with obs.span("serve.dispatch", batch=len(live)):
                 amps = self.retry_policy.run(
-                    lambda: self.bound.amplitudes_det(bits, self.backend),
+                    lambda: bound.amplitudes_det(bits, self.backend),
                     label="serve.dispatch",
                 )
         except Exception as exc:  # noqa: BLE001 — degrade to singletons
@@ -326,20 +419,24 @@ class ContractionService:
             )
             self._count("degraded_batches")
             obs.counter_add("serve.batch_degraded")
-            self._run_singletons(live)
+            self._run_singletons(live, bound)
             return
         done = time.monotonic()
         for i, req in enumerate(live):
             if self._complete(req, result=self._per_request(amps, i)):
                 self._finish(req, done)
 
-    def _run_singletons(self, batch: list[_Request]) -> None:
+    def _run_singletons(self, batch: list[_Request], bound=None) -> None:
         """Degraded mode: each rider re-dispatched alone — one bad
         request (or a transient that outlived its retries) fails only
-        itself."""
+        itself. ``bound`` pins the batch's program across the
+        re-dispatches (a mid-degrade plan swap must not split a
+        batch)."""
+        if bound is None:
+            bound = self.bound
         for req in batch:
             try:
-                amps = self.bound.amplitudes_det([req.bits], self.backend)
+                amps = bound.amplitudes_det([req.bits], self.backend)
             except Exception as exc:  # noqa: BLE001 — per-request verdict
                 self._count("failed")
                 obs.counter_add("serve.requests.failed")
