@@ -2009,6 +2009,58 @@ def _kernel_buckets_from_spans(obs) -> dict:
     return {"source": source, "buckets": buckets}
 
 
+def _distributed_from_spans(obs) -> dict | None:
+    """The ``distributed`` bench block: per-level fan-in wall time,
+    bytes over the interconnect (ICI device-to-device on one host, DCN
+    for cross-process pairs), and the dispatch-overlap ratio
+    (pairs/levels — the scheduled concurrency of the reduce tree; 1.0
+    means a fully serial chain). Read from the ``partitioned.fanin`` /
+    ``partitioned.fanin_level`` spans the overlapped executors emit;
+    ``scripts/perf_gate.py`` cross-checks it between records."""
+    level_spans = [
+        r for r in obs.get_registry().span_records()
+        if r.name == "partitioned.fanin_level"
+    ]
+    if not level_spans:
+        return None
+    per_level: dict[int, dict] = {}
+    for r in level_spans:
+        li = int(r.args.get("level", 0))
+        d = per_level.setdefault(
+            li,
+            {"level": li, "pairs": 0, "runs": 0, "wall_s": 0.0,
+             "bytes": 0.0, "flops": 0.0},
+        )
+        d["runs"] += 1
+        d["pairs"] = max(d["pairs"], int(r.args.get("pairs", 0)))
+        d["wall_s"] += r.dur_ns / 1e9
+        d["bytes"] += float(r.args.get("bytes", 0.0))
+        d["flops"] += float(r.args.get("flops", 0.0))
+    levels = [per_level[li] for li in sorted(per_level)]
+    pairs = sum(d["pairs"] for d in levels)
+    for d in levels:
+        d["wall_s"] = round(d["wall_s"], 6)
+    out = {
+        "fanin_levels": len(levels),
+        "fanin_pairs": pairs,
+        "dispatch_overlap_ratio": round(pairs / max(len(levels), 1), 3),
+        "fanin_wall_s": round(sum(d["wall_s"] for d in levels), 6),
+        "interconnect_bytes": float(
+            f"{sum(d['bytes'] for d in levels):.4e}"
+        ),
+        "per_level": levels,
+    }
+    cross = [
+        r for r in obs.get_registry().span_records()
+        if r.name == "partitioned.fanin" and "cross_pairs" in r.args
+    ]
+    if cross:
+        out["cross_process_pairs"] = int(
+            max(r.args["cross_pairs"] for r in cross)
+        )
+    return out
+
+
 def _attach_obs_breakdown(record: dict, obs) -> None:
     """Per-phase wall-time breakdown (from the obs registry, the reads
     that replaced the old ad-hoc timing) + the Chrome-trace export.
@@ -2077,6 +2129,17 @@ def _attach_obs_breakdown(record: dict, obs) -> None:
         kernel_counters = obs.counters_by_prefix("ops.")
         if kernel_counters:
             record["kernel_counters"] = kernel_counters
+        # distributed fan-in breakdown (overlapped-reduce runs only):
+        # per-level wall time, interconnect bytes, overlap ratio — the
+        # reduce phase also surfaces in the phases block (it nests
+        # under the executor spans, so span_stats(max_depth=1) alone
+        # would never show it)
+        dist = _distributed_from_spans(obs)
+        if dist:
+            record["distributed"] = dist
+            record.setdefault("phases", {})[
+                "partitioned.fanin"
+            ] = dist["fanin_wall_s"]
         # resilience activity (retries, degradation rungs, checkpoint
         # saves/resumes, fired faults): read BEFORE the trace export so
         # an unwritable trace path cannot drop the recovery record of

@@ -80,6 +80,9 @@ TNC_TPU_PLATFORM=cpu python scripts/crash_resume_smoke.py
 echo "== serving smoke (concurrent queries vs oracle, plan-cache hit) =="
 TNC_TPU_PLATFORM=cpu python scripts/serve_smoke.py
 
+echo "== distributed smoke (2-process scatter -> overlapped fan-in -> gather, oracle bit-compare) =="
+python scripts/distributed_smoke.py
+
 echo "== fused-chain smoke (multi-step Pallas kernel, interpret mode: dispatch spans drop) =="
 TNC_TPU_PLATFORM=cpu python scripts/chain_smoke.py
 

@@ -21,7 +21,12 @@ failures, unless ``--strict``):
   (``kernel_buckets.buckets.<small|medium|stem>``) — effective-flop-
   credited MFU (or achieved FLOP/s) per bucket, so a regression in ONE
   kernel rung (a chain that stopped fusing, a Strassen step that fell
-  back) is localized even when the headline wall-clock hides it.
+  back) is localized even when the headline wall-clock hides it;
+- the distributed fan-in block (``distributed.fanin_wall_s`` /
+  ``distributed.dispatch_overlap_ratio``) — a reduce phase that got
+  slower, or a level schedule that collapsed back toward a serial
+  chain (overlap ratio dropped), is flagged even when the probe's
+  headline absorbs it.
 
 Exit codes: 0 pass, 1 regression, 2 unusable input (missing files,
 error records, mismatched metrics).
@@ -151,6 +156,23 @@ def compare(
         msgs.append(
             f"warning: calibrated throughput dropped "
             f"{bf / cf:.2f}x ({bf:.3g} -> {cf:.3g} FLOP/s)"
+        )
+
+    # distributed fan-in cross-check: reduce-phase wall time and the
+    # schedule's concurrency (pairs/levels) between records
+    bd, cd = base.get("distributed") or {}, cand.get("distributed") or {}
+    bw, cw = bd.get("fanin_wall_s"), cd.get("fanin_wall_s")
+    if bw and cw and float(bw) > 0 and float(cw) / float(bw) > 1.5:
+        msgs.append(
+            f"warning: distributed fan-in wall time regressed "
+            f"{float(cw) / float(bw):.2f}x ({float(bw):.4g}s -> "
+            f"{float(cw):.4g}s)"
+        )
+    bo, co = bd.get("dispatch_overlap_ratio"), cd.get("dispatch_overlap_ratio")
+    if bo and co and float(co) < float(bo) / 1.5:
+        msgs.append(
+            f"warning: fan-in dispatch-overlap ratio dropped "
+            f"{float(bo):.2f} -> {float(co):.2f} (schedule went serial?)"
         )
 
     # kernel-ladder per-bucket cross-check: effective-flop-credited MFU

@@ -1,0 +1,186 @@
+"""Worker for the pod-scale distributed tests: process-sharded
+partitioned contraction, multi-host sharded serving, and the shared
+plan cache, across real OS process boundaries.
+
+Run as: python _multihost_serve_worker.py <pid> <nprocs> <port> <cache_dir>
+
+Phases (every process walks the same collective sequence):
+
+A. **Sharded contraction** — process 0 plans the partitioned path,
+   ``broadcast_path`` ships it, ``distributed_partitioned_contraction``
+   runs process-sharded (local phase per host, cross-host fan-in over
+   the coordination-KV transport). Process 0 also runs the single-host
+   executor on its local devices and asserts the sharded result is
+   **bit-identical**.
+B. **Shared plan cache** — process 0 binds the serving circuit against
+   the shared cache directory (planning + publishing), then a barrier;
+   process 1 binds the same circuit and must get a planner-span-free
+   hit (zero ``plan.find_path`` spans on this replica, ≥1
+   ``serve.plan_cache.hit``).
+C. **Sharded serving** — process 0 runs a ``ContractionService`` with a
+   ``ClusterDispatcher``; process 1 parks in ``serve_cluster``. The
+   batched-bra shards must return amplitudes bit-identical to the
+   single-host oracle batch.
+D. **Slice-range sharding** — both processes bind an HBM-sliced
+   structure through the shared cache and run one collective
+   ``cluster_amplitudes_sliced``; process 0 checks the range-partial
+   sum against the full local slice loop (allclose — range partials
+   re-associate the accumulation by design).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ.setdefault("TNC_TPU_TRACE", "1")
+
+import jax
+
+pid, nprocs, port, cache_dir = (
+    int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+)
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
+jax.distributed.initialize(
+    f"127.0.0.1:{port}", num_processes=nprocs, process_id=pid
+)
+assert jax.process_count() == nprocs, jax.process_count()
+
+import numpy as np
+
+import tnc_tpu.obs as obs
+from tnc_tpu.builders.connectivity import ConnectivityLayout
+from tnc_tpu.builders.random_circuit import brickwork_circuit, random_circuit
+from tnc_tpu.contractionpath.contraction_path import ContractionPath
+from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+from tnc_tpu.parallel.partitioned import (
+    broadcast_object,
+    broadcast_path,
+    distributed_partitioned_contraction,
+)
+from tnc_tpu.serve import (
+    ClusterDispatcher,
+    ContractionService,
+    PlanCache,
+    bind_circuit,
+    cluster_amplitudes_sliced,
+    serve_cluster,
+)
+from tnc_tpu.tensornetwork.tensor import CompositeTensor
+from tnc_tpu.tensornetwork.partitioning import (
+    find_partitioning,
+    partition_tensor_network,
+)
+
+
+def find_path_spans() -> int:
+    return sum(
+        1 for r in obs.get_registry().span_records()
+        if r.name == "plan.find_path"
+    )
+
+
+# ---- phase A: process-sharded partitioned contraction ------------------
+rng = np.random.default_rng(17)
+tn = random_circuit(10, 5, 0.9, 0.8, rng, ConnectivityLayout.LINE)
+parts = find_partitioning(tn, 4)
+grouped = partition_tensor_network(CompositeTensor(list(tn.tensors)), parts)
+k = len(grouped)
+
+if pid == 0:
+    path = Greedy(OptMethod.GREEDY).find_path(grouped).replace_path()
+else:
+    path = ContractionPath.simple([])
+path = broadcast_path(path, root=0)
+assert len(path.nested) == k, "broadcast path incomplete"
+
+sharded = distributed_partitioned_contraction(
+    grouped, path, dtype="complex128", process_sharded=True
+)
+sharded_data = np.asarray(sharded.data.into_data())
+assert pid != 0 or find_path_spans() > 0  # planner ran on root only
+if pid == 0:
+    single = distributed_partitioned_contraction(
+        grouped, path, dtype="complex128",
+        devices=jax.local_devices(), process_sharded=False,
+    )
+    single_data = np.asarray(single.data.into_data())
+    assert np.array_equal(sharded_data, single_data), (
+        "process-sharded result is not bit-identical to single-host",
+        sharded_data, single_data,
+    )
+print(f"proc {pid}: SHARDED CONTRACTION OK", flush=True)
+
+# ---- phase B: shared plan cache (replica B = planner-free hit) ---------
+serve_circuit = lambda: brickwork_circuit(8, 4, np.random.default_rng(5))
+cache = PlanCache(cache_dir)
+
+if pid == 0:
+    bound = bind_circuit(serve_circuit(), plan_cache=cache)
+broadcast_object(None, root=0)  # barrier: replica A published its plan
+if pid != 0:
+    spans_before = find_path_spans()
+    bound = bind_circuit(serve_circuit(), plan_cache=cache)
+    assert find_path_spans() == spans_before, (
+        "replica B ran the planner despite replica A's published plan"
+    )
+    key = cache.key_for_network(bound.template.network, bound.target_size)
+    assert cache.hits(key) >= 1, (
+        "replica B did not register a plan-cache hit"
+    )
+print(f"proc {pid}: SHARED PLAN CACHE OK", flush=True)
+
+# ---- phase C: sharded serving (bit-identical to single-host oracle) ----
+bits = [
+    format(v, "08b") for v in
+    np.random.default_rng(23).integers(0, 256, size=24)
+]
+det = [bound.template.request_bits(b) for b in bits]
+oracle = bound.amplitudes_det(det)  # single-host full batch, local
+
+if pid == 0:
+    dispatcher = ClusterDispatcher()
+    svc = ContractionService(
+        bound, dispatcher=dispatcher, max_batch=8, max_wait_ms=20.0
+    )
+    svc.start()
+    futs = [svc.submit(b) for b in bits]
+    got = np.asarray([f.result(timeout=120) for f in futs])
+    svc.stop()
+    dispatcher.stop()
+    assert np.array_equal(got, oracle), (
+        "sharded serve amplitudes differ from the single-host oracle",
+        got, oracle,
+    )
+else:
+    served = serve_cluster(bound, plan_cache=cache)
+    assert served >= 1, "worker process served no batches"
+print(f"proc {pid}: SHARDED SERVING OK", flush=True)
+
+# ---- phase D: slice-range sharding on an HBM-sliced structure ----------
+sliced_circuit = lambda: brickwork_circuit(8, 6, np.random.default_rng(9))
+if pid == 0:
+    sbound = bind_circuit(sliced_circuit(), plan_cache=cache, target_size=64)
+broadcast_object(None, root=0)  # barrier: sliced plan published
+if pid != 0:
+    spans_before = find_path_spans()
+    sbound = bind_circuit(sliced_circuit(), plan_cache=cache, target_size=64)
+    assert find_path_spans() == spans_before, (
+        "replica B replanned the sliced structure"
+    )
+assert sbound.sliced is not None, "expected a sliced structure"
+
+sdet = [sbound.template.request_bits(b) for b in bits[:6]]
+parts_amps = cluster_amplitudes_sliced(sbound, sdet)
+if pid == 0:
+    sfull = sbound.amplitudes_det(sdet)
+    assert np.allclose(parts_amps, sfull, rtol=1e-12, atol=1e-14), (
+        "slice-range-sharded amplitudes drifted", parts_amps, sfull,
+    )
+print(f"proc {pid}: MULTIHOST SERVE OK", flush=True)

@@ -116,6 +116,21 @@ SURFACE = {
         "Communication",
         "DeviceTensorMapping",
         "distributed_partitioned_contraction",
+        "process_shard_map",
+        "plan_fanin_pairs",
+        "PartitionExecutionError",
+    ],
+    "tnc_tpu.serve": [
+        "ContractionService",
+        "PlanCache",
+        "BoundProgram",
+        "BackgroundReplanner",
+        "SharedCacheWatcher",
+        "ClusterDispatcher",
+        "cluster_amplitudes",
+        "cluster_amplitudes_sliced",
+        "serve_cluster",
+        "shard_ranges",
     ],
     "tnc_tpu.gates": [
         "Gate",

@@ -7,6 +7,8 @@ with a single-process oracle). Here the "ranks" are the 8 virtual CPU
 devices from ``conftest.py``.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -95,3 +97,212 @@ def test_distributed_rejects_unpartitioned_network():
     result = Greedy(OptMethod.GREEDY).find_path(tn)
     with pytest.raises(TypeError):
         distributed_partitioned_contraction(tn, result.replace_path())
+
+
+# ---------------------------------------------------------------------------
+# overlapped tree fan-in (level schedule + span pins)
+
+
+def test_fanin_levels_balanced_tree():
+    from tnc_tpu.contractionpath.communication_schemes import fanin_levels
+
+    balanced = [(0, 1), (2, 3), (4, 5), (6, 7), (0, 2), (4, 6), (0, 4)]
+    levels = fanin_levels(balanced)
+    assert [len(lvl) for lvl in levels] == [4, 2, 1]
+    # within a level, every index appears at most once (independence)
+    for lvl in levels:
+        seen = [i for pair in lvl for i in pair]
+        assert len(seen) == len(set(seen))
+    # flattening preserves the tree (same multiset of pairs)
+    assert sorted(p for lvl in levels for p in lvl) == sorted(balanced)
+
+
+def test_fanin_levels_sequential_chain_is_serial():
+    from tnc_tpu.contractionpath.communication_schemes import fanin_levels
+
+    chain = [(0, 1), (0, 2), (0, 3)]
+    assert fanin_levels(chain) == [[(0, 1)], [(0, 2)], [(0, 3)]]
+
+
+def _balanced_partitioned_network(k=8, qubits=16, depth=4, seed=5):
+    """k partitions with a hand-balanced fan-in tree (greedy toplevel
+    schedules are often chain-shaped, which would make the overlap pin
+    vacuous)."""
+    from tnc_tpu.contractionpath.contraction_path import ContractionPath
+
+    tn, grouped, path = _partitioned_network(
+        k=k, qubits=qubits, depth=depth, seed=seed
+    )
+    k = len(grouped)
+    assert k == 8, f"partitioner returned {k} blocks"
+    balanced = [(0, 1), (2, 3), (4, 5), (6, 7), (0, 2), (4, 6), (0, 4)]
+    return tn, grouped, ContractionPath(dict(path.nested), balanced)
+
+
+def test_overlapped_fanin_level_spans_and_oracle():
+    """Acceptance pin: on a ≥8-partition network, same-level pairs
+    dispatch inside ONE ``partitioned.fanin_level`` span each (no
+    per-pair host synchronization points), the level count is the tree
+    depth (3 < 7 pairs), every level span carries bytes/flops roofline
+    counters, and the result still matches the flat oracle."""
+    from tnc_tpu.obs.core import MetricsRegistry
+
+    tn, grouped, path = _balanced_partitioned_network()
+    flat = Greedy(OptMethod.GREEDY).find_path(tn).replace_path()
+    want = complex(contract_tensor_network(tn, flat).data.into_data())
+
+    import tnc_tpu.obs as obs
+
+    obs.configure(enabled=True, registry=MetricsRegistry())
+    try:
+        got_t = distributed_partitioned_contraction(
+            grouped, path, dtype="complex128"
+        )
+        recs = obs.get_registry().span_records()
+    finally:
+        obs.configure(enabled=False, registry=MetricsRegistry())
+
+    got = complex(np.asarray(got_t.data.into_data()).reshape(-1)[0])
+    assert got == pytest.approx(want, rel=1e-10, abs=1e-12)
+
+    fanin = [r for r in recs if r.name == "partitioned.fanin"]
+    levels = [r for r in recs if r.name == "partitioned.fanin_level"]
+    assert len(fanin) == 1
+    assert fanin[0].args["pairs"] == 7
+    assert fanin[0].args["levels"] == 3
+    # one span per LEVEL, not per pair: 4+2+1 pairs in 3 spans
+    assert [r.args["pairs"] for r in levels] == [4, 2, 1]
+    # reduce-phase roofline counters (trace_summarize --roofline input)
+    for r in levels:
+        assert r.args["flops"] > 0
+        assert r.args["bytes"] > 0
+    assert fanin[0].args["flops"] == pytest.approx(
+        sum(r.args["flops"] for r in levels)
+    )
+
+
+def test_reordered_levels_bit_identical_to_path_order():
+    """Level grouping may reorder independent pairs relative to the
+    communication path; the contraction tree is unchanged, so the
+    result must be bit-identical to the same path executed any other
+    way (the overlap is a schedule, not a numerics change)."""
+    from tnc_tpu.contractionpath.contraction_path import ContractionPath
+
+    tn, grouped, path = _partitioned_network(k=4, seed=19)
+    # interleave two independent chains: (0,1),(2,3) are level 0 but
+    # path-ordered with a dependent pair between them
+    toplevel = [(0, 1), (0, 2), (0, 3)]
+    p = ContractionPath(dict(path.nested), toplevel)
+    a = distributed_partitioned_contraction(grouped, p, dtype="complex128")
+    b = distributed_partitioned_contraction(grouped, p, dtype="complex128")
+    assert np.array_equal(
+        np.asarray(a.data.into_data()), np.asarray(b.data.into_data())
+    )
+
+
+def test_partition_error_names_process_device_and_phase():
+    from tnc_tpu.parallel.partitioned import PartitionExecutionError
+
+    err = PartitionExecutionError(3, 2, RuntimeError("boom"), phase="fanin")
+    assert err.partition == 3
+    assert err.device == 2
+    assert err.process == 0  # single-process run
+    assert err.phase == "fanin"
+    msg = str(err)
+    assert "partition 3" in msg
+    assert "device 2" in msg
+    assert "process 0" in msg
+    assert "fanin" in msg
+
+
+def test_local_phase_failure_names_process():
+    """A fault injected into one partition's local phase surfaces as a
+    PartitionExecutionError carrying partition, device, AND process."""
+    from tnc_tpu.parallel.partitioned import PartitionExecutionError
+    from tnc_tpu.resilience import faultinject as fi
+
+    tn, grouped, path = _partitioned_network(k=2, qubits=6, depth=3, seed=13)
+    with fi.faults("partition.local(partition=1)=fatal*1"):
+        with pytest.raises(PartitionExecutionError) as exc_info:
+            distributed_partitioned_contraction(
+                grouped, path, dtype="complex128"
+            )
+    assert exc_info.value.partition == 1
+    assert exc_info.value.process == 0
+    assert "process 0" in str(exc_info.value)
+
+
+def test_process_shard_map_pins_root_and_balances():
+    from tnc_tpu.parallel.partitioned import process_shard_map
+
+    owner = process_shard_map(4, [(3, 1), (3, 0), (3, 2)], 2)
+    assert owner[3] == 0  # survivor on process 0
+    assert sorted(owner) == [0, 0, 1, 1]  # near-equal shares
+    # degenerate single-process fleet: everything on process 0
+    assert process_shard_map(4, [(0, 1), (2, 3), (0, 2)], 1) == (0, 0, 0, 0)
+
+
+def test_process_sharded_single_process_bit_identical():
+    """process_sharded=True on a 1-process run walks the sharded code
+    path (owner map, level fan-in, final broadcast) and must be
+    bit-identical to the single-controller executor."""
+    tn, grouped, path = _partitioned_network(k=4, seed=29)
+    a = distributed_partitioned_contraction(grouped, path, dtype="complex128")
+    b = distributed_partitioned_contraction(
+        grouped, path, dtype="complex128", process_sharded=True
+    )
+    assert np.array_equal(
+        np.asarray(a.data.into_data()), np.asarray(b.data.into_data())
+    )
+
+
+def test_process_sharded_rejects_explicit_placement():
+    """The sharded executor places on each host's local devices itself —
+    an explicit devices/n_devices placement must raise (forced) or keep
+    the single-controller path (auto), never be silently ignored."""
+    import jax
+
+    tn, grouped, path = _partitioned_network(k=4, seed=29)
+    with pytest.raises(ValueError, match="devices"):
+        distributed_partitioned_contraction(
+            grouped, path, dtype="complex128", process_sharded=True,
+            devices=jax.devices(),
+        )
+    with pytest.raises(ValueError, match="devices"):
+        distributed_partitioned_contraction(
+            grouped, path, dtype="complex128", process_sharded=True,
+            n_devices=1,
+        )
+
+
+def test_gather_objects_single_process_identity():
+    from tnc_tpu.parallel.partitioned import gather_objects
+
+    assert gather_objects({"rows": [1, 2]}) == [{"rows": [1, 2]}]
+
+
+def test_mesh_sliced_strategy_psum_reduce():
+    """local_sliced_strategy='mesh': an HBM-budgeted partition's slice
+    partials reduce with an on-device psum over a sub-mesh instead of
+    the host chunked loop, and spare devices join the sub-mesh."""
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from _cluster_fixture import cluster_chain
+
+    from tnc_tpu.tensornetwork.partitioning import (
+        find_partitioning as _fp,
+        partition_tensor_network as _ptn,
+    )
+
+    ctn = cluster_chain(k=2, m=6, bond=2)
+    grouped = _ptn(CompositeTensor(list(ctn.tensors)), _fp(ctn, 2))
+    path = Greedy(OptMethod.GREEDY).find_path(grouped).replace_path()
+    flat = Greedy(OptMethod.GREEDY).find_path(ctn).replace_path()
+    want = complex(contract_tensor_network(ctn, flat).data.into_data())
+    out = distributed_partitioned_contraction(
+        grouped, path, dtype="complex128", hbm_bytes=1 << 17,
+        local_sliced_strategy="mesh",
+    )
+    got = complex(np.asarray(out.data.into_data()).reshape(-1)[0])
+    assert got == pytest.approx(want, rel=1e-5)

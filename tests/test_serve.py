@@ -1020,3 +1020,414 @@ class TestPlanCacheHits:
         assert cache.hot_keys() == []
         assert cache.hits("bad") == 0
 
+
+
+# ---------------------------------------------------------------------------
+# multi-host serving building blocks (single-process contracts; the
+# 2-process cluster pins live in tests/test_multihost_serve.py)
+
+
+class TestShardRanges:
+    def test_even_and_remainder(self):
+        from tnc_tpu.serve import shard_ranges
+
+        assert shard_ranges(8, 2) == [(0, 4), (4, 8)]
+        assert shard_ranges(7, 3) == [(0, 3), (3, 5), (5, 7)]
+
+    def test_covers_exactly_once(self):
+        from tnc_tpu.serve import shard_ranges
+
+        for n, p in [(0, 3), (1, 4), (5, 5), (13, 4), (16, 1)]:
+            ranges = shard_ranges(n, p)
+            assert len(ranges) == p
+            ids = [i for lo, hi in ranges for i in range(lo, hi)]
+            assert ids == list(range(n))
+
+    def test_empty_shards_are_legal(self):
+        from tnc_tpu.serve import shard_ranges
+
+        ranges = shard_ranges(2, 4)
+        assert ranges == [(0, 1), (1, 2), (2, 2), (2, 2)]
+
+
+class TestSliceRangeSharding:
+    def _sliced_bound(self, tmp_path):
+        from tnc_tpu.builders.random_circuit import brickwork_circuit
+
+        c = brickwork_circuit(8, 6, np.random.default_rng(9))
+        bound = bind_circuit(c, target_size=64)
+        assert bound.sliced is not None
+        return bound
+
+    def test_whole_range_bitwise_equals_full_loop(self, tmp_path):
+        bound = self._sliced_bound(tmp_path)
+        num = bound.sliced.slicing.num_slices
+        det = [bound.template.request_bits("10101010")]
+        full = bound.amplitudes_det(det)
+        whole = bound.amplitudes_det(det, slice_range=(0, num))
+        assert np.array_equal(full, whole)
+
+    def test_range_partials_sum_to_full(self, tmp_path):
+        from tnc_tpu.serve import shard_ranges
+
+        bound = self._sliced_bound(tmp_path)
+        num = bound.sliced.slicing.num_slices
+        det = [
+            bound.template.request_bits(b)
+            for b in ("00000000", "11111111", "01100110")
+        ]
+        full = bound.amplitudes_det(det)
+        acc = None
+        for lo, hi in shard_ranges(num, 2):
+            part = bound.amplitudes_det(det, slice_range=(lo, hi))
+            acc = part if acc is None else acc + part
+        assert np.allclose(acc, full, rtol=1e-12, atol=1e-14)
+
+    def test_slice_range_rejected_on_unsliced_bound(self):
+        bound = bind_circuit(make_circuit(seed=0))
+        det = [bound.template.request_bits("0" * 5)]
+        with pytest.raises(ValueError, match="slice_range"):
+            bound.amplitudes_det(det, slice_range=(0, 1))
+
+    def test_numpy_backend_range_is_contiguous_partial(self, tmp_path):
+        bound = self._sliced_bound(tmp_path)
+        backend = NumpyBackend()
+        arrays = list(bound.arrays)
+        full = backend.execute_sliced(bound.sliced, arrays)
+        num = bound.sliced.slicing.num_slices
+        a = backend.execute_sliced(bound.sliced, arrays, slice_range=(0, num))
+        assert np.array_equal(full, a)
+        with pytest.raises(ValueError, match="exclusive"):
+            backend.execute_sliced(
+                bound.sliced, arrays, max_slices=1, slice_range=(0, 1)
+            )
+
+    def test_jax_chunked_strategy_serves_range_partials(
+        self, tmp_path, enabled_obs
+    ):
+        """The chunked executor (the tuned TPU strategy) honors
+        ``slice_range`` — a range shard must not silently demote every
+        serving host to the loop program. Partials sum to the whole and
+        the chunked residual span proves which executor ran."""
+        bound = self._sliced_bound(tmp_path)
+        num = bound.sliced.slicing.num_slices
+        det = [bound.template.request_bits("10101010")]
+        backend = JaxBackend(sliced_strategy="chunked", donate=False)
+        full = np.asarray(bound.amplitudes_det(det, backend))
+        lo = np.asarray(
+            bound.amplitudes_det(det, backend, slice_range=(0, num // 2))
+        )
+        hi = np.asarray(
+            bound.amplitudes_det(det, backend, slice_range=(num // 2, num))
+        )
+        assert np.allclose(lo + hi, full, rtol=1e-5, atol=1e-8)
+        chunked_spans = [
+            r
+            for r in obs.get_registry().span_records()
+            if r.name == "sliced.residual"
+            and r.args.get("executor") == "chunked"
+        ]
+        assert chunked_spans, "range shards bypassed the chunked executor"
+
+    def test_concat_rows_empty_shard_keeps_dtype(self):
+        """Idle hosts of a fleet larger than the batch gather EMPTY
+        shards, and ``amplitudes_det([])`` hardcodes complex128 — the
+        root's concatenation must not upcast the filled rows' dtype."""
+        from tnc_tpu.serve.multihost import _concat_rows
+
+        rows = np.ones((3, 1), dtype=np.complex64)
+        empty = np.zeros((0, 1), dtype=np.complex128)
+        out = _concat_rows([rows, empty, empty])
+        assert out.dtype == np.complex64
+        assert np.array_equal(out, rows)
+        assert _concat_rows([empty, empty]).shape[0] == 0
+
+
+class TestClusterSingleProcess:
+    """Degenerate (1-process) contracts of the fleet entry points: they
+    must fall through to plain local execution bit-identically."""
+
+    def test_cluster_amplitudes_local(self):
+        from tnc_tpu.serve import cluster_amplitudes
+
+        bound = bind_circuit(make_circuit(seed=3))
+        det = [bound.template.request_bits("1" * 5)]
+        assert np.array_equal(
+            cluster_amplitudes(bound, det), bound.amplitudes_det(det)
+        )
+
+    def test_cluster_sliced_requires_sliced_bound(self):
+        from tnc_tpu.serve import cluster_amplitudes_sliced
+
+        bound = bind_circuit(make_circuit(seed=3))
+        det = [bound.template.request_bits("1" * 5)]
+        # single-process fall-through executes locally even unsliced
+        assert np.array_equal(
+            cluster_amplitudes_sliced(bound, det),
+            bound.amplitudes_det(det),
+        )
+
+    def test_dispatcher_mode_validation_and_stop(self):
+        from tnc_tpu.serve import ClusterDispatcher
+
+        with pytest.raises(ValueError):
+            ClusterDispatcher(mode="nope")
+        d = ClusterDispatcher()
+        bound = bind_circuit(make_circuit(seed=4))
+        det = [bound.template.request_bits("0" * 5)]
+        got = d(bound, det)
+        assert np.array_equal(got, bound.amplitudes_det(det))
+        d.stop()
+        d.stop()  # idempotent
+        with pytest.raises(RuntimeError, match="stopped"):
+            d(bound, det)
+
+    def test_shard_failure_named_and_raised(self):
+        """A failed shard gathers as a failure marker (lockstep — no
+        skipped collective) and the root's raise names the process."""
+        from tnc_tpu.serve.multihost import (
+            _raise_shard_failures,
+            _ShardFailure,
+        )
+
+        f = _ShardFailure(2, RuntimeError("boom"))
+        with pytest.raises(
+            RuntimeError, match=r"process 2: RuntimeError: boom"
+        ):
+            _raise_shard_failures([np.zeros(2), f])
+        _raise_shard_failures([np.zeros(2)])  # clean gather: no raise
+
+    def test_legacy_backend_without_slice_range_kw(self):
+        """A Backend subclass written before ``slice_range`` existed
+        keeps serving whole-range sliced requests — the kwarg is only
+        forwarded when a shard is actually requested."""
+        from tnc_tpu.builders.random_circuit import brickwork_circuit
+
+        class LegacyBackend(NumpyBackend):
+            def execute_sliced(
+                self, sp, arrays, max_slices=None, host=True, hoist=None
+            ):
+                return NumpyBackend.execute_sliced(
+                    self, sp, arrays, max_slices=max_slices, host=host,
+                    hoist=hoist,
+                )
+
+        bound = bind_circuit(
+            brickwork_circuit(8, 6, np.random.default_rng(9)),
+            target_size=64,
+        )
+        assert bound.sliced is not None
+        det = [bound.template.request_bits("10101010")]
+        got = bound.amplitudes_det(det, LegacyBackend())
+        assert np.array_equal(got, bound.amplitudes_det(det))
+
+    def test_service_uses_custom_dispatcher(self):
+        """The ContractionService dispatcher hook: batches flow through
+        the pluggable callable (the multi-host fan-out point) and the
+        results are oracle-exact."""
+        calls = []
+        bound = bind_circuit(make_circuit(seed=5))
+
+        def dispatcher(b, bits, backend):
+            calls.append(len(bits))
+            return b.amplitudes_det(bits, backend)
+
+        with ContractionService(
+            bound, dispatcher=dispatcher, max_batch=8, max_wait_ms=20.0
+        ) as svc:
+            bits = ["00000", "10101", "11111"]
+            futs = [svc.submit(b) for b in bits]
+            got = np.asarray([f.result(timeout=60) for f in futs])
+        want = bound.amplitudes_det(
+            [bound.template.request_bits(b) for b in bits]
+        )
+        assert np.array_equal(got, want)
+        assert sum(calls) == 3
+
+
+class TestSharedCacheWatcher:
+    def _service(self, tmp_path, **kw):
+        cache = PlanCache(tmp_path)
+        svc = ContractionService.from_circuit(
+            make_circuit(seed=7), plan_cache=cache, **kw
+        )
+        return svc, cache
+
+    def test_adopts_foreign_publish(self, tmp_path):
+        """Replica A's (simulated) replanner publish lands in replica
+        B's running service: the watcher notices the fingerprint
+        change, rebuilds through the cache-hit path, and stages the
+        swap — amplitudes stay oracle-exact across it."""
+        from tnc_tpu.contractionpath.paths.hyper import Hyperoptimizer
+        from tnc_tpu.serve import SharedCacheWatcher
+        from tnc_tpu.serve.rebind import plan_structure
+
+        svc, cache = self._service(tmp_path)
+        try:
+            bound = svc.bound
+            key = cache.key_for_network(
+                bound.template.network, bound.target_size
+            )
+            watcher = SharedCacheWatcher(svc, cache)
+            assert watcher.poll_once() is False  # nothing new yet
+
+            # replica A publishes an improved plan (different finder →
+            # different path with high probability; force a distinct
+            # program by replanning with a hyper search)
+            tn = bound.template.network
+            path, slicing, program, sliced, result = plan_structure(
+                tn, Hyperoptimizer(ntrials=2, polish_rounds=1)
+            )
+            plan = cache.record_for(
+                path, program, slicing=slicing, sliced_program=sliced,
+                finder="Hyperoptimizer",
+            )
+            cache.store(key, plan)
+
+            before = svc.bound
+            adopted = watcher.poll_once()
+            if program.signature_digest() == before.program.signature_digest():
+                # hyper found the same plan: the watcher must SKIP
+                assert adopted is False
+                assert watcher.stats["skips"] == 1
+            else:
+                assert adopted is True
+                assert watcher.stats["adopts"] == 1
+                # the staged bound adopts at the next batch boundary;
+                # both plans contract the same network, so the value
+                # agrees to accumulation rounding (a different path
+                # re-associates the float sums)
+                amp = svc.amplitude("00000", timeout_s=30)
+                oracle = before.amplitudes_det(
+                    [before.template.request_bits("00000")]
+                )[0]
+                assert amp == pytest.approx(oracle, rel=1e-10)
+                assert svc.stats()["counts"]["plan_swaps"] == 1
+        finally:
+            svc.stop()
+
+    def test_same_plan_republish_is_skipped(self, tmp_path):
+        from tnc_tpu.serve import SharedCacheWatcher
+
+        svc, cache = self._service(tmp_path)
+        try:
+            bound = svc.bound
+            key = cache.key_for_network(
+                bound.template.network, bound.target_size
+            )
+            watcher = SharedCacheWatcher(svc, cache)
+            # touch the entry with the SAME plan content but new bytes
+            plan = json.loads((tmp_path / f"{key}.json").read_text())
+            plan["created_at"] = plan["created_at"] + 1.0
+            cache.store(key, plan)
+            assert watcher.poll_once() is False
+            assert watcher.stats["skips"] == 1
+        finally:
+            svc.stop()
+
+    def test_failed_adoption_retried_next_poll(self, tmp_path, monkeypatch):
+        """A publish whose adoption fails (transient I/O on the shared
+        volume) is retried on the next poll — the fingerprint only
+        advances after the publish is fully handled."""
+        from tnc_tpu.serve import SharedCacheWatcher
+        from tnc_tpu.serve import replan as replan_mod
+
+        svc, cache = self._service(tmp_path)
+        try:
+            bound = svc.bound
+            key = cache.key_for_network(
+                bound.template.network, bound.target_size
+            )
+            watcher = SharedCacheWatcher(svc, cache)
+            plan = json.loads((tmp_path / f"{key}.json").read_text())
+            plan["created_at"] = plan["created_at"] + 1.0
+            cache.store(key, plan)
+
+            real = replan_mod.bind_template
+            monkeypatch.setattr(
+                replan_mod, "bind_template",
+                lambda *a, **k: (_ for _ in ()).throw(
+                    OSError("shared volume hiccup")
+                ),
+            )
+            with pytest.raises(OSError):
+                watcher.poll_once()
+            monkeypatch.setattr(replan_mod, "bind_template", real)
+            # _seen did NOT advance: the same publish is seen again and
+            # (being a same-plan re-publish) now deliberately skipped
+            assert watcher.poll_once() is False
+            assert watcher.stats["skips"] == 1
+        finally:
+            svc.stop()
+
+    def test_from_circuit_watch_lifecycle(self, tmp_path):
+        svc, cache = self._service(
+            tmp_path, shared_cache_watch=True,
+            watch_options={"poll_interval_s": 0.01},
+        )
+        assert len(svc._watchers) == 1
+        watcher = svc._watchers[0]
+        assert watcher._thread is not None
+        svc.stop()
+        assert watcher._thread is None  # stop() stopped the watcher
+
+    def test_watch_requires_cache(self):
+        with pytest.raises(ValueError, match="shared_cache_watch"):
+            ContractionService.from_circuit(
+                make_circuit(seed=7), shared_cache_watch=True
+            )
+
+
+class TestSharedStoreConcurrency:
+    def test_entry_fingerprint_tracks_content(self, tmp_path):
+        cache = PlanCache(tmp_path)
+        assert cache.entry_fingerprint("k") is None
+        cache.store("k", {"version": 1, "pairs": [[0, 1]]})
+        fp1 = cache.entry_fingerprint("k")
+        assert fp1
+        assert cache.entry_fingerprint("k") == fp1  # stable read
+        cache.store("k", {"version": 1, "pairs": [[1, 2]]})
+        assert cache.entry_fingerprint("k") != fp1
+
+    def test_concurrent_writers_never_interleave(self, tmp_path):
+        """N threads racing store() on one key (the replica-fleet
+        shape): every observed on-disk state must be one writer's
+        COMPLETE entry, never a byte mix."""
+        import threading
+
+        cache = PlanCache(tmp_path)
+        plans = [
+            {"version": 1, "pairs": [[i, i + 1]] * 50, "writer": i}
+            for i in range(8)
+        ]
+        stop = threading.Event()
+        bad: list = []
+
+        def reader():
+            while not stop.is_set():
+                plan = cache.load("k")
+                if plan is not None and plan["pairs"] != (
+                    [[plan["writer"], plan["writer"] + 1]] * 50
+                ):
+                    bad.append(plan)
+
+        threads = [threading.Thread(target=reader) for _ in range(2)]
+        for t in threads:
+            t.start()
+        writers = [
+            threading.Thread(
+                target=lambda p=p: [cache.store("k", p) for _ in range(20)]
+            )
+            for p in plans
+        ]
+        for w in writers:
+            w.start()
+        for w in writers:
+            w.join()
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not bad, f"interleaved reads observed: {bad[:1]}"
+        # no stranded temp files beyond the published entry
+        leftovers = list(tmp_path.glob("*.json.tmp"))
+        assert leftovers == []

@@ -68,6 +68,43 @@ def calibrated_latency_map(
     return out
 
 
+def fanin_levels(
+    toplevel: Sequence[tuple[int, int]],
+) -> list[list[tuple[int, int]]]:
+    """Group a replace-format fan-in path into dependency **levels**:
+    every pair within a level touches disjoint indices, so all of a
+    level's contractions are independent and may dispatch concurrently;
+    a pair lands one level past the deepest level either operand was
+    last produced in. This is the overlap schedule the pod executor
+    runs (``intermediate_reduce``): same-level pairs dispatch without
+    intervening host synchronization, levels execute in order.
+
+    The schedule is derived from the communication scheme's path, so a
+    latency-aware scheme (priced with the calibrated latency map) still
+    controls WHICH pairs exist and their tree shape — levels only make
+    the independence that was already in the tree explicit.
+
+    Disjointness within a level holds by construction: a pair at level
+    ``L`` bumps its surviving index ``x`` to depth ``L+1``, so any later
+    pair touching ``x`` is scheduled at ``L+1`` or deeper, and consumed
+    ``y`` indices never reappear (``_fanin_survivor`` validates that).
+
+    >>> fanin_levels([(0, 1), (2, 3), (0, 2)])
+    [[(0, 1), (2, 3)], [(0, 2)]]
+    >>> fanin_levels([(0, 1), (0, 2), (0, 3)])
+    [[(0, 1)], [(0, 2)], [(0, 3)]]
+    """
+    depth: dict[int, int] = {}
+    levels: list[list[tuple[int, int]]] = []
+    for x, y in toplevel:
+        level = max(depth.get(x, 0), depth.get(y, 0))
+        if level == len(levels):
+            levels.append([])
+        levels[level].append((x, y))
+        depth[x] = level + 1
+    return levels
+
+
 class CommunicationScheme(enum.Enum):
     GREEDY = "greedy"
     RANDOM_GREEDY = "random_greedy"

@@ -48,7 +48,13 @@ class Backend:
         max_slices: int | None = None,
         host: bool = True,
         hoist: bool | None = None,
+        slice_range: tuple[int, int] | None = None,
     ):
+        """``slice_range=(lo, hi)``: partial sum over that contiguous
+        slice shard only (the multi-host serving shape). Part of the
+        backend contract — subclasses must accept it (callers only pass
+        it when actually sharding, so a legacy subclass without the
+        parameter keeps working for whole-range execution)."""
         raise NotImplementedError
 
 
@@ -540,17 +546,20 @@ class NumpyBackend(Backend):
         max_slices: int | None = None,
         host: bool = True,
         hoist: bool | None = None,
+        slice_range: tuple[int, int] | None = None,
     ) -> np.ndarray:
         """``host=False`` mirrors the device backends' contract as far
         as it applies here (data is already host-resident): the result
         comes back in **stored** (merged) shape instead of
         ``result_shape``. ``hoist`` defaults to off — the naive loop
-        is the oracle the hoisted executors are tested against."""
+        is the oracle the hoisted executors are tested against.
+        ``slice_range=(lo, hi)`` sums only that contiguous slice shard
+        (the multi-host serving partial)."""
         from tnc_tpu.ops.sliced import execute_sliced_numpy
 
         out = execute_sliced_numpy(
             sp, arrays, dtype=self.dtype, max_slices=max_slices,
-            hoist=bool(hoist),
+            hoist=bool(hoist), slice_range=slice_range,
         )
         if not host:
             return out.reshape(sp.program.stored_result_shape)
@@ -709,6 +718,7 @@ class JaxBackend(Backend):
         max_slices: int | None = None,
         host: bool = True,
         hoist: bool | None = None,
+        slice_range: tuple[int, int] | None = None,
     ):
         """Run a sliced program; the slice loop executes on device.
         ``max_slices`` caps the loop (partial sum — benchmark subsets).
@@ -717,7 +727,12 @@ class JaxBackend(Backend):
         benchmark-timing contract (tunneled backends degrade dispatch
         permanently after the first D2H; see TPU_EVIDENCE_r03.md).
         ``hoist`` overrides the backend default (slice-invariant stem
-        executed once, residual looped — :mod:`tnc_tpu.ops.hoist`)."""
+        executed once, residual looped — :mod:`tnc_tpu.ops.hoist`).
+        ``slice_range=(lo, hi)`` sums only that contiguous slice shard
+        on device (the multi-host serving partial) — under the
+        backend's own sliced strategy: chunked runs the range through
+        the chunked executor, the loop strategies compile a range-bound
+        loop program."""
 
         from tnc_tpu.ops.sliced import make_jax_sliced_fn
 
@@ -726,6 +741,59 @@ class JaxBackend(Backend):
         obs.counter_add(
             "backend.execute_sliced_calls", strategy=self.sliced_strategy
         )
+        if slice_range is not None:
+            if max_slices is not None:
+                raise ValueError(
+                    "slice_range and max_slices are exclusive"
+                )
+            if self.sliced_strategy == "chunked" and sp.slicing.num_slices > 1:
+                # keep the fast path: on real TPUs the chunked executor
+                # is the tuned strategy (~150x per slice vs the loop
+                # program, docs/running_on_tpu.md) — a range shard must
+                # not silently demote every serving host to the loop
+                from tnc_tpu.ops.chunked import execute_sliced_batched_jax
+
+                return execute_sliced_batched_jax(
+                    sp,
+                    arrays,
+                    batch=self.slice_batch,
+                    chunk_steps=self.chunk_steps,
+                    split_complex=self.split_complex,
+                    precision=self.precision,
+                    dtype=self.dtype,
+                    device=self.device,
+                    host=host,
+                    hoist=hoist,
+                    slice_range=tuple(slice_range),
+                )
+            from tnc_tpu.ops.split_complex import complex_mult_key
+
+            key = (
+                "sliced_range", sp.signature(), str(self.dtype),
+                self.split_complex, tuple(slice_range), hoist,
+                lanemix_env(),
+                complex_mult_key() if self.split_complex else None,
+            )
+            fn = self._cache.get(key)
+            if fn is None:
+                fn = make_jax_sliced_fn(
+                    sp,
+                    split_complex=self.split_complex,
+                    precision=self.precision,
+                    hoist=hoist,
+                    slice_range=tuple(slice_range),
+                )
+                self._cache[key] = fn
+            result = fn(self._device_buffers(arrays))
+            if not host:
+                return result
+            if self.split_complex:
+                from tnc_tpu.ops.split_complex import combine_array
+
+                return combine_array(*result).reshape(
+                    sp.program.result_shape
+                )
+            return np.asarray(result).reshape(sp.program.result_shape)
         if sp.slicing.num_slices == 1:
             if not host:  # device-resident, stored shape — no D2H
                 return self.execute_on_device(sp.program, arrays)

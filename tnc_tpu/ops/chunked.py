@@ -412,6 +412,7 @@ def execute_sliced_batched_jax(
     host: bool = True,
     hoist: bool = False,
     ckpt: str | None = None,
+    slice_range: tuple[int, int] | None = None,
 ):
     """Run a sliced program as chunked, slice-batched jitted calls.
 
@@ -431,6 +432,12 @@ def execute_sliced_batched_jax(
     ``ckpt`` (or ``TNC_TPU_CKPT``) arms slice-range checkpointing:
     the accumulator + cursor persist periodically and a restarted run
     resumes bit-identically (:mod:`tnc_tpu.resilience.checkpoint`).
+
+    ``slice_range=(lo, hi)``: partial sum over slice ids ``[lo, hi)``
+    only — the multi-host serving shard shape. Mutually exclusive with
+    ``max_slices`` and explicit ``ckpt`` (a range partial is already
+    someone else's resume unit; an env-armed ``TNC_TPU_CKPT`` is
+    ignored for range runs for the same reason).
     """
     if sp.slicing.num_slices <= 1:
         raise ValueError(
@@ -460,6 +467,7 @@ def execute_sliced_batched_jax(
         hoist=hoist,
         ckpt=ckpt,
         ckpt_data_digest=data_digest,
+        slice_range=slice_range,
     )
     if not host:
         return acc
@@ -484,6 +492,7 @@ def run_sliced_chunked_placed(
     hoist: bool = False,
     ckpt: str | None = None,
     ckpt_data_digest: str | None = None,
+    slice_range: tuple[int, int] | None = None,
 ):
     """Chunked slice-batched execution over already-placed device
     buffers; returns the device-resident accumulator in stored shape
@@ -532,6 +541,7 @@ def run_sliced_chunked_placed(
                 hoist=False,
                 ckpt=ckpt,
                 ckpt_data_digest=ckpt_data_digest,
+                slice_range=slice_range,
             )
 
     num = sp.slicing.num_slices
@@ -553,20 +563,30 @@ def run_sliced_chunked_placed(
             split_complex=split_complex,
             dtype_bytes=8 if "128" in str(dtype) else 4,
         )
-    if max_slices is not None:
+    lo = 0
+    if slice_range is not None:
+        if max_slices is not None or ckpt is not None:
+            raise ValueError(
+                "slice_range is mutually exclusive with max_slices/ckpt"
+            )
+        lo = max(0, int(slice_range[0]))
+        num = min(int(slice_range[1]), num)
+        lo = min(lo, num)
+    elif max_slices is not None:
         num = max(1, min(num, max_slices))
-    batch = max(1, min(batch, num))
-    while num % batch:  # largest divisor <= requested (dims are tiny)
+    span = max(num - lo, 1)
+    batch = max(1, min(batch, span))
+    while span % batch:  # largest divisor <= requested (dims are tiny)
         batch -= 1
 
     # slice-range checkpointing (TNC_TPU_CKPT / ckpt=): load cursor +
     # accumulator before compiling; the signature covers everything that
     # changes the accumulation sequence except the batch (the cursor is a
     # slice index, valid at any batch alignment)
-    ckpt_path = _ckpt.resolve_ckpt(ckpt)
+    ckpt_path = _ckpt.resolve_ckpt(ckpt) if slice_range is None else None
     mgr = None
     resumed = None
-    start0 = 0
+    start0 = lo
     if ckpt_path is not None:
         # str(device) disambiguates the distributed local phase: two
         # structurally identical partitions share a program signature but
@@ -624,7 +644,7 @@ def run_sliced_chunked_placed(
         # zero-step program: the result is the (sliced) leaf itself —
         # sum its first `num` slices in one dispatch
         info = sp.slot_slices[sp.program.result_slot]
-        idx_all = place(all_indices)
+        idx_all = place(all_indices[lo:num])
 
         def leaf_sum(buf, idx):
             rows = jax.vmap(lambda i: index_buffer(jnp, buf, info, i))(idx)

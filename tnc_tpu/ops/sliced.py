@@ -170,6 +170,7 @@ def execute_sliced_numpy(
     hoist: bool = False,
     ckpt: str | None = None,
     step_spans: bool | None = None,
+    slice_range: tuple[int, int] | None = None,
 ) -> np.ndarray:
     """CPU oracle: python loop over slices, sum of program results.
 
@@ -189,6 +190,12 @@ def execute_sliced_numpy(
     a published baseline pass ``False`` so span bookkeeping never sits
     inside their timed region (``bench.py`` takes its calibration
     sample from a separate untimed pass).
+
+    ``slice_range=(lo, hi)``: partial sum over slice ids ``[lo, hi)``
+    only — the multi-host serving shard shape (each host covers a
+    contiguous range; the root sums the range partials in range order).
+    Mutually exclusive with ``max_slices`` and checkpointing (a range
+    partial is already someone else's resume unit).
     """
     from tnc_tpu.resilience import checkpoint as _ckpt
     from tnc_tpu.resilience import faultinject as _faults
@@ -214,6 +221,23 @@ def execute_sliced_numpy(
     num = sp.slicing.num_slices
     if max_slices is not None:
         num = min(num, max_slices)
+    if slice_range is not None:
+        if max_slices is not None or ckpt is not None:
+            raise ValueError(
+                "slice_range is mutually exclusive with max_slices/ckpt"
+            )
+        lo, hi = slice_range
+        lo = max(0, int(lo))
+        hi = min(int(hi), sp.slicing.num_slices)
+        with obs.span("sliced.range", lo=lo, hi=hi):
+            for s in range(lo, hi):
+                indices = _slice_indices(sp.slicing, s)
+                buffers = [
+                    index_buffer(np, arr, info, indices)
+                    for arr, info in zip(full, sp.slot_slices)
+                ]
+                acc = acc + _run_steps(np, sp.program, buffers)
+        return acc.reshape(sp.program.result_shape)
     ckpt_path = _ckpt.resolve_ckpt(ckpt)
     mgr = None
     start = 0
@@ -380,6 +404,7 @@ def make_jax_sliced_fn(
     num_slices: int | None = None,
     unroll: int = 1,
     hoist: bool = False,
+    slice_range: tuple[int, int] | None = None,
 ):
     """Build a jittable ``fn(full_buffers) -> result`` running the whole
     slice loop on device. In split mode, buffers and result are
@@ -412,10 +437,17 @@ def make_jax_sliced_fn(
     loop_sp = hp.residual if hp is not None else sp
 
     dims = sp.slicing.dims
+    lo = 0
     num = sp.slicing.num_slices
-    if num_slices is not None:
+    if slice_range is not None:
+        # contiguous shard [lo, hi) — the multi-host serving shape
+        if num_slices is not None:
+            raise ValueError("slice_range and num_slices are exclusive")
+        lo = max(0, int(slice_range[0]))
+        num = min(int(slice_range[1]), num)
+    elif num_slices is not None:
         num = max(1, min(num, num_slices))
-    unroll = max(1, min(unroll, num))
+    unroll = max(1, min(unroll, max(num - lo, 1)))
 
     def decompose(s):
         idx = []
@@ -506,7 +538,7 @@ def make_jax_sliced_fn(
             def body(s, acc):
                 return add(acc, one_slice(loop_buffers, s))
 
-            return finish(lax.fori_loop(0, num, body, zeros(full_buffers)))
+            return finish(lax.fori_loop(lo, num, body, zeros(full_buffers)))
 
     else:
 
@@ -517,7 +549,7 @@ def make_jax_sliced_fn(
                 return add(acc, one_slice(loop_buffers, s)), None
 
             acc, _ = lax.scan(
-                body, zeros(full_buffers), jnp.arange(num), unroll=unroll
+                body, zeros(full_buffers), jnp.arange(lo, num), unroll=unroll
             )
             return finish(acc)
 
@@ -526,8 +558,8 @@ def make_jax_sliced_fn(
     # prelude + loop live inside ONE jitted dispatch here, so a single
     # span covers both; its flop counter is the hoisted total (prelude
     # once + residual per slice)
-    total_flops = num * steps_flops(loop_sp.program.steps)
-    total_elem_bytes = num * steps_bytes(loop_sp.program.steps, 1.0)
+    total_flops = (num - lo) * steps_flops(loop_sp.program.steps)
+    total_elem_bytes = (num - lo) * steps_bytes(loop_sp.program.steps, 1.0)
     if hp is not None:
         pre = [ps.step for ps in hp.prelude_steps]
         total_flops += steps_flops(pre)

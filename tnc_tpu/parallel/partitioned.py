@@ -34,6 +34,7 @@ DCN. There is no rank-local control flow to port.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 from dataclasses import dataclass
 from typing import Any, Sequence
@@ -56,19 +57,44 @@ from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
 from tnc_tpu.tensornetwork.tensordata import TensorData
 
 
-class PartitionExecutionError(RuntimeError):
-    """A partition's local contraction failed; names the partition index
-    and device slot so a pool-surfaced XLA error is attributable
-    (``pool.map`` otherwise raises a bare runtime error with no hint of
-    which partition died). Chains the original (``__cause__``)."""
+def _process_index() -> int:
+    """This host's jax process index (0 when jax is not initialized —
+    error paths must not fail while naming a failure)."""
+    try:
+        import jax
 
-    def __init__(self, partition: int, device: int, original: BaseException):
+        return int(jax.process_index())
+    except Exception:  # noqa: BLE001 — never raise from error naming
+        return 0
+
+
+class PartitionExecutionError(RuntimeError):
+    """A partition's scatter, local contraction, or fan-in step failed;
+    names the partition index, device slot, and **host process** so a
+    pool-surfaced XLA error in a multi-host incident log is attributable
+    to a machine (``pool.map`` otherwise raises a bare runtime error
+    with no hint of which partition — let alone which host — died).
+    Chains the original (``__cause__``)."""
+
+    def __init__(
+        self,
+        partition: int,
+        device: int,
+        original: BaseException,
+        process: int | None = None,
+        phase: str = "local",
+    ):
+        if process is None:
+            process = _process_index()
         super().__init__(
-            f"partition {partition} on device {device} failed: "
+            f"partition {partition} on device {device} "
+            f"(process {process}, {phase} phase) failed: "
             f"{type(original).__name__}: {original}"
         )
         self.partition = partition
         self.device = device
+        self.process = process
+        self.phase = phase
         self.original = original
 
 def partition_latency_map(
@@ -326,28 +352,35 @@ def scatter_partitions(
     buffers: list[list[Any]] = []
     with obs.span("partitioned.scatter", partitions=k):
         for i, child in enumerate(children):
-            sp = None
-            if hbm_bytes is not None:
-                sp = _slice_partition(
-                    child, contract_path.nested[i], hbm_bytes
+            try:
+                sp = None
+                if hbm_bytes is not None:
+                    sp = _slice_partition(
+                        child, contract_path.nested[i], hbm_bytes
+                    )
+                if sp is not None:
+                    programs.append(sp)
+                    program = sp.program
+                else:
+                    program = build_program(child, contract_path.nested[i])
+                    programs.append(program)
+                metas.append(
+                    LeafTensor(
+                        list(program.result_legs), list(program.result_shape)
+                    )
                 )
-            if sp is not None:
-                programs.append(sp)
-                program = sp.program
-            else:
-                program = build_program(child, contract_path.nested[i])
-                programs.append(program)
-            metas.append(
-                LeafTensor(
-                    list(program.result_legs), list(program.result_shape)
+                buffers.append(
+                    place_buffers(
+                        _leaf_arrays(child), dtype, split_complex,
+                        devices[mapping.device(i)],
+                    )
                 )
-            )
-            buffers.append(
-                place_buffers(
-                    _leaf_arrays(child), dtype, split_complex,
-                    devices[mapping.device(i)],
-                )
-            )
+            except (ValueError, TypeError):
+                raise  # caller contract errors keep their type
+            except Exception as exc:  # noqa: BLE001 — name the failure site
+                raise PartitionExecutionError(
+                    i, mapping.device(i), exc, phase="scatter"
+                ) from exc
             # mirror of "Scattering tensor network" (communication.rs:132)
             logger.debug(
                 "scatter: partition %d -> device %d (%d tensors, %d steps%s)",
@@ -393,18 +426,82 @@ def local_contract_partitions(
     partition programs would otherwise compile back-to-back on the main
     thread (XLA compilation releases the GIL), serializing exactly the
     phase that should overlap. Warm runs take the sequential fast path.
+
+    ``sliced_strategy="mesh"``: a locally sliced partition's slice
+    partial sums reduce with an **on-device collective** (``psum`` over
+    a sub-mesh axis) instead of the chunked executor's host
+    accumulation loop — partials stay device-resident end to end, and
+    devices beyond the partition count (``comm.devices[k:]``) are
+    farmed out to the sliced partitions, each of which runs its slice
+    range SPMD over its sub-mesh (``tnc_tpu.parallel.sliced_parallel``
+    machinery; the sub-mesh shrinks to the largest size dividing the
+    partition's slice count).
     """
-    if sliced_strategy not in ("chunked", "loop"):
+    if sliced_strategy not in ("chunked", "loop", "mesh"):
         raise ValueError(
             f"unknown sliced_strategy {sliced_strategy!r}; "
-            "expected 'chunked' or 'loop'"
+            "expected 'chunked', 'loop', or 'mesh'"
         )
     logger.debug("local phase: %d partition programs", len(comm.programs))
     from tnc_tpu.ops.chunked import run_sliced_chunked_placed
     from tnc_tpu.ops.sliced import SlicedProgram, make_jax_sliced_fn
 
+    # mesh strategy: hand the spare devices (slots beyond the partition
+    # count) to the sliced partitions, round-robin
+    spare_of: dict[int, list] = {}
+    if sliced_strategy == "mesh":
+        k = len(comm.programs)
+        sliced_parts = [
+            i for i, p in enumerate(comm.programs)
+            if isinstance(p, SlicedProgram)
+        ]
+        spare_of = {i: [] for i in sliced_parts}
+        for j, dev in enumerate(comm.devices[k:]):
+            if sliced_parts:
+                spare_of[sliced_parts[j % len(sliced_parts)]].append(dev)
+
+    def _mesh_fn(i, program):
+        import numpy as _np
+        from jax.sharding import Mesh
+
+        from tnc_tpu.parallel.sliced_parallel import _spmd_fn_cached
+
+        own = comm.devices[comm.mapping.device(i)]
+        sub = [own] + spare_of.get(i, [])
+        n = len(sub)
+        while program.slicing.num_slices % n:
+            n -= 1
+        submesh = Mesh(_np.asarray(sub[:n]), ("slices",))
+        fn = _spmd_fn_cached(
+            program, submesh, "slices", dtype, split_complex, precision,
+            1, max_slices, hoist,
+        )
+
+        def run(bufs, _fn=fn, _own=own):
+            import jax
+
+            # the SPMD fn replicates its inputs over the sub-mesh
+            # itself; feed host copies so single-device-committed
+            # buffers never fight the mesh sharding
+            host = [
+                (np.asarray(b[0]), np.asarray(b[1]))
+                if isinstance(b, tuple)
+                else np.asarray(b)
+                for b in bufs
+            ]
+            out = _fn(*host)
+            # psum leaves the (replicated) sum on the sub-mesh; the
+            # fan-in contracts single-device buffers, so land the
+            # partition's copy back on its own device (free when the
+            # sub-mesh is just that device)
+            return jax.device_put(out, _own)
+
+        return run
+
     def compile_one(i, program):
         if isinstance(program, SlicedProgram):
+            if sliced_strategy == "mesh":
+                return _mesh_fn(i, program)
             if sliced_strategy == "chunked":
                 dev = comm.devices[comm.mapping.device(i)]
 
@@ -474,39 +571,385 @@ def local_contract_partitions(
         return [run_job(i, fn, bufs) for i, fn, bufs in jobs]
 
 
+def _buffer_nbytes(buf: Any) -> float:
+    """Bytes a held fan-in buffer occupies on device (a (real, imag)
+    pair in split mode; best-effort 0.0 when the array type hides it)."""
+    try:
+        if isinstance(buf, tuple):
+            return float(sum(_buffer_nbytes(p) for p in buf))
+        return float(buf.size) * float(buf.dtype.itemsize)
+    except Exception:  # noqa: BLE001 — accounting must never fail a run
+        return 0.0
+
+
+def plan_fanin_pairs(
+    metas: Sequence[LeafTensor], toplevel: Sequence[tuple[int, int]]
+) -> tuple[list[ContractionProgram], list[LeafTensor], list[float], LeafTensor]:
+    """Precompute the whole fan-in schedule's pair programs: for each
+    pair ``(x, y)`` of the communication path, its 2-tensor program, the
+    meta of the tensor **moved** (y's, the ICI/DCN payload), and the
+    pair's flop count. Returns ``(programs, moved_metas, flops,
+    final_meta)``. Hoisting this out of the reduce loop keeps the
+    per-level hot path free of planning work — a level's dispatches go
+    back-to-back with no host-side program construction between them."""
+    from tnc_tpu.ops.program import step_flops
+
+    pair_meta = list(metas)
+    programs: list[ContractionProgram] = []
+    moved: list[LeafTensor] = []
+    flops: list[float] = []
+    for x, y in toplevel:
+        program, result_meta = _pair_program(pair_meta[x], pair_meta[y])
+        programs.append(program)
+        moved.append(pair_meta[y])
+        flops.append(float(step_flops(program.steps[0])))
+        pair_meta[x] = result_meta
+    root = _fanin_survivor(len(metas), toplevel) if toplevel else 0
+    return programs, moved, flops, pair_meta[root]
+
+
 def intermediate_reduce(
     comm: Communication,
     toplevel: Sequence[tuple[int, int]],
     results: list[Any],
     split_complex: bool,
     precision,
+    levels: Sequence[Sequence[tuple[int, int]]] | None = None,
 ) -> tuple[Any, LeafTensor]:
-    """Pairwise fan-in following the communication path
+    """Overlapped tree fan-in following the communication path
     (``intermediate_reduce_tensor_network``, ``communication.rs:199-249``):
     for ``(x, y)``, move y's tensor onto x's device and contract there.
+
+    The path is grouped into dependency **levels**
+    (:func:`~tnc_tpu.contractionpath.communication_schemes.fanin_levels`
+    — derived from the communication scheme's own pair order, so a
+    latency-aware schedule priced with the calibrated latency map keeps
+    its tree shape). All pairs of a level are independent by
+    construction and dispatch back-to-back with **no intervening host
+    synchronization** — jax dispatch is asynchronous, so a level's
+    device-to-device moves and pair contractions all run concurrently;
+    partials stay device-resident between levels (nothing returns to
+    the host until the survivor is fetched by the caller). One
+    ``partitioned.fanin_level`` span per level records the pair count,
+    bytes moved over the interconnect, and pair flops — the reduce
+    phase's roofline input (``trace_summarize.py --roofline``).
     """
     import jax
 
     metas = list(comm.results_meta)
     held: list[Any] = list(results)
-    with obs.span("partitioned.fanin", pairs=len(toplevel)):
-        for x, y in toplevel:
-            target = comm.devices[comm.mapping.device(x)]
-            logger.debug(
-                "fan-in: partition %d (device %d) <- partition %d (device %d)",
-                x,
-                comm.mapping.device(x),
-                y,
-                comm.mapping.device(y),
+    if levels is None:
+        from tnc_tpu.contractionpath.communication_schemes import fanin_levels
+
+        levels = fanin_levels(toplevel)
+    # program bookkeeping in FLATTENED level order (a caller-supplied
+    # level schedule may reorder independent pairs relative to the
+    # path; the tree — which tensors meet — is unchanged either way)
+    flat = [pair for level in levels for pair in level]
+    programs, moved_metas, pair_flops, final_meta = plan_fanin_pairs(
+        metas, flat
+    )
+    proc = _process_index()
+    with obs.span(
+        "partitioned.fanin", pairs=len(flat), levels=len(levels)
+    ) as fanin_sp:
+        total_bytes = 0.0
+        total_flops = 0.0
+        pi = 0
+        for li, level in enumerate(levels):
+            with obs.span(
+                "partitioned.fanin_level", level=li, pairs=len(level)
+            ) as level_sp:
+                level_bytes = 0.0
+                level_flops = 0.0
+                for x, y in level:
+                    dev = comm.mapping.device(x)
+                    target = comm.devices[dev]
+                    logger.debug(
+                        "fan-in L%d: partition %d (device %d) <- "
+                        "partition %d (device %d)",
+                        li, x, dev, y, comm.mapping.device(y),
+                    )
+                    try:
+                        # async: device_put and the pair dispatch both
+                        # return immediately; the level's pairs overlap
+                        # on their devices while the host loops on
+                        moved = jax.device_put(held[y], target)
+                        fn = jit_program(programs[pi], split_complex, precision)
+                        out = fn([held[x], moved])
+                    except Exception as exc:  # noqa: BLE001 — name the site
+                        raise PartitionExecutionError(
+                            x, dev, exc, process=proc, phase="fanin"
+                        ) from exc
+                    level_bytes += _buffer_nbytes(held[y])
+                    level_flops += pair_flops[pi]
+                    held[x] = out
+                    held[y] = None
+                    pi += 1
+                if obs.enabled():
+                    level_sp.add(bytes=level_bytes, flops=level_flops)
+                total_bytes += level_bytes
+                total_flops += level_flops
+        if obs.enabled():
+            fanin_sp.add(bytes=total_bytes, flops=total_flops)
+    root = _fanin_survivor(len(held), flat) if flat else 0
+    return held[root], final_meta if flat else comm.results_meta[root]
+
+
+def process_shard_map(
+    k: int, toplevel: Sequence[tuple[int, int]], n_procs: int
+) -> tuple[int, ...]:
+    """Partition index → owning host process for the process-sharded
+    executor. The fan-in survivor is pinned to process 0 (the reference's
+    rank-0 contract); the rest round-robin across processes so every
+    host carries a near-equal share of the local phase.
+
+    >>> process_shard_map(4, [(0, 1), (2, 3), (0, 2)], 2)
+    (0, 1, 0, 1)
+    """
+    root = _fanin_survivor(k, toplevel) if toplevel else 0
+    n_procs = max(int(n_procs), 1)
+    owner = [0] * k
+    for j, part in enumerate(i for i in range(k) if i != root):
+        owner[part] = (j + 1) % n_procs
+    return tuple(owner)
+
+
+def _fetch_host(buf: Any):
+    """Device buffer → host numpy payload for the KV transport (a
+    (real, imag) numpy pair in split mode)."""
+    if isinstance(buf, tuple):
+        return tuple(np.asarray(p) for p in buf)
+    return np.asarray(buf)
+
+
+def _process_sharded_contraction(
+    tn: CompositeTensor,
+    contract_path: ContractionPath,
+    dtype: str,
+    split_complex: bool | None,
+    precision,
+    hbm_bytes: int | None,
+    local_sliced_strategy: str,
+    slice_batch: int,
+    chunk_steps: int,
+    hoist: bool,
+) -> LeafTensor:
+    """Multi-host partitioned contraction under
+    ``jax.distributed.initialize``: partitions shard across processes
+    (:func:`process_shard_map`), each host scatters its partitions onto
+    its **local** devices and contracts them concurrently, and the
+    fan-in walks the level schedule in process-spanning order — a pair
+    whose operands live on one host reduces device-to-device there; a
+    cross-host pair ships y's tensor over the coordination-KV
+    :func:`broadcast_object` transport (the channel PR 7 hardened
+    against the silent-zeros gloo collective) to x's owner, which
+    contracts on device. Every process walks the same schedule, so the
+    collectives stay in lockstep by construction; the final tensor is
+    broadcast from the survivor's owner (process 0) to all processes,
+    and the result is **bit-identical** to the single-host executor
+    (same pair programs, same per-pair arithmetic, byte-exact
+    transport).
+    """
+    import jax
+
+    n_procs = jax.process_count()
+    me = jax.process_index()
+    local_devices = jax.local_devices()
+    if split_complex is None:
+        split_complex = local_devices[0].platform != "cpu"
+
+    children = list(tn.tensors)
+    k = len(children)
+    for i, child in enumerate(children):
+        if not isinstance(child, CompositeTensor):
+            raise TypeError(f"top-level child {i} is not a partition composite")
+        if i not in contract_path.nested:
+            raise ValueError(f"partition {i} has no nested contraction path")
+    owner = process_shard_map(k, contract_path.toplevel, n_procs)
+    mine = [i for i in range(k) if owner[i] == me]
+
+    # every process derives ALL partition programs host-side (cheap, no
+    # communication): pair programs and result metas must agree
+    # everywhere for the schedule to stay in lockstep
+    programs: list[Any] = []
+    metas: list[LeafTensor] = []
+    with obs.span(
+        "partitioned.scatter", partitions=len(mine), process=me
+    ):
+        for i, child in enumerate(children):
+            sp = None
+            if hbm_bytes is not None:
+                sp = _slice_partition(child, contract_path.nested[i], hbm_bytes)
+            if sp is not None:
+                programs.append(sp)
+                program = sp.program
+            else:
+                program = build_program(child, contract_path.nested[i])
+                programs.append(program)
+            metas.append(
+                LeafTensor(
+                    list(program.result_legs), list(program.result_shape)
+                )
             )
-            moved = jax.device_put(held[y], target)  # device-to-device (ICI)
-            program, result_meta = _pair_program(metas[x], metas[y])
-            fn = jit_program(program, split_complex, precision)
-            held[x] = fn([held[x], moved])
-            held[y] = None
-            metas[x] = result_meta
-    root = _fanin_survivor(len(held), toplevel) if toplevel else 0
-    return held[root], metas[root]
+        # buffers land only on the owner's local devices
+        dev_slot = {
+            part: idx % len(local_devices) for idx, part in enumerate(mine)
+        }
+        buffers = {}
+        for i in mine:
+            try:
+                buffers[i] = place_buffers(
+                    _leaf_arrays(children[i]), dtype, split_complex,
+                    local_devices[dev_slot[i]],
+                )
+            except Exception as exc:  # noqa: BLE001 — name the site
+                raise PartitionExecutionError(
+                    i, dev_slot[i], exc, process=me, phase="scatter"
+                ) from exc
+
+    # local phase: this host's partitions only, overlapped via the
+    # shared thread-pool dispatch path
+    sub = Communication(
+        DeviceTensorMapping(tuple(dev_slot[i] for i in mine)),
+        list(local_devices),
+        [programs[i] for i in mine],
+        [metas[i] for i in mine],
+    )
+    try:
+        results = local_contract_partitions(
+            sub,
+            [buffers[i] for i in mine],
+            split_complex,
+            precision,
+            sliced_strategy=local_sliced_strategy,
+            dtype=dtype,
+            slice_batch=slice_batch,
+            chunk_steps=chunk_steps,
+            hoist=hoist,
+        )
+    except PartitionExecutionError as exc:
+        # remap the sub-communication's local index to the global
+        # partition id so multi-host incident logs name the real site
+        raise PartitionExecutionError(
+            mine[exc.partition], exc.device, exc.original,
+            process=me, phase=exc.phase,
+        ) from exc.original
+    held: dict[int, Any] = dict(zip(mine, results))
+
+    from tnc_tpu.contractionpath.communication_schemes import fanin_levels
+
+    levels = fanin_levels(contract_path.toplevel)
+    flat = [pair for level in levels for pair in level]
+    pair_programs, moved_metas, pair_flops, final_meta = plan_fanin_pairs(
+        metas, flat
+    )
+    item_bytes = float(np.dtype(dtype).itemsize)
+    # one p2p namespace per fan-in: cross-host pairs move point-to-point
+    # (sender publishes, x's owner reads; uninvolved hosts skip the
+    # transfer entirely) instead of an all-process broadcast per pair.
+    # Every process reserves it — counter alignment — even if no pair
+    # of the schedule crosses hosts.
+    p2p_seq = p2p_sequence()
+    pi = 0
+    with obs.span(
+        "partitioned.fanin",
+        pairs=len(flat), levels=len(levels), process=me,
+    ) as fanin_sp:
+        total_bytes = 0.0
+        total_flops = 0.0
+        cross = 0
+        for li, level in enumerate(levels):
+            with obs.span(
+                "partitioned.fanin_level",
+                level=li, pairs=len(level), process=me,
+            ) as level_sp:
+                level_bytes = 0.0
+                level_flops = 0.0
+                for x, y in level:
+                    ox, oy = owner[x], owner[y]
+                    moved = None
+                    # every moved pair counts the payload (same meta
+                    # bytes whether it rides ICI on one host or DCN
+                    # across hosts) — keeps interconnect_bytes
+                    # comparable with the single-host executor's
+                    pair_bytes = (
+                        float(np.prod(moved_metas[pi].bond_dims))
+                        * item_bytes
+                    )
+                    if ox == oy:
+                        if ox == me:
+                            target = local_devices[dev_slot[x]]
+                            moved = jax.device_put(held.pop(y), target)
+                        level_bytes += pair_bytes
+                    else:
+                        # cross-host pair: y's owner publishes, x's
+                        # owner reads — point-to-point, O(payload) on
+                        # the wire; hosts owning neither side never
+                        # block on (or unpickle) this tensor
+                        cross += 1
+                        if p2p_seq is not None:
+                            if oy == me:
+                                send_object(
+                                    _fetch_host(held.pop(y)), p2p_seq, pi
+                                )
+                            elif ox == me:
+                                target = local_devices[dev_slot[x]]
+                                moved = jax.device_put(
+                                    recv_object(p2p_seq, pi), target
+                                )
+                        else:
+                            # no coordination client: all-process
+                            # broadcast fallback (lockstep per pair)
+                            payload = (
+                                _fetch_host(held.pop(y)) if oy == me else None
+                            )
+                            obj = broadcast_object(payload, root=oy)
+                            if ox == me:
+                                target = local_devices[dev_slot[x]]
+                                moved = jax.device_put(obj, target)
+                        level_bytes += pair_bytes
+                    if ox == me:
+                        try:
+                            fn = jit_program(
+                                pair_programs[pi], split_complex, precision
+                            )
+                            held[x] = fn([held.pop(x), moved])
+                        except Exception as exc:  # noqa: BLE001
+                            raise PartitionExecutionError(
+                                x, dev_slot[x], exc,
+                                process=me, phase="fanin",
+                            ) from exc
+                        level_flops += pair_flops[pi]
+                    pi += 1
+                if obs.enabled():
+                    level_sp.add(bytes=level_bytes, flops=level_flops)
+                total_bytes += level_bytes
+                total_flops += level_flops
+        if obs.enabled():
+            fanin_sp.add(
+                bytes=total_bytes, flops=total_flops, cross_pairs=cross
+            )
+
+    root_part = _fanin_survivor(k, flat) if flat else 0
+    if not flat:
+        final_meta = metas[root_part]
+    data = None
+    if owner[root_part] == me:
+        final = held[root_part]
+        if split_complex:
+            from tnc_tpu.ops.split_complex import combine_array
+
+            data = combine_array(*final)
+        else:
+            data = np.asarray(final)
+        data = data.reshape(tuple(final_meta.bond_dims))
+    # every process returns the same tensor (byte-exact KV transport)
+    data = broadcast_object(data, root=owner[root_part])
+    return LeafTensor(
+        list(final_meta.legs), list(final_meta.bond_dims),
+        TensorData.matrix(data),
+    )
 
 
 def distributed_partitioned_contraction(
@@ -524,6 +967,7 @@ def distributed_partitioned_contraction(
     hoist: bool = False,
     communication_scheme=None,
     cost_model=None,
+    process_sharded: bool | None = None,
 ) -> LeafTensor:
     """Contract a partitioned network with one partition per device.
 
@@ -544,12 +988,39 @@ def distributed_partitioned_contraction(
     schedule here via :func:`replan_fanin` — with per-partition
     latencies always populated (calibrated seconds under ``cost_model``)
     — instead of trusting ``contract_path.toplevel``.
+
+    ``process_sharded``: shard partitions across host processes
+    (:func:`_process_sharded_contraction` — local contraction per host,
+    cross-host fan-in over the coordination-KV transport, bit-identical
+    to the single-host result). Default (``None``): automatic whenever
+    the run is multi-process (``jax.distributed.initialize`` with
+    ``jax.process_count() > 1``) *unless* an explicit ``devices`` /
+    ``n_devices`` placement was given (the sharded executor places on
+    each host's local devices itself, so it would silently ignore
+    them — an explicit placement keeps the single-controller path, and
+    combining one with ``process_sharded=True`` raises); pass ``False``
+    to force the single-controller path (requires all devices
+    addressable).
     """
     import jax
 
     if communication_scheme is not None:
         contract_path = replan_fanin(
             tn, contract_path, communication_scheme, cost_model
+        )
+    explicit_placement = devices is not None or n_devices is not None
+    if process_sharded is None:
+        process_sharded = jax.process_count() > 1 and not explicit_placement
+    if process_sharded:
+        if explicit_placement:
+            raise ValueError(
+                "process_sharded=True places partitions on each host's "
+                "local devices itself; devices/n_devices cannot be "
+                "combined with it"
+            )
+        return _process_sharded_contraction(
+            tn, contract_path, dtype, split_complex, precision, hbm_bytes,
+            local_sliced_strategy, slice_batch, chunk_steps, hoist,
         )
     if devices is None:
         devices = jax.devices()
@@ -821,19 +1292,23 @@ def partitioned_sliced_executor(
 
     local_fns = [make_local_fn(sp) for sp in sps]
 
-    # fan-in pair programs are slice-independent (legs already reduced)
-    pair_programs = []
-    pair_metas = list(metas)
-    for x, y in contract_path.toplevel:
-        program, result_meta = _pair_program(pair_metas[x], pair_metas[y])
-        pair_programs.append(program)
-        pair_metas[x] = result_meta
-    root = (
-        _fanin_survivor(k, contract_path.toplevel)
-        if contract_path.toplevel
-        else 0
+    # fan-in pair programs are slice-independent (legs already reduced);
+    # the level schedule groups independent pairs so each slice's reduce
+    # dispatches a level back-to-back (async) with no host sync between
+    # same-level pairs
+    from tnc_tpu.contractionpath.communication_schemes import fanin_levels
+
+    levels = fanin_levels(contract_path.toplevel)
+    # programs indexed in FLATTENED level order (level grouping may
+    # reorder independent pairs relative to the path; the tree — which
+    # tensors meet — is unchanged, so the programs and survivor are too)
+    flat_pairs = [pair for level in levels for pair in level]
+    pair_programs, _moved_metas, pair_flops, final_meta = plan_fanin_pairs(
+        metas, flat_pairs
     )
-    final_meta = pair_metas[root]
+    root = _fanin_survivor(k, flat_pairs) if flat_pairs else 0
+    if not flat_pairs:
+        final_meta = metas[root]
 
     def run(max_slices: int | None = None):
         num = slicing.num_slices if max_slices is None else min(
@@ -852,6 +1327,39 @@ def partitioned_sliced_executor(
             data = np.asarray(acc)
         return data.reshape(tuple(final_meta.bond_dims))
 
+    def _fanin_one_slice(held: list, record_spans: bool):
+        """One slice's tree reduce: level-grouped async dispatch, the
+        survivor stays resident on the root device. Spans (recorded for
+        the first slice of a run only — one schedule, many identical
+        slices) carry per-level pairs/bytes/flops for the roofline and
+        the bench ``distributed`` block."""
+        pi = 0
+        for li, level in enumerate(levels):
+            with (
+                obs.span(
+                    "partitioned.fanin_level", level=li, pairs=len(level)
+                )
+                if record_spans
+                else contextlib.nullcontext()
+            ) as level_sp:
+                level_bytes = 0.0
+                level_flops = 0.0
+                for x, y in level:
+                    target = devices[mapping.device(x)]
+                    moved = jax.device_put(held[y], target)
+                    pair_fn = jit_program(
+                        pair_programs[pi], split_complex, precision,
+                        donate=False,
+                    )
+                    level_bytes += _buffer_nbytes(held[y])
+                    level_flops += pair_flops[pi]
+                    held[x] = pair_fn([held[x], moved])
+                    held[y] = None
+                    pi += 1
+                if record_spans and obs.enabled():
+                    level_sp.add(bytes=level_bytes, flops=level_flops)
+        return held
+
     def _run_slices(num: int):
         acc = None
         for s in range(num):
@@ -861,14 +1369,7 @@ def partitioned_sliced_executor(
             held = [
                 fn(bufs, indices) for fn, bufs in zip(local_fns, buffers)
             ]  # async: all devices work concurrently
-            for pi, (x, y) in enumerate(contract_path.toplevel):
-                target = devices[mapping.device(x)]
-                moved = jax.device_put(held[y], target)
-                pair_fn = jit_program(
-                    pair_programs[pi], split_complex, precision, donate=False
-                )
-                held[x] = pair_fn([held[x], moved])
-                held[y] = None
+            held = _fanin_one_slice(held, record_spans=(s == 0))
             if acc is None:
                 acc = held[root]
             elif split_complex:
@@ -901,7 +1402,7 @@ def _coordination_client():
         return None
 
 
-def broadcast_object(obj, root: int = 0):
+def broadcast_object(obj, root: int = 0, wait_forever: bool = False):
     """Broadcast any picklable object from host process ``root`` to all
     processes — the generic transport under :func:`broadcast_path` and
     the cross-process fan-in (the reference's serialized MPI broadcast,
@@ -909,6 +1410,13 @@ def broadcast_object(obj, root: int = 0):
 
     Identity when running single-process; non-root processes pass any
     value (it is ignored) and receive root's object.
+
+    ``wait_forever``: keep re-arming the KV wait past the transport
+    timeout instead of raising — the serving fleet's command channel
+    (:mod:`tnc_tpu.serve.multihost`), where a worker legitimately
+    blocks on the *next* command through arbitrarily long idle periods.
+    The per-call sequence key is armed exactly once, so retried waits
+    stay in lockstep with the sender.
 
     Transport: the distributed **coordination-service KV store** (root
     ``key_value_set``s the pickled payload under a per-call sequence
@@ -943,7 +1451,14 @@ def broadcast_object(obj, root: int = 0):
             client.key_value_set(
                 key, base64.b64encode(pickle.dumps(obj)).decode("ascii")
             )
-        blob = client.blocking_key_value_get(key, _KV_BCAST_TIMEOUT_MS)
+        while True:
+            try:
+                blob = client.blocking_key_value_get(key, _KV_BCAST_TIMEOUT_MS)
+                break
+            except Exception as exc:  # noqa: BLE001 — deadline probe
+                if wait_forever and "deadline" in str(exc).lower():
+                    continue  # same key: the sender hasn't spoken yet
+                raise
         out = pickle.loads(base64.b64decode(blob))
         # reclaim the key: a barrier proves every process has read it,
         # then the root deletes — without this, a long-running job's
@@ -983,6 +1498,123 @@ def broadcast_object(obj, root: int = 0):
             "host is unreliable; jax's coordination-service client was "
             "unavailable for the KV fallback"
         ) from exc
+
+
+def gather_objects(obj, root: int = 0) -> list | None:
+    """Gather one picklable object per process at ``root``: returns the
+    per-process list (index = process) on the root, ``None`` elsewhere.
+    The collective inverse of :func:`broadcast_object` — and unlike a
+    gather built from n-1 broadcasts, only the root reads the payloads
+    (each sender ``key_value_set``s under its own slot of one shared
+    sequence key; total transfer is O(n · payload), one cleanup barrier
+    per call). Every process must call this in the same collective
+    order; the serving fleet's batch gather rides it
+    (:mod:`tnc_tpu.serve.multihost`).
+
+    Identity when running single-process (returns ``[obj]``). Falls
+    back to n-1 :func:`broadcast_object` rounds when the coordination
+    client is unavailable.
+    """
+    import jax
+
+    n = jax.process_count()
+    if n == 1:
+        return [obj]
+
+    import pickle
+
+    global _KV_BCAST_SEQ
+    me = jax.process_index()
+    client = _coordination_client()
+    if client is None:
+        # collective fallback: everyone hears everything (n-1 bcasts)
+        parts = []
+        for src in range(n):
+            got = broadcast_object(obj if me == src else None, root=src)
+            parts.append(got)
+        return parts if me == root else None
+
+    import base64
+
+    seq = _KV_BCAST_SEQ
+    _KV_BCAST_SEQ += 1
+    prefix = f"tnc_tpu/gather/{root}/{seq}"
+    if me != root:
+        client.key_value_set(
+            f"{prefix}/{me}",
+            base64.b64encode(pickle.dumps(obj)).decode("ascii"),
+        )
+    parts = None
+    if me == root:
+        parts = [None] * n
+        parts[root] = obj
+        for src in range(n):
+            if src == root:
+                continue
+            blob = client.blocking_key_value_get(
+                f"{prefix}/{src}", _KV_BCAST_TIMEOUT_MS
+            )
+            parts[src] = pickle.loads(base64.b64decode(blob))
+    # reclaim: the barrier proves the root has read every slot, then
+    # each sender deletes its own key (best-effort, leak-not-break)
+    try:
+        client.wait_at_barrier(
+            f"tnc_tpu/gather_done/{root}/{seq}", _KV_BCAST_TIMEOUT_MS
+        )
+        if me != root:
+            client.key_value_delete(f"{prefix}/{me}")
+    except Exception:  # noqa: BLE001 — cleanup must never fail a gather
+        logger.debug("gather key cleanup skipped for %s", prefix)
+    return parts
+
+
+def p2p_sequence() -> int | None:
+    """Reserve one point-to-point key namespace for the calling
+    collective. EVERY process must call this at the same point of the
+    same collective (it advances the shared sequence counter, keeping
+    all later :func:`broadcast_object` keys aligned) even though only
+    a sender/receiver pair touches each :func:`send_object` /
+    :func:`recv_object` slot under it. Returns ``None`` when no
+    coordination client is available — callers fall back to the
+    all-process :func:`broadcast_object` transport."""
+    global _KV_BCAST_SEQ
+    seq = _KV_BCAST_SEQ
+    _KV_BCAST_SEQ += 1
+    return seq if _coordination_client() is not None else None
+
+
+def send_object(obj, seq: int, slot: int) -> None:
+    """Point-to-point send: publish ``obj`` under slot ``slot`` of the
+    :func:`p2p_sequence` namespace ``seq``. Non-blocking; only the one
+    consumer (:func:`recv_object`) reads it — O(payload) total traffic
+    where a :func:`broadcast_object` costs O(n_processes · payload) and
+    a blocking read on every host."""
+    import base64
+    import pickle
+
+    _coordination_client().key_value_set(
+        f"tnc_tpu/p2p/{seq}/{slot}",
+        base64.b64encode(pickle.dumps(obj)).decode("ascii"),
+    )
+
+
+def recv_object(seq: int, slot: int):
+    """Point-to-point receive half of :func:`send_object`. The receiver
+    is the slot's only consumer, so it reclaims the key itself after
+    reading — no fleet barrier (best-effort: a delete hiccup leaks the
+    key, never breaks the transfer)."""
+    import base64
+    import pickle
+
+    client = _coordination_client()
+    key = f"tnc_tpu/p2p/{seq}/{slot}"
+    blob = client.blocking_key_value_get(key, _KV_BCAST_TIMEOUT_MS)
+    out = pickle.loads(base64.b64decode(blob))
+    try:
+        client.key_value_delete(key)
+    except Exception:  # noqa: BLE001 — cleanup must never fail a recv
+        logger.debug("p2p key cleanup skipped for %s", key)
+    return out
 
 
 def broadcast_path(path_: ContractionPath, root: int = 0) -> ContractionPath:

@@ -16,7 +16,13 @@ The serving pipeline, front to back:
 - :class:`BackgroundReplanner` (``replan.py``) — anytime improvement:
   cache misses serve from a fast greedy plan, a low-priority worker
   hyper-optimizes hot structures between requests and atomically swaps
-  in plans whose predicted cost wins.
+  in plans whose predicted cost wins; :class:`SharedCacheWatcher`
+  adopts other replicas' published plans into a running service.
+- multi-host fan-out (``multihost.py``) — the root process shards
+  micro-batched bras (bit-identical) or slice ranges across every
+  process of a ``jax.distributed`` fleet via
+  :class:`ClusterDispatcher` / :func:`serve_cluster`, results
+  gathering at the root over the coordination-KV transport.
 
 See ``docs/serving.md`` and ``docs/planning.md``.
 """
@@ -33,7 +39,17 @@ from tnc_tpu.serve.rebind import (  # noqa: F401
     stacked_bras,
     thread_batch,
 )
-from tnc_tpu.serve.replan import BackgroundReplanner  # noqa: F401
+from tnc_tpu.serve.multihost import (  # noqa: F401
+    ClusterDispatcher,
+    cluster_amplitudes,
+    cluster_amplitudes_sliced,
+    serve_cluster,
+    shard_ranges,
+)
+from tnc_tpu.serve.replan import (  # noqa: F401
+    BackgroundReplanner,
+    SharedCacheWatcher,
+)
 from tnc_tpu.serve.service import (  # noqa: F401
     ContractionService,
     DeadlineExceededError,

@@ -1,0 +1,382 @@
+"""Multi-host sharded serving: one fleet, one queue, N host processes.
+
+The single-host :class:`~tnc_tpu.serve.service.ContractionService`
+micro-batches requests into one dispatch. This module spreads that
+dispatch across every process of a ``jax.distributed.initialize``
+fleet:
+
+- **batched bras shard across hosts** — the root process micro-batches
+  as usual, then fans the batch's bitstrings out in contiguous shards
+  (:func:`shard_ranges`); every process answers its shard with its own
+  locally compiled :class:`~tnc_tpu.serve.rebind.BoundProgram`, and the
+  rows gather back at the root. Each amplitude is computed wholly on
+  one host by the identical program, so the fleet's answers are
+  **bit-identical** to a single-host run;
+- **slice ranges shard across hosts** — an HBM-sliced structure's
+  per-request slice loop splits into contiguous ranges
+  (``amplitudes_det(..., slice_range=)``), each host sums its range,
+  and the root adds the range partials *in range order*. The
+  association of the sum differs from the single-host sequential loop,
+  so range-sharded amplitudes agree to accumulation rounding (not
+  bitwise) — the trade for an ``x num_hosts`` wall-clock win on deep
+  slice loops.
+
+Transport: every control and data message rides the coordination-KV
+:func:`~tnc_tpu.parallel.partitioned.broadcast_object` channel (the
+same reliable TCP path ``jax.distributed.initialize`` established —
+PR 7 retired the silently-corrupting gloo collective for exactly this
+role), with ``wait_forever`` so an idle fleet blocks on the next
+command indefinitely instead of timing out. All processes execute the
+same collective sequence in the same order by construction: one
+command broadcast, then one gather broadcast per non-root process.
+
+Deployment shape (see ``docs/serving.md``):
+
+- every process binds the same circuit against a **shared**
+  :class:`~tnc_tpu.serve.plancache.PlanCache` directory, so the fleet
+  plans once — the first process to publish wins, everyone else gets
+  a planner-span-free cache hit;
+- process 0 runs the :class:`~tnc_tpu.serve.service.ContractionService`
+  with a :class:`ClusterDispatcher`; every other process parks in
+  :func:`serve_cluster`;
+- a :class:`~tnc_tpu.serve.replan.SharedCacheWatcher` per process makes
+  the background replanner's swaps visible fleet-wide.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import weakref
+from typing import Sequence
+
+import numpy as np
+
+from tnc_tpu import obs
+from tnc_tpu.parallel.partitioned import broadcast_object, gather_objects
+from tnc_tpu.serve.rebind import BoundProgram, bind_template
+
+logger = logging.getLogger(__name__)
+
+
+class _ShardFailure:
+    """A process's shard computation failed. Gathered in place of the
+    rows so the fleet's collective sequence stays in lockstep — the
+    root raises AFTER the gather completes (naming the process), which
+    means a transient shard error surfaces as a retryable batch failure
+    instead of desynchronizing the per-process broadcast counters (the
+    service's retry re-dispatches into a still-synced fleet)."""
+
+    def __init__(self, process: int, exc: BaseException):
+        self.process = process
+        self.error = f"{type(exc).__name__}: {exc}"
+
+    def __repr__(self) -> str:  # shows up in the root's raise
+        return f"process {self.process}: {self.error}"
+
+
+def _raise_shard_failures(parts: list) -> None:
+    failures = [p for p in parts if isinstance(p, _ShardFailure)]
+    if failures:
+        raise RuntimeError(
+            "cluster shard computation failed on "
+            + "; ".join(repr(f) for f in failures)
+        )
+
+
+def _procs() -> tuple[int, int]:
+    """(process_count, process_index) — (1, 0) without a distributed
+    runtime, so every entry point degrades to local execution."""
+    try:
+        import jax
+
+        return int(jax.process_count()), int(jax.process_index())
+    except Exception:  # noqa: BLE001 — no jax / not initialized
+        return 1, 0
+
+
+def shard_ranges(n_items: int, n_parts: int) -> list[tuple[int, int]]:
+    """Split ``[0, n_items)`` into ``n_parts`` contiguous ranges whose
+    sizes differ by at most one (leading ranges take the remainder).
+    Empty ranges are legal — a 3-request batch on an 8-host fleet
+    simply idles five hosts for that round.
+
+    >>> shard_ranges(7, 3)
+    [(0, 3), (3, 5), (5, 7)]
+    >>> shard_ranges(2, 4)
+    [(0, 1), (1, 2), (2, 2), (2, 2)]
+    """
+    n_parts = max(int(n_parts), 1)
+    base, extra = divmod(max(int(n_items), 0), n_parts)
+    out = []
+    lo = 0
+    for p in range(n_parts):
+        hi = lo + base + (1 if p < extra else 0)
+        out.append((lo, hi))
+        lo = hi
+    return out
+
+
+def _concat_rows(parts: Sequence) -> np.ndarray:
+    """Concatenate per-process row shards, dropping EMPTY shards first:
+    ``amplitudes_det([])`` returns complex128 zeros whatever the
+    backend dtype, and ``np.concatenate`` promotes across all inputs —
+    so a batch smaller than the fleet (idle hosts return empty shards)
+    would otherwise upcast the whole batch's dtype relative to the same
+    batch on a single host."""
+    arrays = [np.asarray(p) for p in parts]
+    filled = [a for a in arrays if a.shape[0]] or arrays[:1]
+    return np.concatenate(filled, axis=0)
+
+
+def _gather_rows(mine, me: int, n: int, root: int) -> list | None:
+    """Collective gather of per-process payloads at the root (one
+    root-only-read KV round, O(n · payload) — not n broadcasts); every
+    process participates, non-root processes get ``None``. ``mine`` is
+    this process's payload — possibly a :class:`_ShardFailure`, which
+    the root raises only after the gather completed, keeping the
+    fleet's collective sequence in lockstep through shard errors."""
+    parts = gather_objects(mine, root=root)
+    if me == root:
+        _raise_shard_failures(parts)
+    return parts
+
+
+def cluster_amplitudes(
+    bound: BoundProgram,
+    batch_bits: Sequence[str],
+    backend=None,
+    root: int = 0,
+) -> np.ndarray | None:
+    """One collective bra-sharded batch: every process of the fleet
+    computes a contiguous shard of ``batch_bits`` with its local
+    ``bound`` and the rows gather at ``root``. Returns the full
+    ``(B,) + result_shape`` array on the root process, ``None``
+    elsewhere. **All processes must call this with the same batch**
+    (the root's command loop guarantees that in service deployments).
+
+    Bit-identical to a single-host ``bound.amplitudes_det``: each row
+    is produced by the same program, backend, and arithmetic — sharding
+    only changes *where*, never *how*.
+    """
+    n, me = _procs()
+    if n == 1:
+        return bound.amplitudes_det(list(batch_bits), backend)
+    ranges = shard_ranges(len(batch_bits), n)
+    lo, hi = ranges[me]
+    try:
+        with obs.span(
+            "serve.cluster_shard", mode="bras", rows=hi - lo, process=me
+        ):
+            mine = bound.amplitudes_det(list(batch_bits[lo:hi]), backend)
+    except Exception as exc:  # noqa: BLE001 — stay in collective lockstep
+        mine = _ShardFailure(me, exc)
+    parts = _gather_rows(mine, me, n, root)
+    if me != root:
+        return None
+    return _concat_rows(parts)
+
+
+def cluster_amplitudes_sliced(
+    bound: BoundProgram,
+    batch_bits: Sequence[str],
+    backend=None,
+    root: int = 0,
+) -> np.ndarray | None:
+    """One collective slice-range-sharded batch for an HBM-sliced
+    structure: every process runs the WHOLE batch over its contiguous
+    share of the slice range (``amplitudes_det(slice_range=)``) and the
+    root sums the range partials in range order. Exact up to float
+    accumulation association (the single-host loop adds slice-by-slice,
+    the fleet adds range partials) — use :func:`cluster_amplitudes`
+    when bitwise reproducibility beats slice-loop wall-clock.
+    """
+    n, me = _procs()
+    if n == 1:
+        return bound.amplitudes_det(list(batch_bits), backend)
+    if bound.sliced is None:
+        raise ValueError(
+            "cluster_amplitudes_sliced needs a sliced bound program"
+        )
+    num = bound.sliced.slicing.num_slices
+    ranges = shard_ranges(num, n)
+    lo, hi = ranges[me]
+    try:
+        with obs.span(
+            "serve.cluster_shard", mode="slices", slices=hi - lo, process=me
+        ):
+            mine = bound.amplitudes_det(
+                list(batch_bits), backend, slice_range=(lo, hi)
+            )
+    except Exception as exc:  # noqa: BLE001 — stay in collective lockstep
+        mine = _ShardFailure(me, exc)
+    parts = _gather_rows(mine, me, n, root)
+    if me != root:
+        return None
+    acc = np.asarray(parts[0])
+    for p in parts[1:]:
+        acc = acc + np.asarray(p)
+    return acc
+
+
+class ClusterDispatcher:
+    """Root-side batch dispatcher for a multi-host
+    :class:`~tnc_tpu.serve.service.ContractionService`: plug it in as
+    ``ContractionService(..., dispatcher=ClusterDispatcher())``.
+
+    Every call broadcasts one command to the worker processes parked in
+    :func:`serve_cluster` and runs the matching collective: batched
+    bras shard across hosts by default; a sliced bound program shards
+    its slice ranges instead (``mode="auto"``). Calls are serialized by
+    an internal lock — the fleet's collective sequence must never
+    interleave two batches (or a batch with :meth:`stop`).
+
+    ``stop()`` broadcasts the shutdown command and releases the
+    workers; call it after stopping the service.
+    """
+
+    def __init__(self, mode: str = "auto", root: int = 0):
+        if mode not in ("auto", "bras", "slices"):
+            raise ValueError(f"unknown dispatch mode {mode!r}")
+        self.mode = mode
+        self.root = int(root)
+        self._lock = threading.Lock()
+        self._stopped = False
+        # (weakref to bound, sig): an `is` check on the live object —
+        # never id(), which CPython recycles across swap generations
+        self._sig_cache: tuple | None = None
+
+    def _resolve(self, bound: BoundProgram) -> str:
+        if self.mode != "auto":
+            return self.mode
+        return "slices" if bound.sliced is not None else "bras"
+
+    def _plan_sig(self, bound: BoundProgram) -> str:
+        """The bound's program signature, memoized per bound object —
+        rides every command so the workers can prove (and restore, via
+        the shared plan cache) plan agreement before computing."""
+        cached = self._sig_cache
+        if cached is not None and cached[0]() is bound:
+            return cached[1]
+        sig = bound.program.signature_digest()
+        self._sig_cache = (weakref.ref(bound), sig)
+        return sig
+
+    def __call__(self, bound: BoundProgram, bits: list, backend=None):
+        n, me = _procs()
+        if me != self.root:
+            raise RuntimeError(
+                "ClusterDispatcher must run on the root process; workers "
+                "belong in serve_cluster()"
+            )
+        mode = self._resolve(bound)
+        with self._lock:
+            if self._stopped:
+                raise RuntimeError("ClusterDispatcher is stopped")
+            if n > 1:
+                try:
+                    broadcast_object(
+                        (mode, list(bits), self._plan_sig(bound)),
+                        root=self.root,
+                    )
+                except Exception as exc:
+                    # a failed COMMAND broadcast leaves the fleet's
+                    # collective sequence in an unknown state — poison
+                    # the dispatcher loudly rather than hang the next
+                    # batch against desynced workers
+                    self._stopped = True
+                    raise RuntimeError(
+                        "cluster command broadcast failed; the fleet's "
+                        "collective sequence is unknown — dispatcher "
+                        "stopped (restart the fleet)"
+                    ) from exc
+            obs.counter_add("serve.cluster.batches", mode=mode)
+            if mode == "slices":
+                return cluster_amplitudes_sliced(
+                    bound, bits, backend, root=self.root
+                )
+            return cluster_amplitudes(bound, bits, backend, root=self.root)
+
+    def stop(self) -> None:
+        """Release the worker processes (idempotent)."""
+        n, _me = _procs()
+        with self._lock:
+            if self._stopped:
+                return
+            self._stopped = True
+            if n > 1:
+                broadcast_object(("stop", None, None), root=self.root)
+
+
+def serve_cluster(
+    bound: BoundProgram, backend=None, root: int = 0, plan_cache=None
+) -> int:
+    """Worker-process serving loop: park on the root's command channel
+    and answer each batch's shard until the root's
+    :meth:`ClusterDispatcher.stop`. Returns the number of batches
+    served. Every process must hold a ``bound`` for the SAME circuit
+    structure (bind through one shared plan cache so only the first
+    process pays the planner).
+
+    Every command carries the root's plan signature; a mismatch (the
+    root's service adopted a background-replanner/shared-cache swap)
+    makes the worker rebuild its bound through ``plan_cache`` — a
+    cache hit on the swap the root already published, zero pathfinding
+    — BEFORE computing, so every shard of a batch runs under one plan
+    (the fleet-wide batch-atomicity the bit-identity claim needs).
+    Without a ``plan_cache`` a signature mismatch raises instead of
+    silently computing under a stale plan.
+    """
+    n, me = _procs()
+    if n == 1 or me == root:
+        raise RuntimeError(
+            "serve_cluster is the NON-root side of a multi-process fleet"
+        )
+    served = 0
+    my_sig = bound.program.signature_digest()
+    while True:
+        cmd, payload, want_sig = broadcast_object(
+            None, root=root, wait_forever=True
+        )
+        if cmd == "stop":
+            logger.info("serve_cluster: stop after %d batches", served)
+            return served
+        if want_sig is not None and want_sig != my_sig:
+            try:
+                if plan_cache is None:
+                    raise RuntimeError(
+                        "root's plan signature changed but this worker "
+                        "has no plan_cache to rebuild from — bind "
+                        "through the fleet's shared cache to follow "
+                        "plan swaps"
+                    )
+                new_bound = bind_template(
+                    bound.template, None, plan_cache, bound.target_size
+                )
+                new_sig = new_bound.program.signature_digest()
+                if want_sig != new_sig:
+                    raise RuntimeError(
+                        "worker rebuilt from the shared plan cache but "
+                        "still disagrees with the root's plan signature "
+                        "— cache divergence or version skew; refusing "
+                        "to serve a mixed-plan batch"
+                    )
+            except Exception as exc:  # noqa: BLE001 — stay in lockstep
+                # join the batch's gather with a failure sentinel and
+                # keep looping: the root raises a retryable batch error
+                # naming this process; a worker that raised here would
+                # instead hang the whole fleet's next collective
+                logger.exception("serve_cluster: plan-swap adoption failed")
+                _gather_rows(_ShardFailure(me, exc), me, n, root)
+                continue
+            bound, my_sig = new_bound, new_sig
+            obs.counter_add("serve.cluster.worker_rebinds")
+            logger.info("serve_cluster: adopted root's plan swap")
+        if cmd == "slices":
+            cluster_amplitudes_sliced(bound, payload, backend, root=root)
+        elif cmd == "bras":
+            cluster_amplitudes(bound, payload, backend, root=root)
+        else:  # unknown command: the fleet is version-skewed — stop loud
+            raise RuntimeError(f"serve_cluster: unknown command {cmd!r}")
+        served += 1
+        obs.counter_add("serve.cluster.worker_batches")

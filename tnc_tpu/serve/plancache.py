@@ -26,6 +26,22 @@ Discipline (shared with the other on-disk artifact stores):
 - writes are atomic (temp file + ``os.replace``);
 - the cache is LRU-bounded by entry count (mtime = last use; loads
   touch it), with corrupted entries deleted and counted, never raised.
+
+**Shared store.** The directory is safe to share between N serving
+replicas (processes, containers mounting one volume): entries are
+content-addressed by the structure digest, every writer publishes
+through its own uniquely named temp file + atomic ``os.replace`` (two
+replicas racing on one key leave whichever complete entry landed last —
+never an interleaved file), and readers are lock-free (``os.replace``
+guarantees a reader sees either the old or the new complete entry).
+N replicas of one fleet therefore plan each structure **once**: the
+first replica to finish planning publishes, every other replica's
+lookup is a planner-span-free hit. :func:`PlanCache.entry_fingerprint`
+gives replicas a cheap change probe — the background replanner's
+improved plans (published through the same store) become visible to
+every replica, and a
+:class:`~tnc_tpu.serve.replan.SharedCacheWatcher` adopts them into a
+running service.
 """
 
 from __future__ import annotations
@@ -35,6 +51,7 @@ import logging
 import os
 import threading
 import time
+import uuid
 from pathlib import Path
 
 from tnc_tpu import obs
@@ -230,15 +247,35 @@ class PlanCache:
             ranked = sorted(self._hits.items(), key=lambda kv: (-kv[1], kv[0]))
         return [k for k, n in ranked[: max(limit, 0)] if n > 0]
 
+    def entry_fingerprint(self, key: str) -> str | None:
+        """Cheap content probe for ``key``'s on-disk entry: a digest of
+        the entry's raw bytes, or ``None`` when absent/unreadable.
+        Replicas poll this to notice another replica's publish (a
+        background replanner's swap, a fresh plan) without parsing the
+        JSON — the read is lock-free (``os.replace`` publishes whole
+        files, so the bytes are always one complete entry)."""
+        try:
+            with open(self._path(key), "rb") as fh:
+                return stable_digest("plan-bytes", fh.read())
+        except OSError:
+            return None
+
     def store(self, key: str, plan: dict) -> None:
         """Atomic write + LRU eviction down to ``max_entries``.
 
         Best-effort, mirroring :meth:`load`: the cache is an
         optimization, so a write failure (disk full, permissions, dir
         removed) is logged and counted — never raised. The caller holds
-        the freshly planned program in memory either way."""
+        the freshly planned program in memory either way.
+
+        Safe under concurrent writers (N replicas sharing the
+        directory): the temp file is uniquely named per writer (pid +
+        random suffix), so two replicas racing on one key can never
+        interleave bytes — the last complete ``os.replace`` wins."""
         target = self._path(key)
-        tmp = target.with_suffix(".json.tmp")
+        tmp = target.with_name(
+            f"{key}.{os.getpid()}.{uuid.uuid4().hex[:8]}.json.tmp"
+        )
         try:
             with open(tmp, "w", encoding="utf-8") as fh:
                 json.dump(plan, fh)
@@ -251,6 +288,10 @@ class PlanCache:
                 "the in-memory plan", target, type(exc).__name__, exc,
             )
             obs.counter_add("serve.plan_cache.store_failed")
+            try:  # don't strand the partial temp file
+                tmp.unlink(missing_ok=True)
+            except OSError:
+                pass
             return
         obs.counter_add("serve.plan_cache.store")
         self._evict()
@@ -270,6 +311,15 @@ class PlanCache:
         ]
 
     def _evict(self) -> None:
+        # reap orphaned temp files a crashed writer left behind (never
+        # fresh ones — another replica may be mid-publish right now)
+        now = time.time()
+        for orphan in self.directory.glob("*.json.tmp"):
+            try:
+                if now - orphan.stat().st_mtime > 3600.0:
+                    orphan.unlink(missing_ok=True)
+            except OSError:
+                continue
         entries = self._entries()
         if len(entries) <= self.max_entries:
             return

@@ -164,18 +164,42 @@ class BoundProgram:
         self,
         batch_bits: Sequence[str],
         backend: Backend | None = None,
+        slice_range: tuple[int, int] | None = None,
     ) -> np.ndarray:
         """:meth:`amplitudes` over already-validated determined-position
         bit strings (``template.request_bits`` output) — the service
         dispatches these directly so per-request validation runs once,
-        at admission, not again on the batching hot path."""
+        at admission, not again on the batching hot path.
+
+        ``slice_range=(lo, hi)`` (sliced structures only): each
+        request's amplitude is the **partial sum** over that contiguous
+        slice shard — the multi-host serving shape, where every host
+        covers a range and the root adds the range partials in range
+        order (:mod:`tnc_tpu.serve.multihost`)."""
         if backend is None:
             backend = NumpyBackend()
+        if slice_range is not None and self.sliced is None:
+            raise ValueError(
+                "slice_range only applies to sliced structures "
+                "(this bound program has no slicing)"
+            )
         if not batch_bits:
             return np.zeros((0,) + self.result_shape, dtype=np.complex128)
         if not self.bra_slots:
             # fully-open template: every request is the same statevector
-            out = np.asarray(backend.execute(self.program, list(self.arrays)))
+            if self.sliced is not None:
+                # the slice loop (not the flat program) is the
+                # executable for a sliced structure — and a range shard
+                # must return the range PARTIAL, never the full sum
+                # (the root adds one partial per host)
+                kw = {} if slice_range is None else {"slice_range": slice_range}
+                out = np.asarray(
+                    backend.execute_sliced(self.sliced, list(self.arrays), **kw)
+                )
+            else:
+                out = np.asarray(
+                    backend.execute(self.program, list(self.arrays))
+                )
             return np.broadcast_to(out, (len(batch_bits),) + out.shape).copy()
         buffers = self._batch_buffers(batch_bits)
         b = len(batch_bits)
@@ -185,8 +209,11 @@ class BoundProgram:
             # (stacked dispatch — the batch leg would multiply the
             # already-HBM-bound per-slice peak)
             obs.counter_add("serve.rebind.dispatch", mode="sliced")
+            # kwarg only when actually sharding: a backend subclass
+            # predating slice_range keeps serving whole-range requests
+            kw = {} if slice_range is None else {"slice_range": slice_range}
             return stacked_rows(
-                lambda per: backend.execute_sliced(self.sliced, per),
+                lambda per: backend.execute_sliced(self.sliced, per, **kw),
                 buffers, self.bra_slots, b, self.result_shape,
             )
 

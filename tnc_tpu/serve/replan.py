@@ -38,6 +38,13 @@ Swap safety:
 
 Counters: ``serve.replan.attempt`` / ``serve.replan.swap`` /
 ``serve.replan.reject`` (+ the service-side ``serve.replan.adopted``).
+
+Fleet visibility: because swaps publish through the shared on-disk
+store, a :class:`SharedCacheWatcher` on any OTHER replica notices the
+new entry (a cheap byte-fingerprint probe) and adopts it into its own
+running service through the identical rebuild-and-swap path — one
+replica's background search improves every replica sharing the cache
+directory (``serve.replan.shared_adopt``).
 """
 
 from __future__ import annotations
@@ -73,6 +80,137 @@ def plan_predicted_cost(
     if slicing is not None and slicing.num_slices > 1:
         return objective.sliced_path_cost(inputs, pairs, slicing)
     return objective.path_cost(inputs, ContractionPath.simple(pairs))
+
+
+class SharedCacheWatcher:
+    """Adopt plan-cache publishes made by OTHER replicas.
+
+    A fleet of serving replicas shares one
+    :class:`~tnc_tpu.serve.plancache.PlanCache` directory; when any of
+    them (usually the one running a :class:`BackgroundReplanner`)
+    publishes an improved plan for this service's structure, the watcher
+    sees the entry's byte fingerprint change, rebuilds a
+    :class:`~tnc_tpu.serve.rebind.BoundProgram` through the normal
+    cache-hit path (zero pathfinding), and stages it via
+    :meth:`~tnc_tpu.serve.service.ContractionService.swap_bound` — the
+    same batch-boundary adoption as a local replan, so amplitudes stay
+    correct through the swap. An entry whose rebuilt program matches
+    the serving one (a same-plan re-publish, or our own store) is
+    skipped.
+
+    >>> SharedCacheWatcher.__name__
+    'SharedCacheWatcher'
+    """
+
+    def __init__(
+        self,
+        service,
+        plan_cache,
+        poll_interval_s: float = 0.25,
+    ):
+        self.service = service
+        self.plan_cache = plan_cache
+        self.poll_interval_s = float(poll_interval_s)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        bound = service.bound
+        self._key = plan_cache.key_for_network(
+            bound.template.network, bound.target_size
+        )
+        # baseline: whatever is on disk NOW is what this service serves
+        # (or close enough — adopting it immediately would be a no-op
+        # swap anyway, caught by the signature check)
+        self._seen = plan_cache.entry_fingerprint(self._key)
+        # a publish whose adoption keeps raising (corrupt/incompatible
+        # foreign entry) is abandoned after max_failures consecutive
+        # attempts — the full rebuild must not re-run 4x/second forever.
+        # A NEW publish (different fingerprint) re-arms the watcher.
+        self.max_failures = 5
+        self._fail_count = 0
+        self._last_fp = None
+        self.stats = {"adopts": 0, "skips": 0, "abandons": 0}
+
+    def start(self) -> "SharedCacheWatcher":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="tnc-serve-cachewatch", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=60.0)
+
+    def __enter__(self) -> "SharedCacheWatcher":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def poll_once(self) -> bool:
+        """One fingerprint probe; True when a foreign publish was
+        adopted (exposed for deterministic tests — the thread loop is
+        just this on a timer)."""
+        fp = self.plan_cache.entry_fingerprint(self._key)
+        self._last_fp = fp
+        if fp is None or fp == self._seen:
+            return False
+        # _seen advances only after the publish is fully handled — a
+        # rebuild/swap that raises here (transient I/O on the shared
+        # volume, a rejected swap) is retried on the next poll instead
+        # of being silently dropped until some future publish
+        bound = self.service.bound
+        new_bound = bind_template(
+            bound.template, None, self.plan_cache, bound.target_size
+        )
+        if (
+            new_bound.program.signature_digest()
+            == bound.program.signature_digest()
+        ):
+            # same plan re-published (or our own write): nothing to adopt
+            self._seen = fp
+            self.stats["skips"] += 1
+            return False
+        self.service.swap_bound(new_bound)
+        self._seen = fp
+        self.stats["adopts"] += 1
+        obs.counter_add("serve.replan.shared_adopt")
+        logger.info(
+            "adopted shared-cache plan for %s (foreign publish)",
+            self._key[:12],
+        )
+        return True
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self.poll_once()
+                self._fail_count = 0
+            except Exception:  # noqa: BLE001 — the watcher must survive
+                self._fail_count += 1
+                if (
+                    self._fail_count >= self.max_failures
+                    and self._last_fp is not None
+                ):
+                    # abandon exactly the publish that kept failing:
+                    # advancing _seen to its fingerprint stops the
+                    # rebuild churn; any later publish re-arms
+                    self._seen = self._last_fp
+                    self._fail_count = 0
+                    self.stats["abandons"] += 1
+                    obs.counter_add("serve.replan.shared_abandon")
+                    logger.exception(
+                        "shared-cache publish for %s abandoned after %d "
+                        "failed adoptions (re-armed by the next publish)",
+                        self._key[:12], self.max_failures,
+                    )
+                else:
+                    logger.exception("shared-cache watch poll failed")
 
 
 class BackgroundReplanner:

@@ -103,11 +103,22 @@ class ContractionService:
         max_wait_ms: float = 2.0,
         max_queue: int = 1024,
         retry_policy: _retry.RetryPolicy | None = None,
+        dispatcher=None,
     ):
+        """``dispatcher``: optional batch-execution hook
+        ``fn(bound, bits, backend) -> (B,)+result_shape array``
+        replacing the local ``bound.amplitudes_det`` dispatch — the
+        multi-host fan-out point (:class:`~tnc_tpu.serve.multihost.
+        ClusterDispatcher` shards the micro-batch across host
+        processes and gathers at the root). Everything else (queueing,
+        deadlines, retry, degradation, plan swaps) is unchanged: the
+        dispatcher is only ever called with a batch and the CURRENT
+        bound, so plan swaps stay batch-atomic across the fleet."""
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         self.bound = bound
         self.backend = backend  # None → rebind's numpy default
+        self.dispatcher = dispatcher
         self.max_batch = int(max_batch)
         self.max_wait_s = float(max_wait_ms) / 1e3
         self.max_queue = int(max_queue)
@@ -128,6 +139,7 @@ class ContractionService:
         # the dispatcher adopts it at the next batch boundary
         self._pending_bound: BoundProgram | None = None
         self._replanner = None  # attached BackgroundReplanner, if any
+        self._watchers: list = []  # attached SharedCacheWatchers
 
     @classmethod
     def from_circuit(
@@ -140,6 +152,8 @@ class ContractionService:
         target_size=None,
         background_replan: bool = False,
         replan_options: dict | None = None,
+        shared_cache_watch: bool = False,
+        watch_options: dict | None = None,
         **kwargs,
     ) -> "ContractionService":
         """Build (plan/compile once, plan cache honored) and start.
@@ -149,24 +163,40 @@ class ContractionService:
         is answered from the fast greedy plan immediately, and the
         worker hyper-optimizes the structure between requests, swapping
         in the improved plan when its predicted cost wins.
-        ``replan_options`` are its constructor kwargs."""
+        ``replan_options`` are its constructor kwargs.
+
+        ``shared_cache_watch=True`` (requires ``plan_cache``) attaches a
+        :class:`~tnc_tpu.serve.replan.SharedCacheWatcher`: a replica
+        deployment sharing one cache directory adopts OTHER replicas'
+        published plans (including their background replanner's swaps)
+        at batch boundaries. ``watch_options`` are its kwargs."""
         if background_replan and plan_cache is None:
             raise ValueError("background_replan requires a plan_cache")
+        if shared_cache_watch and plan_cache is None:
+            raise ValueError("shared_cache_watch requires a plan_cache")
         bound = bind_circuit(circuit, mask, pathfinder, plan_cache, target_size)
         svc = cls(bound, backend=backend, **kwargs)
         svc.start()
-        if background_replan:
-            from tnc_tpu.serve.replan import BackgroundReplanner
+        try:
+            if background_replan:
+                from tnc_tpu.serve.replan import BackgroundReplanner
 
-            try:
                 BackgroundReplanner(
                     svc, plan_cache, **(replan_options or {})
                 ).start()
-            except Exception:
-                # a bad replan_options kwarg must not leak a running
-                # dispatcher thread the caller has no handle to
-                svc.stop()
-                raise
+            if shared_cache_watch:
+                from tnc_tpu.serve.replan import SharedCacheWatcher
+
+                watcher = SharedCacheWatcher(
+                    svc, plan_cache, **(watch_options or {})
+                )
+                svc._watchers.append(watcher)
+                watcher.start()
+        except Exception:
+            # a bad option kwarg must not leak a running dispatcher
+            # thread (or half the attachments) the caller can't reach
+            svc.stop()
+            raise
         return svc
 
     # -- lifecycle ---------------------------------------------------------
@@ -190,6 +220,9 @@ class ContractionService:
         replanner, self._replanner = self._replanner, None
         if replanner is not None:
             replanner.stop()
+        watchers, self._watchers = list(self._watchers), []
+        for watcher in watchers:
+            watcher.stop()
         with self._cond:
             if not self._running:
                 return
@@ -369,6 +402,13 @@ class ContractionService:
             obs.counter_add("serve.requests.cancelled")
             return False
 
+    def _dispatch_amps(self, bound: BoundProgram, bits: list) -> np.ndarray:
+        """One batch execution under ``bound`` — locally, or through the
+        pluggable ``dispatcher`` (multi-host fan-out)."""
+        if self.dispatcher is not None:
+            return self.dispatcher(bound, bits, self.backend)
+        return bound.amplitudes_det(bits, self.backend)
+
     def _per_request(self, amps: np.ndarray, i: int):
         out = amps[i]
         # copy, not view: co-riders must never alias one mutable batch
@@ -409,7 +449,7 @@ class ContractionService:
         try:
             with obs.span("serve.dispatch", batch=len(live)):
                 amps = self.retry_policy.run(
-                    lambda: bound.amplitudes_det(bits, self.backend),
+                    lambda: self._dispatch_amps(bound, bits),
                     label="serve.dispatch",
                 )
         except Exception as exc:  # noqa: BLE001 — degrade to singletons
@@ -436,7 +476,7 @@ class ContractionService:
             bound = self.bound
         for req in batch:
             try:
-                amps = bound.amplitudes_det([req.bits], self.backend)
+                amps = self._dispatch_amps(bound, [req.bits])
             except Exception as exc:  # noqa: BLE001 — per-request verdict
                 self._count("failed")
                 obs.counter_add("serve.requests.failed")
