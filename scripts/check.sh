@@ -74,6 +74,9 @@ if [ "$gate_rc" -ne 1 ]; then
   exit 1
 fi
 
+echo "== joint planner smoke (joint tree+slice search vs post-pass on a pinned budget network) =="
+TNC_TPU_PLATFORM=cpu python scripts/joint_planner_smoke.py
+
 echo "== crash-resume smoke (SIGKILL mid-range, resume, compare to golden) =="
 TNC_TPU_PLATFORM=cpu python scripts/crash_resume_smoke.py
 

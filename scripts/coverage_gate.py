@@ -49,6 +49,7 @@ REQUIRED_MODULES = (
     os.path.join("tnc_tpu", "ops", "strassen.py"),
     os.path.join("tnc_tpu", "ops", "pallas_complex.py"),
     os.path.join("tnc_tpu", "contractionpath", "contraction_cost.py"),
+    os.path.join("tnc_tpu", "contractionpath", "sliced_cost.py"),
     os.path.join("tnc_tpu", "serve", "replan.py"),
     os.path.join("tnc_tpu", "serve", "multihost.py"),
 )

@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Joint tree+slice planner smoke for check.sh.
+
+Runs the joint search and the classic hyper-then-slice-and-reconfigure
+post-pass on one pinned budget-constrained gate network with the same
+trials/seed, and asserts the joint plan's sliced total (flops AND
+predicted seconds under the pinned reference model) never exceeds the
+post-pass plan's — the core promise of slicing-aware pathfinding, as a
+few-second CI check (the full set is gated by planner_quality --gate).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import planner_quality  # noqa: E402  (scripts/ sibling import)
+
+SMOKE_NETWORK = "brickwork12_d8_b7"  # smallest sliced gate entry
+
+
+def main() -> int:
+    rec = planner_quality.measure_sliced_gate_network(SMOKE_NETWORK)
+    post, joint = rec["post"], rec["joint"]
+    print(
+        f"{SMOKE_NETWORK}: post {post['num_slices']} slices, "
+        f"{post['hoisted_flops']:.4g} hoisted flops, "
+        f"{post['predicted_seconds']:.4g}s predicted "
+        f"(overhead {post['overhead']}x)"
+    )
+    print(
+        f"{SMOKE_NETWORK}: joint {joint['num_slices']} slices, "
+        f"{joint['hoisted_flops']:.4g} hoisted flops, "
+        f"{joint['predicted_seconds']:.4g}s predicted "
+        f"(overhead {joint['overhead']}x)"
+    )
+    tie = 1.0 + 1e-9
+    if joint["hoisted_flops"] > post["hoisted_flops"] * tie:
+        print(
+            "joint planner smoke: FAILED — joint hoisted sliced flops "
+            "exceed the post-pass pipeline's",
+            file=sys.stderr,
+        )
+        return 1
+    if joint["predicted_seconds"] > post["predicted_seconds"] * tie:
+        print(
+            "joint planner smoke: FAILED — joint predicted seconds "
+            "exceed the post-pass pipeline's",
+            file=sys.stderr,
+        )
+        return 1
+    print("joint planner smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

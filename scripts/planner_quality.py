@@ -63,6 +63,26 @@ GATE_POLISH_ROUNDS = 1
 GATE_POLISH_STEPS = 500
 GATE_TARGET_LOG2 = 14.0
 
+#: the sliced gate set: networks planned under a memory budget TIGHT
+#: enough to force real slicing (unlike the 2^14 budget above, which
+#: every gate network fits unsliced). Each entry records the classic
+#: hyper-then-slice-and-reconfigure pipeline ("post") next to the
+#: joint tree+slice search ("joint") on the same trials/seed; the gate
+#: enforces joint <= post on every network and strictly better on at
+#: least one — the whole point of making slicing a search dimension.
+#: name -> (gate network, target_log2)
+SLICED_GATE_NETWORKS = {
+    "line20_d12_b6": ("line20_d12", 6.0),
+    "brickwork12_d8_b7": ("brickwork12_d8", 7.0),
+    "brickwork14_d12_b8": ("brickwork14_d12", 8.0),
+}
+
+#: pinned joint-SA effort for the sliced gate — deeper than the
+#: Hyperoptimizer default (the gate is a quality floor, not a latency
+#: budget) and explicit so the artifact reproduces anywhere
+GATE_JOINT_SA_STEPS = 2000
+GATE_JOINT_SA_ROUNDS = 3
+
 
 def _gate_network(name: str):
     from tnc_tpu.builders.connectivity import ConnectivityLayout
@@ -82,6 +102,13 @@ def _gate_network(name: str):
         raw, _ = (
             brickwork_circuit(12, 8, np.random.default_rng(1))
             .into_amplitude_network("0" * 12)
+        )
+    elif name == "brickwork14_d12":
+        # sliced-gate workhorse: peak 2^13 under greedy, so the 2^8
+        # budget needs real multi-leg slicing
+        raw, _ = (
+            brickwork_circuit(14, 12, np.random.default_rng(2))
+            .into_amplitude_network("0" * 14)
         )
     elif name == "qaoa18_p4":
         raw, _ = (
@@ -193,6 +220,83 @@ def measure_gate_networks() -> dict:
     return out
 
 
+def measure_sliced_gate_network(name: str) -> dict:
+    """One sliced-gate entry: the classic post-pass pipeline vs the
+    joint tree+slice search on the same trials/seed, both finished by
+    the same bounded ``slice_and_reconfigure`` repair (cold for post,
+    seeded with the joint search's slice set for joint)."""
+    from tnc_tpu.contractionpath.contraction_cost import CalibratedObjective
+    from tnc_tpu.contractionpath.paths.hyper import Hyperoptimizer
+    from tnc_tpu.contractionpath.slicing import (
+        hoisted_sliced_flops,
+        slice_and_reconfigure,
+        sliced_flops,
+    )
+    from tnc_tpu.serve.replan import plan_predicted_cost
+
+    base, target_log2 = SLICED_GATE_NETWORKS[name]
+    tn = _gate_network(base)
+    inputs = list(tn.tensors)
+    target = 2.0**target_log2
+    objective = CalibratedObjective(_reference_cost_model())
+
+    def plan(joint: bool) -> dict:
+        t0 = time.perf_counter()
+        hy = Hyperoptimizer(
+            ntrials=GATE_NTRIALS,
+            seed=42,
+            target_size=target,
+            polish_rounds=GATE_POLISH_ROUNDS,
+            polish_steps=GATE_POLISH_STEPS,
+            reconfigure_budget=None,  # work-bounded: reproducible
+            joint_slicing=joint,
+            joint_sa_steps=GATE_JOINT_SA_STEPS,
+            joint_sa_rounds=GATE_JOINT_SA_ROUNDS,
+        )
+        result = hy.find_path(tn)
+        seed = hy.last_slicing
+        pairs, slicing = slice_and_reconfigure(
+            inputs, result.ssa_path.toplevel, target,
+            reconf_rounds=1, step_budget=None,
+            final_rounds=2, final_budget=None,
+            seed_slices=seed.legs if seed is not None else None,
+        )
+        plan_s = time.perf_counter() - t0
+        total = sliced_flops(inputs, pairs, slicing)
+        _, _, hoisted = hoisted_sliced_flops(inputs, pairs, slicing)
+        seconds = plan_predicted_cost(
+            inputs, pairs, slicing if slicing.num_slices > 1 else None,
+            objective,
+        )
+        return {
+            "raw_flops": result.flops,
+            "legs": len(slicing.legs),
+            "num_slices": slicing.num_slices,
+            "sliced_flops": total,
+            "hoisted_flops": hoisted,
+            "predicted_seconds": seconds,
+            # the slicing-overhead column: sliced work over the plan's
+            # own unsliced flops
+            "overhead": round(total / max(result.flops, 1.0), 3),
+            "seconds": round(plan_s, 3),
+        }
+
+    return {
+        "cores": len(tn),
+        "target_log2": target_log2,
+        "post": plan(False),
+        "joint": plan(True),
+    }
+
+
+def measure_sliced_gate_networks() -> dict:
+    out = {}
+    for name in SLICED_GATE_NETWORKS:
+        print(f"measuring sliced gate network {name} ...", flush=True)
+        out[name] = measure_sliced_gate_network(name)
+    return out
+
+
 def measure(depth: int, seed: int, ntrials: int, target_log2: float) -> dict:
     """The full north-star measurement (slow: sycamore53 at 128 trials)."""
     from tnc_tpu.builders.sycamore_circuit import sycamore_circuit
@@ -288,6 +392,14 @@ def compare_quality(
     the calibrated plan's predicted seconds. Improvements always pass;
     within-record, the calibrated plan must not predict worse than the
     flops plan beyond the tolerance (the objective's whole point).
+
+    The ``sliced_gate_networks`` block is gated the same way (joint
+    plan hoisted sliced flops + predicted seconds vs baseline) plus two
+    within-record invariants on the fresh measurement: the joint
+    tree+slice search must not lose to the post-pass pipeline on ANY
+    network (beyond float noise), and must beat it strictly on at
+    least one — otherwise making slicing a search dimension has
+    silently stopped paying.
     """
     base_nets = base.get("gate_networks")
     fresh_nets = fresh.get("gate_networks")
@@ -354,6 +466,64 @@ def compare_quality(
                 f"predicts {cal:.4g}s vs flops-objective {flo:.4g}s — "
                 "the calibrated objective stopped helping"
             )
+
+    # -- sliced gate: joint tree+slice search vs post-pass pipeline --
+    base_sl = base.get("sliced_gate_networks")
+    fresh_sl = fresh.get("sliced_gate_networks")
+    if isinstance(base_sl, dict) and base_sl:
+        if not isinstance(fresh_sl, dict) or not fresh_sl:
+            return 2, msgs + [
+                "fresh record has no sliced_gate_networks block"
+            ]
+        missing = sorted(set(base_sl) - set(fresh_sl))
+        if missing:
+            return 2, msgs + [
+                "fresh record is missing sliced gate network(s): "
+                + ", ".join(missing)
+            ]
+    if isinstance(fresh_sl, dict) and fresh_sl:
+        # a hair of float slack: both pipelines are deterministic, but
+        # exact ties must never trip the "joint lost" check
+        tie = 1.0 + 1e-9
+        strict_win = False
+        for net in sorted(fresh_sl):
+            f = fresh_sl[net]
+            joint, post = f["joint"], f["post"]
+            if isinstance(base_sl, dict) and net in base_sl:
+                b = base_sl[net]
+                ratio_check(
+                    net, "joint.hoisted_flops",
+                    b["joint"]["hoisted_flops"], joint["hoisted_flops"],
+                )
+                ratio_check(
+                    net, "joint.predicted_seconds",
+                    b["joint"]["predicted_seconds"],
+                    joint["predicted_seconds"],
+                )
+            # the gated sliced totals are what the hoisting executors
+            # actually pay: the hoist-aware flop total and the predicted
+            # seconds — the naive num_slices x per-slice total stays a
+            # recorded column (a joint plan may trade a hair of naive
+            # total for a larger hoistable stem, and that trade is the
+            # objective, not a regression)
+            for metric in ("hoisted_flops", "predicted_seconds"):
+                if joint[metric] > post[metric] * tie:
+                    verdict = 1
+                    msgs.append(
+                        f"PLAN REGRESSION: {net} joint {metric} "
+                        f"{joint[metric]:.4g} exceeds the post-pass "
+                        f"pipeline's {post[metric]:.4g} — the joint "
+                        "search lost to optimize-then-slice"
+                    )
+                if joint[metric] < post[metric]:
+                    strict_win = True
+        if not strict_win:
+            verdict = 1
+            msgs.append(
+                "PLAN REGRESSION: the joint search beats the post-pass "
+                "pipeline on NO sliced gate network — slicing-aware "
+                "pathfinding has stopped paying for itself"
+            )
     return verdict, msgs
 
 
@@ -418,11 +588,13 @@ def main():
             "Planner quality: native Hyperoptimizer (128 trials, seed 42) "
             "vs Greedy on the BASELINE north-star networks, "
             "slice-and-reconfigure overhead at the single-chip HBM "
-            "target, and the fast gate_networks set (greedy / "
+            "target, the fast gate_networks set (greedy / "
             "flops-objective hyper / calibrated-objective hyper, priced "
-            "under reference_model) gated in CI by "
-            "scripts/planner_quality.py --gate. Regenerate with "
-            "scripts/planner_quality.py [--fast]."
+            "under reference_model), and the sliced_gate_networks set "
+            "(budget-constrained: joint tree+slice search vs the classic "
+            "hyper-then-slice post-pass, with the slicing-overhead "
+            "column) gated in CI by scripts/planner_quality.py --gate. "
+            "Regenerate with scripts/planner_quality.py [--fast]."
         ),
         "reference_model": dict(REFERENCE_MODEL),
     }
@@ -443,6 +615,7 @@ def main():
             print(f"measuring {key} ...", flush=True)
             out[key] = measure(depth, 42, args.ntrials, args.target_log2)
     out["gate_networks"] = measure_gate_networks()
+    out["sliced_gate_networks"] = measure_sliced_gate_networks()
     with open(args.out, "w") as f:
         json.dump(out, f, indent=1)
     print(f"wrote {args.out}")

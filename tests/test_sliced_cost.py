@@ -1,0 +1,480 @@
+"""Incremental sliced-cost evaluator + joint tree+slice search.
+
+The evaluator's contract is *exactness*: every query must agree with
+the replay oracles in ``contractionpath/slicing.py`` (``sliced_flops``,
+``sliced_peak``, ``hoisted_sliced_flops``, ``StemAccountant``) — on the
+power-of-two bond dimensions of circuit networks the agreement is
+bitwise — while delta updates keep it O(affected steps) per move, fast
+enough to run once per hyper trial instead of once per finalist.
+"""
+
+import random
+import time
+
+import numpy as np
+import pytest
+
+from tnc_tpu import LeafTensor
+from tnc_tpu.builders.connectivity import ConnectivityLayout
+from tnc_tpu.builders.qaoa_circuit import qaoa_circuit
+from tnc_tpu.builders.random_circuit import brickwork_circuit, random_circuit
+from tnc_tpu.contractionpath.contraction_path import (
+    ContractionPath,
+    ssa_replace_ordering,
+)
+from tnc_tpu.contractionpath.contraction_tree import ContractionTree
+from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+from tnc_tpu.contractionpath.paths.hyper import Hyperoptimizer
+from tnc_tpu.contractionpath.sliced_cost import (
+    SlicedCostEvaluator,
+    SlicedReconfState,
+    _apply_rotation,
+    _rotation_candidates,
+    greedy_slice_to_target,
+    joint_slice_search,
+)
+from tnc_tpu.contractionpath.slicing import (
+    Slicing,
+    StemAccountant,
+    _make_replayer,
+    _reduced_flops,
+    hoisted_sliced_flops,
+    slice_and_reconfigure,
+    sliced_flops,
+    sliced_peak,
+)
+from tnc_tpu.tensornetwork.simplify import simplify_network
+
+
+def _network(kind="line", seed=0, qubits=12, depth=8):
+    if kind == "line":
+        raw = random_circuit(
+            qubits, depth, 0.5, 0.5, np.random.default_rng(seed),
+            ConnectivityLayout.LINE, bitstring="0" * qubits,
+        )
+    elif kind == "brickwork":
+        raw, _ = (
+            brickwork_circuit(qubits, depth, np.random.default_rng(seed))
+            .into_amplitude_network("0" * qubits)
+        )
+    else:
+        raw, _ = (
+            qaoa_circuit(qubits, depth, np.random.default_rng(seed))
+            .into_amplitude_network("0" * qubits)
+        )
+    return simplify_network(raw)
+
+
+def _greedy_paths(tn):
+    res = Greedy(OptMethod.GREEDY).find_path(tn)
+    return res, res.ssa_path.toplevel, res.replace_path().toplevel
+
+
+def _slicing_for(ev, removed):
+    ordered = sorted(removed)
+    return Slicing(tuple(ordered), tuple(ev.dims[l] for l in ordered))
+
+
+# -- exactness vs the replay oracles -------------------------------------
+
+
+@pytest.mark.parametrize("kind,seed", [("line", 0), ("brickwork", 3),
+                                       ("qaoa", 7)])
+def test_evaluator_exact_vs_oracles_random_slice_sets(kind, seed):
+    tn = _network(kind, seed)
+    inputs = list(tn.tensors)
+    _, _, replace = _greedy_paths(tn)
+    ev = SlicedCostEvaluator(inputs, replace)
+    rng = random.Random(seed)
+    closed = [l for l in ev.dims if ev.sliceable(l)]
+    removed = set()
+    for _ in range(50):
+        if removed and rng.random() < 0.4:
+            leg = rng.choice(sorted(removed))
+            ev.drop_leg(leg)
+            removed.discard(leg)
+        else:
+            pool = [l for l in closed if l not in removed]
+            if not pool:
+                continue
+            leg = rng.choice(pool)
+            ev.add_leg(leg)
+            removed.add(leg)
+        s = _slicing_for(ev, removed)
+        # bitwise-equal counts vs every oracle (power-of-two dims)
+        assert ev.per_slice_flops() == _reduced_flops(
+            inputs, replace, removed
+        )
+        assert ev.sliced_total() == sliced_flops(inputs, replace, s)
+        assert ev.peak() == sliced_peak(inputs, replace, s)
+        inv, res_, total = hoisted_sliced_flops(inputs, replace, s)
+        assert ev.hoist_split() == (inv, res_)
+        assert ev.hoisted_total() == total
+        assert ev.num_slices == s.num_slices
+
+
+def test_evaluator_degenerate_one_slice_and_all_variant():
+    # 1-slice (empty removal set): the hoist pass no-ops — nothing
+    # cached, everything residual (the PR 7 accounting fix)
+    tn = _network("brickwork", 1, qubits=10, depth=6)
+    inputs = list(tn.tensors)
+    _, _, replace = _greedy_paths(tn)
+    ev = SlicedCostEvaluator(inputs, replace)
+    assert ev.hoist_split() == (0.0, ev.per_slice_flops())
+    assert ev.hoisted_total() == ev.per_slice_flops()
+    assert ev.num_slices == 1
+    assert ev.hoist_split() == hoisted_sliced_flops(
+        inputs, replace, Slicing((), ())
+    )[:2]
+
+    # all-variant: a caterpillar path over a line network where leaf 0
+    # participates in every step — slicing one of its legs makes every
+    # step variant, and the accounting must degrade to the same no-op
+    ts = [LeafTensor.from_const([0, 1], 2), LeafTensor.from_const([1, 2], 2),
+          LeafTensor.from_const([2, 3], 2), LeafTensor.from_const([3, 0], 2)]
+    cat = [(0, 1), (0, 2), (0, 3)]
+    ev2 = SlicedCostEvaluator(ts, cat, removed=(1,))
+    assert all(v > 0 for v, a in zip(ev2._vcount, ev2._active) if a)
+    s = Slicing((1,), (2,))
+    inv, res_, total = hoisted_sliced_flops(ts, cat, s)
+    assert inv == 0.0
+    assert ev2.hoist_split() == (inv, res_)
+    assert ev2.hoisted_total() == total == sliced_flops(ts, cat, s)
+
+
+def test_evaluator_seconds_matches_stem_accountant():
+    from tnc_tpu.obs.calibrate import CalibratedCostModel
+
+    model = CalibratedCostModel(
+        flops_per_s=1e11, dispatch_s=2e-5, bytes_per_s=1e10
+    )
+    tn = _network("brickwork", 5, qubits=12, depth=10)
+    inputs = list(tn.tensors)
+    _, _, replace = _greedy_paths(tn)
+    ev = SlicedCostEvaluator(inputs, replace, cost_model=model)
+    acct = StemAccountant(inputs, replace, cost_model=model)
+    rng = random.Random(9)
+    closed = [l for l in ev.dims if ev.sliceable(l)]
+    removed = set()
+    for _ in range(12):
+        leg = rng.choice([l for l in closed if l not in removed])
+        ev.add_leg(leg)
+        removed.add(leg)
+        per_slice = _make_replayer(inputs, replace).flops(removed)
+        assert ev.cost() == acct.hoisted_cost(
+            removed, per_slice, ev.num_slices
+        )
+
+
+def test_delta_updates_equal_from_scratch_under_random_moves():
+    tn = _network("brickwork", 5, qubits=12, depth=10)
+    inputs = list(tn.tensors)
+    _, ssa, _ = _greedy_paths(tn)
+    tree = ContractionTree.from_ssa_path(inputs, ssa)
+    full_dims = dict(tree.dims)
+    ev = SlicedCostEvaluator.from_tree(tree, dims=full_dims)
+    rng = random.Random(17)
+    closed = [l for l in full_dims if ev.sliceable(l)]
+    internal = [i for i, nd in enumerate(tree.nodes) if not nd.is_leaf]
+    removed = set()
+    for step in range(150):
+        r = rng.random()
+        if r < 0.25 and closed:
+            if removed and rng.random() < 0.5:
+                leg = rng.choice(sorted(removed))
+                ev.drop_leg(leg)
+                removed.discard(leg)
+            else:
+                pool = [l for l in closed if l not in removed]
+                if pool:
+                    leg = rng.choice(pool)
+                    ev.add_leg(leg)
+                    removed.add(leg)
+        elif r < 0.85:
+            p = internal[rng.randrange(len(internal))]
+            if not tree._reachable(p):
+                continue
+            cands = list(_rotation_candidates(tree, p))
+            if not cands:
+                continue
+            x, a, b, c = cands[rng.randrange(len(cands))]
+            keep, other = (a, b) if rng.random() < 0.5 else (b, a)
+            _apply_rotation(tree, p, x, keep, other, c)
+            ev.sync_nodes(tree, [x, p])
+        else:
+            # a DP splice batch through the sliced acceptance path
+            tree.reconfigure(6, 1, sliced=SlicedReconfState(ev, None))
+        if step % 10 == 0:
+            fresh = SlicedCostEvaluator.from_tree(
+                tree, removed=sorted(removed), dims=full_dims
+            )
+            assert ev.per_slice_flops() == fresh.per_slice_flops()
+            assert ev.peak() == fresh.peak()
+            assert ev.hoist_split() == fresh.hoist_split()
+            # and the tree's current path agrees with the replay oracle
+            rep = ssa_replace_ordering(
+                ContractionPath.simple(tree.to_ssa_path())
+            ).toplevel
+            s = _slicing_for(ev, removed)
+            assert ev.sliced_total() == sliced_flops(inputs, rep, s)
+            assert ev.peak() == sliced_peak(inputs, rep, s)
+
+
+def test_evaluator_validation_errors():
+    ts = [LeafTensor.from_const([0, 1], 2), LeafTensor.from_const([1, 2], 2),
+          LeafTensor.from_const([2, 0], 2)]
+    ev = SlicedCostEvaluator(ts, [(0, 1), (0, 2)])
+    ev.add_leg(1)
+    with pytest.raises(ValueError):
+        ev.add_leg(1)
+    with pytest.raises(ValueError):
+        ev.add_leg(99)
+    with pytest.raises(ValueError):
+        ev.drop_leg(2)
+    ev.drop_leg(1)
+    assert ev.removed == frozenset()
+
+
+def test_evaluator_rescore_10x_faster_than_slice_and_reconfigure():
+    """The acceptance bar: on a >=100-tensor network the evaluator
+    rescoring a slice set must be at least 10x faster than a full
+    slice_and_reconfigure rescore — that's what lets it run once per
+    trial instead of once per finalist."""
+    tn = _network("line", 7, qubits=24, depth=16)  # 153 cores
+    inputs = list(tn.tensors)
+    assert len(inputs) >= 100
+    _, ssa, replace = _greedy_paths(tn)
+    target = 2.0**8
+
+    t0 = time.perf_counter()
+    pairs, slicing = slice_and_reconfigure(
+        inputs, ssa, target, reconf_rounds=1, step_budget=None,
+        final_rounds=2, final_budget=None,
+    )
+    t_full = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    ev = SlicedCostEvaluator(inputs, replace, removed=slicing.legs)
+    cost = ev.cost()
+    peak = ev.peak()
+    t_ev = time.perf_counter() - t0
+
+    assert cost > 0 and peak > 0
+    assert t_full > 10.0 * t_ev, (
+        f"evaluator rescore {t_ev:.4f}s vs full repair {t_full:.4f}s "
+        f"({t_full / max(t_ev, 1e-9):.1f}x)"
+    )
+
+
+# -- greedy slice maintenance + joint search ------------------------------
+
+
+def test_greedy_slice_to_target_meets_budget():
+    tn = _network("brickwork", 5, qubits=12, depth=10)
+    inputs = list(tn.tensors)
+    _, _, replace = _greedy_paths(tn)
+    ev = SlicedCostEvaluator(inputs, replace)
+    target = 2.0**8
+    assert ev.peak() > target
+    greedy_slice_to_target(ev, target)
+    assert ev.peak() <= target
+    s = _slicing_for(ev, ev.removed)
+    assert sliced_peak(inputs, replace, s) <= target
+    # unreachable target raises instead of looping
+    ev2 = SlicedCostEvaluator(inputs, replace)
+    with pytest.raises(ValueError):
+        greedy_slice_to_target(ev2, 2.0)
+
+
+def test_joint_slice_search_beats_or_ties_post_pass():
+    tn = _network("brickwork", 5, qubits=12, depth=10)
+    inputs = list(tn.tensors)
+    _, ssa, _ = _greedy_paths(tn)
+    target = 2.0**8
+    pairs, post_sl = slice_and_reconfigure(
+        inputs, ssa, target, reconf_rounds=1, step_budget=None,
+        final_rounds=2, final_budget=None,
+    )
+    _, _, post_hoisted = hoisted_sliced_flops(inputs, pairs, post_sl)
+
+    jp, jsl, jcost = joint_slice_search(inputs, ssa, target, seed=42)
+    jrep = ssa_replace_ordering(ContractionPath.simple(jp)).toplevel
+    assert sliced_peak(inputs, jrep, jsl) <= target
+    _, _, joint_hoisted = hoisted_sliced_flops(inputs, jrep, jsl)
+    assert jcost == joint_hoisted  # the returned cost is honest
+    assert joint_hoisted <= post_hoisted
+    # determinism for a fixed seed
+    jp2, jsl2, jcost2 = joint_slice_search(inputs, ssa, target, seed=42)
+    assert (jp2, jsl2, jcost2) == (jp, jsl, jcost)
+
+
+def test_joint_slice_search_never_worse_than_its_seed():
+    tn = _network("brickwork", 3, qubits=12, depth=8)
+    inputs = list(tn.tensors)
+    _, ssa, replace = _greedy_paths(tn)
+    target = 2.0**7
+    ev = SlicedCostEvaluator(inputs, replace)
+    greedy_slice_to_target(ev, target)
+    seed_cost = ev.cost()
+    _, _, jcost = joint_slice_search(
+        inputs, ssa, target, seed_slices=sorted(ev.removed), seed=1
+    )
+    assert jcost <= seed_cost
+
+
+def test_sliced_reconfigure_improves_and_respects_budget():
+    tn = _network("brickwork", 5, qubits=12, depth=10)
+    inputs = list(tn.tensors)
+    _, ssa, _ = _greedy_paths(tn)
+    tree = ContractionTree.from_ssa_path(inputs, ssa)
+    full_dims = dict(tree.dims)
+    tree.dims = dict(tree.dims)
+    ev = SlicedCostEvaluator.from_tree(tree, dims=full_dims)
+    target = 2.0**8
+    greedy_slice_to_target(ev, target)
+    for leg in ev.removed:
+        tree.dims[leg] = 1
+    before = ev.cost()
+    tree.reconfigure(10, 2, sliced=SlicedReconfState(ev, target))
+    assert ev.cost() <= before
+    assert ev.peak() <= target
+    # the evaluator stayed exact through accepted AND reverted splices
+    fresh = SlicedCostEvaluator.from_tree(
+        tree, removed=sorted(ev.removed), dims=full_dims
+    )
+    assert ev.per_slice_flops() == fresh.per_slice_flops()
+    assert ev.hoist_split() == fresh.hoist_split()
+    assert ev.peak() == fresh.peak()
+
+
+# -- seed_slices warm start ----------------------------------------------
+
+
+def test_seed_slices_warm_start_never_worse_at_equal_rounds():
+    tn = _network("brickwork", 5, qubits=12, depth=10)
+    inputs = list(tn.tensors)
+    _, ssa, _ = _greedy_paths(tn)
+    target = 2.0**8
+    kwargs = dict(
+        reconf_rounds=1, step_budget=None, final_rounds=2,
+        final_budget=None,
+    )
+    cold_pairs, cold_sl = slice_and_reconfigure(
+        inputs, ssa, target, **kwargs
+    )
+    _, _, cold_cost = hoisted_sliced_flops(inputs, cold_pairs, cold_sl)
+
+    seeded_pairs, seeded_sl = slice_and_reconfigure(
+        inputs, ssa, target, seed_slices=cold_sl, **kwargs
+    )
+    assert sliced_peak(inputs, seeded_pairs, seeded_sl) <= target
+    _, _, seeded_cost = hoisted_sliced_flops(
+        inputs, seeded_pairs, seeded_sl
+    )
+    assert seeded_cost <= cold_cost
+
+
+def test_seed_slices_invalid_seeds_are_skipped():
+    # open legs, unknown legs, and dim-1 legs in the seed must be
+    # ignored, not sliced
+    tn = _network("brickwork", 5, qubits=12, depth=10)
+    inputs = list(tn.tensors)
+    res, ssa, _ = _greedy_paths(tn)
+    target = 2.0**8
+    bogus = (10**9, 10**9 + 1)
+    pairs, slicing = slice_and_reconfigure(
+        inputs, ssa, target, seed_slices=bogus,
+        reconf_rounds=1, step_budget=None, final_rounds=2,
+        final_budget=None,
+    )
+    assert not set(bogus) & set(slicing.legs)
+    assert sliced_peak(inputs, pairs, slicing) <= target
+
+
+# -- hyper joint mode -----------------------------------------------------
+
+
+def _hyper(joint, target):
+    return Hyperoptimizer(
+        ntrials=4, seed=42, target_size=target, polish_rounds=1,
+        polish_steps=400, reconfigure_budget=None, joint_slicing=joint,
+        joint_sa_steps=600, joint_sa_rounds=1,
+    )
+
+
+def test_hyper_joint_mode_beats_or_ties_post_pass_pipeline():
+    tn = _network("brickwork", 5, qubits=12, depth=10)
+    inputs = list(tn.tensors)
+    target = 2.0**8
+
+    def pipeline(joint):
+        hy = _hyper(joint, target)
+        result = hy.find_path(tn)
+        seed = hy.last_slicing
+        pairs, slicing = slice_and_reconfigure(
+            inputs, result.ssa_path.toplevel, target,
+            reconf_rounds=1, step_budget=None, final_rounds=2,
+            final_budget=None,
+            seed_slices=seed.legs if seed is not None else None,
+        )
+        _, _, hoisted = hoisted_sliced_flops(inputs, pairs, slicing)
+        return hoisted, pairs, slicing, hy
+
+    post_cost, _, _, post_hy = pipeline(False)
+    joint_cost, jpairs, jslicing, joint_hy = pipeline(True)
+    assert post_hy.last_slicing is None  # post mode never exposes seeds
+    assert joint_hy.last_slicing is not None
+    assert joint_hy.last_slicing.num_slices > 1
+    assert sliced_peak(inputs, jpairs, jslicing) <= target
+    assert joint_cost <= post_cost
+
+
+def test_hyper_joint_mode_deterministic():
+    tn = _network("brickwork", 5, qubits=12, depth=10)
+    target = 2.0**8
+    a = _hyper(True, target).find_path(tn)
+    b = _hyper(True, target).find_path(tn)
+    assert a.ssa_path.toplevel == b.ssa_path.toplevel
+
+
+def test_hyper_unsliced_budget_keeps_flat_plan():
+    # a budget the plan already fits: joint mode must not slice, must
+    # not expose a seed, and the plan should match the classic mode
+    tn = _network("brickwork", 1, qubits=10, depth=6)
+    target = 2.0**20
+    hy = _hyper(True, target)
+    result = hy.find_path(tn)
+    assert hy.last_slicing is None
+    assert result.size <= target
+
+
+def test_sliced_score_memoized_across_snapshots(monkeypatch):
+    """The inf-fallback and polish snapshots re-request already-scored
+    candidates; the repair pass must run at most once per unique
+    path (satellite: memoize sliced_score)."""
+    import tnc_tpu.contractionpath.slicing as slicing_mod
+
+    calls: dict[tuple, int] = {}
+    real = slicing_mod.slice_and_reconfigure
+
+    def counting(inputs, ssa_path, target_size, **kw):
+        key = tuple(ssa_path)
+        calls[key] = calls.get(key, 0) + 1
+        return real(inputs, ssa_path, target_size, **kw)
+
+    monkeypatch.setattr(
+        slicing_mod, "slice_and_reconfigure", counting
+    )
+    tn = _network("brickwork", 1, qubits=10, depth=6)
+    # unreachable budget: every candidate scores inf and the fallback
+    # path re-requests the winner's score — a guaranteed repeat that
+    # only the memo absorbs
+    hy = Hyperoptimizer(
+        ntrials=2, seed=42, target_size=2.0, polish_rounds=1,
+        polish_steps=200, reconfigure_budget=None, joint_slicing=False,
+    )
+    hy.find_path(tn)
+    assert calls, "sliced scoring never ran"
+    assert max(calls.values()) == 1, (
+        "slice_and_reconfigure ran repeatedly on the same candidate"
+    )

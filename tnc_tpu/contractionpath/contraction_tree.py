@@ -352,6 +352,34 @@ class ContractionTree:
             last = node_id
         assert last == top
 
+    def _local_pairs(
+        self, top: int, frontier: list[int]
+    ) -> list[tuple[int, int]]:
+        """The subtree-internal structure of ``top`` down to
+        ``frontier``, as local ssa pairs over the frontier order — the
+        inverse of :meth:`_splice` (re-splicing these pairs restores
+        the structure), used to revert a rejected sliced-objective
+        splice."""
+        local_of = {f: i for i, f in enumerate(frontier)}
+        frontier_set = set(frontier)
+        order: list[int] = []
+        stack = [top]
+        while stack:
+            i = stack.pop()
+            if i in frontier_set:
+                continue
+            order.append(i)
+            stack.append(self.nodes[i].left)
+            stack.append(self.nodes[i].right)
+        pairs: list[tuple[int, int]] = []
+        next_local = len(frontier)
+        for i in reversed(order):  # children precede parents
+            nd = self.nodes[i]
+            pairs.append((local_of[nd.left], local_of[nd.right]))
+            local_of[i] = next_local
+            next_local += 1
+        return pairs
+
     def reconfigure(
         self,
         subtree_size: int = 8,
@@ -359,6 +387,7 @@ class ContractionTree:
         minimize: str = "flops",
         time_budget: float | None = None,
         logsize_cap: float = -1.0,
+        sliced=None,
     ) -> None:
         """Iterative subtree reconfiguration, in place.
 
@@ -368,6 +397,15 @@ class ContractionTree:
         improvement, or when ``time_budget`` seconds elapse (the reference
         gives its optimizers explicit time budgets too,
         ``benchmark/src/main.rs:63``).
+
+        ``sliced``: a :class:`~tnc_tpu.contractionpath.sliced_cost.
+        SlicedReconfState` switches splice *acceptance* to the sliced
+        objective — the DP still proposes orders in this tree's (slice-
+        reduced) flop model, but a proposal is kept only when the
+        attached incremental evaluator's hoisted sliced cost does not
+        regress and the sliced peak stays within the budget; rejected
+        splices are reverted exactly (:meth:`_local_pairs`). This is the
+        "tree reconfigure move" half of the joint tree+slice search.
         """
         import time
 
@@ -401,9 +439,25 @@ class ContractionTree:
                     continue
                 new_cost, pairs = result
                 old_cost = self._subtree_cost(top, set(frontier), minimize)
-                if new_cost < old_cost * (1 - 1e-12):
+                if not new_cost < old_cost * (1 - 1e-12):
+                    continue
+                if sliced is None:
                     self._splice(top, frontier, pairs)
                     improved = True
+                    continue
+                ev = sliced.evaluator
+                old_pairs = self._local_pairs(top, frontier)
+                old_internal = ev.subtree_internal(self, top, frontier)
+                cost_before = ev.cost()
+                peak_bound = sliced.peak_bound()
+                self._splice(top, frontier, pairs)
+                ev.sync_splice(self, top, frontier, old_internal)
+                if ev.cost() <= cost_before and ev.peak() <= peak_bound:
+                    improved = True
+                else:
+                    undo = ev.subtree_internal(self, top, frontier)
+                    self._splice(top, frontier, old_pairs)
+                    ev.sync_splice(self, top, frontier, undo)
             if not improved:
                 break
 

@@ -72,6 +72,9 @@ class Hyperoptimizer(Pathfinder):
         polish_steps: int = 8000,
         polish_temps: tuple[float, float] = (0.3, 0.01),
         objective: PathObjective | None = None,
+        joint_slicing: bool = True,
+        joint_sa_steps: int = 1200,
+        joint_sa_rounds: int = 2,
     ) -> None:
         """``objective``: a :class:`~tnc_tpu.contractionpath.
         contraction_cost.PathObjective` that overrides ``minimize`` for
@@ -89,6 +92,25 @@ class Hyperoptimizer(Pathfinder):
         flops* after greedy slicing to ``target_size`` peak elements,
         not by raw flops (a slightly worse raw path that slices well is
         the better plan on HBM-bound networks).
+
+        ``joint_slicing`` (default on, engages only with a
+        ``target_size``): slicing becomes a first-class dimension of
+        the search instead of a post-pass. EVERY trial carries a
+        greedily-maintained slice set and is ranked by its hoisted
+        sliced cost under the budget (the incremental
+        :class:`~tnc_tpu.contractionpath.sliced_cost.
+        SlicedCostEvaluator` makes that a per-trial price, not a
+        per-finalist one), and finalists are refined by the joint
+        tree+slice SA (:func:`~tnc_tpu.contractionpath.sliced_cost.
+        joint_slice_search`: rotation moves ⇄ slice-set swap moves ⇄
+        exact-DP reconfiguration, all accepted under the sliced
+        objective) with a classic ``slice_and_reconfigure`` repair as a
+        quality floor. The winning slice set is exposed as
+        ``last_slicing`` so callers seed their repair pass from it.
+        ``joint_slicing=False`` forces the old optimize-then-slice
+        post-pass mode (A/B comparisons; scripts/planner_quality.py
+        records both). ``joint_sa_steps`` / ``joint_sa_rounds`` bound
+        the per-finalist SA work.
 
         ``polish_rounds``: the winner gets an annealing polish — rounds
         of subtree rotations at a cooling temperature interleaved with
@@ -115,8 +137,17 @@ class Hyperoptimizer(Pathfinder):
         self.polish_steps = polish_steps
         self.polish_temps = polish_temps
         self.objective = objective
+        self.joint_slicing = joint_slicing
+        self.joint_sa_steps = joint_sa_steps
+        self.joint_sa_rounds = joint_sa_rounds
+        #: the slice set of the most recent winning plan (joint mode
+        #: only; ``None`` when the winner fits the budget unsliced) —
+        #: callers seed ``slice_and_reconfigure(seed_slices=...)`` with
+        #: it so the post repair is a thin pass, not a fresh search
+        self.last_slicing = None
 
     def _solve_toplevel(self, inputs: list[LeafTensor]) -> list[tuple[int, int]]:
+        self.last_slicing = None
         n = len(inputs)
         if n <= 2:
             return [(0, 1)] if n == 2 else []
@@ -152,6 +183,8 @@ class Hyperoptimizer(Pathfinder):
             )
             return flops if self.minimize == "flops" else size
 
+        sliced_cache: dict[tuple, float] = {}
+
         def sliced_score(candidate: list[tuple[int, int]]) -> float:
             """Cost after slicing to the HBM target *with repair*: a
             light slice-and-reconfigure pass, scored under the active
@@ -159,13 +192,21 @@ class Hyperoptimizer(Pathfinder):
             predicted seconds under a calibrated objective). Plain
             greedy slicing without repair wildly misranks low-flops
             candidates (their naive slicing overhead is enormous, but
-            reconfiguration recovers most of it)."""
+            reconfiguration recovers most of it). Memoized on the
+            candidate path — annealing-polish snapshots repeat already
+            scored trees (and the inf-fallback re-scores the winner),
+            and the repair pass is far too expensive to re-run on a
+            repeat."""
             from tnc_tpu.contractionpath.slicing import (
                 slice_and_reconfigure,
                 sliced_flops,
             )
 
             assert self.target_size is not None
+            key = tuple(candidate)
+            hit = sliced_cache.get(key)
+            if hit is not None:
+                return hit
             try:
                 # Work-bounded repair (rounds only, no wall-clock
                 # deadline) so candidate ranking is reproducible
@@ -180,20 +221,173 @@ class Hyperoptimizer(Pathfinder):
                     final_budget=None,
                 )
             except ValueError:
+                sliced_cache[key] = math.inf
                 return math.inf
             if self.objective is not None:
-                return self.objective.sliced_path_cost(
+                score = self.objective.sliced_path_cost(
                     inputs, replace, slicing
                 )
-            return sliced_flops(inputs, replace, slicing)
+            else:
+                score = sliced_flops(inputs, replace, slicing)
+            sliced_cache[key] = score
+            return score
 
-        ranked = sorted(candidates, key=evaluate)
+        use_joint = self.target_size is not None and self.joint_slicing
+        cost_model = getattr(self.objective, "cost_model", None)
+        # trial key -> (greedy sliced cost, greedy slice legs)
+        rank_cache: dict[tuple, tuple[float, tuple[int, ...]]] = {}
+        # trial key -> (refined cost, refined ssa pairs, Slicing | None)
+        final_cache: dict[tuple, tuple] = {}
+
+        def trial_sliced_rank(candidate: list[tuple[int, int]]) -> float:
+            """Joint mode, stage 1: EVERY trial carries a greedily
+            maintained slice set under the budget and is ranked by its
+            hoisted sliced cost (seconds under a calibrated objective)
+            — the incremental evaluator prices a trial in O(deltas)
+            where the classic pipeline paid a full
+            slice-and-reconfigure per finalist."""
+            key = tuple(candidate)
+            hit = rank_cache.get(key)
+            if hit is not None:
+                return hit[0]
+            from tnc_tpu.contractionpath.sliced_cost import (
+                SlicedCostEvaluator,
+                greedy_slice_to_target,
+            )
+
+            replace = ssa_replace_ordering(
+                ContractionPath.simple(list(candidate))
+            ).toplevel
+            ev = SlicedCostEvaluator(inputs, replace, cost_model=cost_model)
+            try:
+                greedy_slice_to_target(ev, self.target_size)
+                entry = (ev.cost(), tuple(sorted(ev.removed)))
+            except ValueError:
+                entry = (math.inf, ())
+            rank_cache[key] = entry
+            return entry[0]
+
+        def joint_final(candidate: list[tuple[int, int]]) -> tuple:
+            """Joint mode, stage 2 (finalists + polish snapshots):
+            refine tree and slice set TOGETHER (SA rotations ⇄ slice
+            swaps ⇄ sliced-objective DP reconfiguration), floored by
+            the classic bounded repair so the joint mode can only match
+            or beat the post-pass pipeline. Memoized like
+            :func:`sliced_score`."""
+            key = tuple(candidate)
+            hit = final_cache.get(key)
+            if hit is not None:
+                return hit
+            from tnc_tpu.contractionpath.sliced_cost import (
+                SlicedCostEvaluator,
+                joint_slice_search,
+            )
+            from tnc_tpu.contractionpath.slicing import (
+                slice_and_reconfigure,
+            )
+
+            score0 = trial_sliced_rank(candidate)
+            seed_legs = rank_cache[tuple(candidate)][1]
+            if math.isinf(score0):
+                entry = (math.inf, list(candidate), None, math.inf)
+            elif not seed_legs:
+                # fits the budget unsliced: nothing to search jointly
+                entry = (score0, list(candidate), None, score0)
+            else:
+                pairs, slicing, cost = joint_slice_search(
+                    inputs,
+                    candidate,
+                    self.target_size,
+                    seed_slices=seed_legs,
+                    cost_model=cost_model,
+                    sa_steps=self.joint_sa_steps,
+                    sa_rounds=self.joint_sa_rounds,
+                    seed=self.seed,
+                    temps=self.polish_temps,
+                )
+                legacy_floor = math.inf
+                try:
+                    replace2, s2 = slice_and_reconfigure(
+                        inputs,
+                        candidate,
+                        self.target_size,
+                        reconf_rounds=1,
+                        step_budget=None,
+                        final_rounds=2,
+                        final_budget=None,
+                        cost_model=cost_model,
+                    )
+                except ValueError:
+                    replace2 = None
+                if replace2 is not None:
+                    from tnc_tpu.contractionpath.slicing import (
+                        sliced_flops,
+                    )
+
+                    ev2 = SlicedCostEvaluator(
+                        inputs,
+                        list(replace2),
+                        removed=s2.legs,
+                        cost_model=cost_model,
+                    )
+                    floor_cost = ev2.cost()
+                    # the score the POST-PASS pipeline would have given
+                    # this candidate (sliced_score's metric) — used to
+                    # find the trajectory that pipeline would polish
+                    legacy_floor = (
+                        self.objective.sliced_path_cost(
+                            inputs, replace2, s2
+                        )
+                        if self.objective is not None
+                        else sliced_flops(inputs, replace2, s2)
+                    )
+                entry = (cost, pairs, slicing, legacy_floor)
+                if replace2 is not None and floor_cost < cost:
+                    from tnc_tpu.contractionpath.contraction_path import (
+                        replace_ssa_ordering,
+                    )
+
+                    entry = (
+                        floor_cost,
+                        replace_ssa_ordering(list(replace2), len(inputs)),
+                        s2,
+                        legacy_floor,
+                    )
+            final_cache[key] = entry
+            return entry
+
+        ranked = sorted(
+            candidates, key=trial_sliced_rank if use_joint else evaluate
+        )
 
         # Refine the best few candidates by exact-DP subtree
         # reconfiguration (the reference's TreeReconfigure capability,
         # natively): different bisection trees settle into different
         # local minima, so refining several beats refining one.
-        finalists = ranked[: max(1, self.reconfigure_top)]
+        top = max(1, self.reconfigure_top)
+        finalists = ranked[:top]
+        evaluate_side: list[list[tuple[int, int]]] = []
+        if use_joint:
+            # hedge the finalist pool with the raw-objective ranking:
+            # greedy-maintained slice sets are unrepaired, and on
+            # treewidth-class networks they misrank candidates whose
+            # slicing overhead repair would recover — carrying the
+            # post-pass pipeline's own finalists (plus its unrefined
+            # guard) means the per-finalist repair floor covers every
+            # candidate that pipeline could have picked
+            evaluate_side = sorted(candidates, key=evaluate)[:top]
+            seen_f: set[tuple] = set()
+            finalists = []
+            for candidate in ranked[:top] + evaluate_side:
+                key = tuple(candidate)
+                if key not in seen_f:
+                    seen_f.add(key)
+                    finalists.append(candidate)
+        # the post-pass pipeline's candidate pool, rebuilt inside the
+        # joint pool (refined below in lockstep): polish is strongly
+        # path-dependent, so the joint mode must also anneal the exact
+        # trajectory that pipeline would have polished
+        post_pool: list[list[tuple[int, int]]] = []
         if self.reconfigure_rounds > 0:
             from tnc_tpu.contractionpath.contraction_tree import ContractionTree
 
@@ -207,9 +401,20 @@ class Hyperoptimizer(Pathfinder):
                     time_budget=self.reconfigure_budget,
                 )
                 refined.append(tree.to_ssa_path())
+            if use_joint:
+                eval_keys = {tuple(c) for c in evaluate_side}
+                post_pool = [
+                    r
+                    for f, r in zip(finalists, refined)
+                    if tuple(f) in eval_keys
+                ]
+                post_pool.append(evaluate_side[0])
             # The refined trees dominate their raw versions on both raw
             # and sliced scores; keep the best raw candidate as a guard.
-            finalists = refined + [ranked[0]]
+            finalists = refined + [ranked[0]] + post_pool[-1:]
+        elif use_joint:
+            post_pool = list(evaluate_side)
+            finalists = finalists + post_pool[:1]
 
         # Dedup (reconfigure often leaves a good tree unchanged) so the
         # expensive sliced_score never runs twice on the same path.
@@ -222,7 +427,10 @@ class Hyperoptimizer(Pathfinder):
                 unique.append(candidate)
 
         if self.target_size is not None:
-            scored = [(sliced_score(c), c) for c in unique]
+            score_fn = (
+                (lambda c: joint_final(c)[0]) if use_joint else sliced_score
+            )
+            scored = [(score_fn(c), c) for c in unique]
             winner_score, winner = min(scored, key=lambda p: p[0])
             if math.isinf(winner_score):
                 # No finalist could be sliced to the target: fall back to
@@ -230,8 +438,8 @@ class Hyperoptimizer(Pathfinder):
                 # inf-scored pick would defer the failure to the caller's
                 # own slicing attempt, far from this decision).
                 winner = min(unique, key=evaluate)
-                winner_score = sliced_score(winner)
-            final_score = sliced_score
+                winner_score = score_fn(winner)
+            final_score = score_fn
         else:
             winner = min(unique, key=evaluate)
             winner_score = evaluate(winner)
@@ -240,11 +448,38 @@ class Hyperoptimizer(Pathfinder):
         # Annealing polish: every round's snapshot competes under the
         # SAME objective as the final selection (in slicing-aware mode a
         # raw-flops-worse tree can be the sliced-flops winner).
+        polish_seeds = [winner]
+        if use_joint and post_pool:
+            # polish is strongly path-dependent (on treewidth-class
+            # networks it cuts the final plan several-fold), so the
+            # joint mode also anneals the trajectory the POST-PASS
+            # pipeline would have polished: the winner of ITS OWN
+            # finalist pool under ITS OWN scoring (the classic
+            # bounded-repair floor). Without this hedge a
+            # sliced-selection winner whose basin polishes poorly can
+            # lose to the old pipeline.
+            floor_winner = min(
+                post_pool, key=lambda c: joint_final(c)[3]
+            )
+            if tuple(floor_winner) != tuple(winner):
+                polish_seeds.append(floor_winner)
         best_path, best_score = winner, winner_score
-        for snapshot in self._polish(inputs, winner):
-            s = final_score(snapshot)
-            if s < best_score:
-                best_path, best_score = snapshot, s
+        for polish_seed in polish_seeds:
+            for snapshot in self._polish(inputs, polish_seed):
+                s = final_score(snapshot)
+                if s < best_score:
+                    best_path, best_score = snapshot, s
+        if use_joint:
+            # the winner's *refined* tree (the joint search moved it)
+            # and its slice set are the plan; expose the slice set so
+            # the caller's slice_and_reconfigure is a seeded thin
+            # repair instead of a fresh post-pass search
+            _, refined_pairs, slicing, _ = joint_final(best_path)
+            if refined_pairs is not None and not math.isinf(
+                final_score(best_path)
+            ):
+                self.last_slicing = slicing
+                return refined_pairs
         return best_path
 
     def _run_trials(

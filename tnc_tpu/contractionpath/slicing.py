@@ -32,6 +32,21 @@ from tnc_tpu import obs
 from tnc_tpu.contractionpath.contraction_path import ContractionPath
 from tnc_tpu.tensornetwork.tensor import LeafTensor
 
+__all__ = [
+    "Slicing",
+    "StemAccountant",
+    "SlicedCostEvaluator",
+    "find_slicing",
+    "find_parallel_slicing",
+    "flat_replace_path",
+    "greedy_slice_to_target",
+    "hoisted_sliced_flops",
+    "joint_slice_search",
+    "slice_and_reconfigure",
+    "sliced_flops",
+    "sliced_peak",
+]
+
 
 @dataclass(frozen=True)
 class Slicing:
@@ -503,6 +518,7 @@ def slice_and_reconfigure(
     max_slices: int = 1 << 26,
     max_leg_candidates: int = 48,
     cost_model=None,
+    seed_slices: "Sequence[int] | Slicing | None" = None,
 ) -> tuple[list[tuple[int, int]], Slicing]:
     """Interleaved slicing + subtree reconfiguration (cotengra's
     ``slicing_reconf`` approach): repeatedly slice a leg of the peak
@@ -528,6 +544,14 @@ def slice_and_reconfigure(
     :class:`~tnc_tpu.obs.calibrate.CalibratedCostModel`) switches leg
     scoring from hoisted flop counts to predicted seconds, charging
     each extra slice its real dispatch overhead.
+
+    ``seed_slices`` (legs, or a :class:`Slicing`) warm-starts the
+    removal set — the joint hyper search hands its winning slice set
+    over so this pass degrades to a thin repair (one reconfigure over
+    the pre-reduced model, usually zero candidate-leg searches), and a
+    cached plan's slice set warm-starts replanning the same structure.
+    Invalid seeds (open legs, dim 1, unknown) are skipped; the loop
+    still extends the set when the seeded peak misses the target.
     """
     from tnc_tpu.contractionpath.contraction_path import (
         ContractionPath,
@@ -550,6 +574,26 @@ def slice_and_reconfigure(
 
     removed: set[int] = set()
     num_slices = 1
+    # Seeds restrict the candidate pool, they don't bypass the loop:
+    # each round scores only the remaining seed legs (instead of up to
+    # max_leg_candidates peak-step legs) with the SAME (peak, hoisted
+    # cost) key and the same interleaved repair cadence. Seeding with a
+    # cold run's own slice set on the same path therefore replays that
+    # run's trajectory — never worse at equal rounds — while skipping
+    # most of its candidate-replay cost; once the pool is exhausted the
+    # normal search resumes for any legs the seed missed.
+    seed_pool: set[int] = set()
+    if seed_slices is not None:
+        seed_legs = (
+            seed_slices.legs
+            if isinstance(seed_slices, Slicing)
+            else seed_slices
+        )
+        seed_pool = {
+            leg
+            for leg in seed_legs
+            if leg in dims and leg not in open_legs and dims[leg] > 1
+        }
     while True:
         replace = ssa_replace_ordering(
             ContractionPath.simple(tree.to_ssa_path())
@@ -565,14 +609,18 @@ def slice_and_reconfigure(
         # diverge between native and Python-fallback machines (this is
         # the order the native leg_peak already iterates in, preserving
         # the canonical prewarmed plan)
-        candidates = sorted(
-            leg
-            for leg, size in leg_peak.items()
-            if size >= peak * 0.99
-            and leg not in removed
-            and leg not in open_legs
-            and dims[leg] > 1
-        )
+        seed_pool -= removed
+        if seed_pool:
+            candidates = sorted(seed_pool)
+        else:
+            candidates = sorted(
+                leg
+                for leg, size in leg_peak.items()
+                if size >= peak * 0.99
+                and leg not in removed
+                and leg not in open_legs
+                and dims[leg] > 1
+            )
         if not candidates:
             # no sliceable leg in the peak step: fall back to any leg
             candidates = sorted(
@@ -637,6 +685,18 @@ def slice_and_reconfigure(
     return list(replace), Slicing(
         tuple(ordered), tuple(dims[l] for l in ordered)
     )
+
+
+# The incremental sliced-cost evaluator and the joint tree+slice search
+# live in their own module but belong to this layer's public surface:
+# the evaluator answers the same questions as the replay oracles above
+# (pinned bitwise-equal) with O(affected-steps) delta updates, cheap
+# enough to run inside every search loop instead of once per finalist.
+from tnc_tpu.contractionpath.sliced_cost import (  # noqa: E402
+    SlicedCostEvaluator,
+    greedy_slice_to_target,
+    joint_slice_search,
+)
 
 
 def _reduced_flops(

@@ -290,14 +290,21 @@ class BoundProgram:
 
 
 def plan_structure(
-    tn, pathfinder=None, target_size: float | None = None
+    tn, pathfinder=None, target_size: float | None = None, cost_model=None
 ):
     """Plan one amplitude structure: find a path, slice to the budget
     when needed, compile. Returns ``(path, slicing, program,
     sliced_program, result)`` — the shared planning step behind
     :func:`bind_template`'s cache-miss branch and the background
     replanner (:mod:`tnc_tpu.serve.replan`), so both produce plans with
-    identical semantics and cache records."""
+    identical semantics and cache records.
+
+    A slicing-aware pathfinder (the Hyperoptimizer's joint mode)
+    exposes its winning slice set as ``last_slicing``; the budget
+    repair here is then *seeded* with it — a thin validation pass over
+    the plan the search already priced, not a fresh post-pass slicing
+    search. ``cost_model`` keeps the repair's leg scoring in the same
+    predicted-seconds domain as a calibrated replanner."""
     from tnc_tpu.contractionpath.contraction_path import ContractionPath
 
     if pathfinder is None:
@@ -309,8 +316,11 @@ def plan_structure(
     if target_size is not None and result.size > target_size:
         from tnc_tpu.contractionpath.slicing import slice_and_reconfigure
 
+        seed = getattr(pathfinder, "last_slicing", None)
         replace_pairs, slicing = slice_and_reconfigure(
-            list(tn.tensors), result.ssa_path.toplevel, target_size
+            list(tn.tensors), result.ssa_path.toplevel, target_size,
+            cost_model=cost_model,
+            seed_slices=seed.legs if seed is not None else None,
         )
         if slicing.num_slices <= 1:
             slicing = None

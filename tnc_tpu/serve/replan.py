@@ -45,6 +45,14 @@ new entry (a cheap byte-fingerprint probe) and adopts it into its own
 running service through the identical rebuild-and-swap path — one
 replica's background search improves every replica sharing the cache
 directory (``serve.replan.shared_adopt``).
+
+Budget-constrained structures get the Hyperoptimizer's **joint
+tree+slice search** (its default with a ``target_size``): the
+background search optimizes the sliced total directly and hands its
+slice set to a seeded thin ``slice_and_reconfigure`` repair
+(:func:`~tnc_tpu.serve.rebind.plan_structure`), so the plans that
+stream into live replicas through the shared cache are sliced-optimal,
+not flop-optimal-then-sliced.
 """
 
 from __future__ import annotations
@@ -387,7 +395,8 @@ class BackgroundReplanner:
         tn = bound.template.network
         leaves = flat_leaf_tensors(tn)
         path, slicing, program, sliced, result = plan_structure(
-            tn, self.optimizer, bound.target_size
+            tn, self.optimizer, bound.target_size,
+            cost_model=self.cost_model,
         )
         candidate_cost = plan_predicted_cost(
             leaves, path.toplevel, slicing, self.objective
