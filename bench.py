@@ -1806,13 +1806,44 @@ CONFIGS = {
 }
 
 
+def _parse_serve_mix(spec: str) -> dict:
+    """``BENCH_SERVE_MIX`` parser: ``"amplitude:6,sample:1,
+    expectation:1"`` → weight per query type (types absent from the
+    spec get weight 0; unknown names are an error)."""
+    known = ("amplitude", "sample", "expectation", "marginal")
+    weights = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, w = part.partition(":")
+        name = name.strip()
+        if name not in known:
+            raise ValueError(
+                f"BENCH_SERVE_MIX: unknown query type {name!r} "
+                f"(known: {known})"
+            )
+        weight = int(w) if w.strip() else 1
+        if weight < 0:
+            raise ValueError(
+                f"BENCH_SERVE_MIX: weight for {name!r} must be >= 0"
+            )
+        weights[name] = weight
+    if not any(w > 0 for w in weights.values()):
+        raise ValueError("BENCH_SERVE_MIX selects no queries")
+    return weights
+
+
 def _serve_bench() -> dict:
-    """``--serve``: throughput/latency of the in-process amplitude
-    service (docs/serving.md). A random circuit is bound once
-    (plan+compile amortized), then BENCH_SERVE_QUERIES mixed bitstrings
-    are fired from a thread pool through the micro-batching front end;
-    the block reports queries/sec, the realized batch-size
-    distribution, and p50/p99 request latency."""
+    """``--serve``: throughput/latency of the in-process query service
+    (docs/serving.md). A random circuit is bound once (plan+compile
+    amortized), then BENCH_SERVE_QUERIES requests drawn from the
+    BENCH_SERVE_MIX amplitude/sample/expectation/marginal mix are fired
+    from a thread pool through the mixed micro-batching queue; the
+    block reports overall queries/sec, the realized batch-size
+    distribution, p50/p99 latency, and the same per query type
+    (``by_type``: requests, qps, p50/p99 ms — the per-type serving
+    surface scripts/perf_gate.py cross-checks)."""
     import concurrent.futures
 
     from tnc_tpu import obs
@@ -1824,6 +1855,11 @@ def _serve_bench() -> dict:
     n_queries = _env_int("BENCH_SERVE_QUERIES", 256)
     max_batch = _env_int("BENCH_SERVE_BATCH", 32)
     wait_ms = float(os.environ.get("BENCH_SERVE_WAIT_MS", "2"))
+    mix = _parse_serve_mix(
+        os.environ.get(
+            "BENCH_SERVE_MIX", "amplitude:6,sample:1,expectation:1"
+        )
+    )
     rng = np.random.default_rng(_env_int("BENCH_SEED", 42))
     circuit = brickwork_circuit(n, depth, rng)
 
@@ -1833,50 +1869,107 @@ def _serve_bench() -> dict:
         from tnc_tpu.ops.backends import JaxBackend
 
         backend = JaxBackend(dtype="complex64", donate=False)
-    queries = [
-        "".join(rng.choice(["0", "1"], n)) for _ in range(n_queries)
-    ]
+
+    def rand_bits() -> str:
+        return "".join(rng.choice(["0", "1"], n))
+
+    # one marginal mask for the whole run (the mask is the structure;
+    # serving traffic reuses it), half the qubits marginalized
+    marginal_mask = ["?"] * (n - n // 2) + ["*"] * (n // 2)
+
+    def make_query(kind: str):
+        if kind == "amplitude":
+            return kind, rand_bits()
+        if kind == "sample":
+            return kind, {
+                "n_samples": _env_int("BENCH_SERVE_SAMPLES", 1),
+                "seed": int(rng.integers(2**31)),
+            }
+        if kind == "expectation":
+            return kind, "".join(rng.choice(list("ixyz"), n))
+        bits = rand_bits()
+        return kind, "".join(
+            b if m == "?" else "*" for b, m in zip(bits, marginal_mask)
+        )
+
+    # weighted round-robin over the mix, so types interleave in the
+    # queue the way mixed fleet traffic would
+    cycle = [k for k, w in mix.items() for _ in range(w)]
+    queries = [make_query(cycle[i % len(cycle)]) for i in range(n_queries)]
+    use_queries = any(k != "amplitude" for k, _ in queries)
+
+    def submit(query):
+        kind, payload = query
+        if kind == "amplitude":
+            return svc.submit(payload)
+        return svc.submit_query(kind, payload)
+
     with obs.span("bench.serve", queries=n_queries):
         with ContractionService.from_circuit(
             circuit,
             backend=backend,
+            queries=use_queries,
             max_batch=max_batch,
             max_wait_ms=wait_ms,
             max_queue=max(n_queries, 1024),
         ) as svc:
             # warmup outside the timed window: one singleton (the
-            # batch-1 bucket) AND one full batch (the max_batch bucket)
-            # — the jax threaded path compiles one executable per pow2
-            # batch bucket, and steady traffic lands on the full bucket
-            svc.amplitude(queries[0])
-            warm = [svc.submit(queries[0]) for _ in range(max_batch)]
+            # batch-1 bucket) AND one full amplitude batch (the
+            # max_batch bucket — the jax threaded path compiles one
+            # executable per pow2 bucket), plus one request per
+            # non-amplitude type in the mix so every query structure
+            # plans/compiles before the clock starts
+            warm_bits = rand_bits()
+            svc.amplitude(warm_bits)
+            warm = [svc.submit(warm_bits) for _ in range(max_batch)]
             for f in warm:
                 f.result(timeout=600)
+            for kind, weight in mix.items():
+                if kind != "amplitude" and weight > 0:
+                    submit(make_query(kind)).result(timeout=600)
             svc.reset_stats()  # warmup must not skew the published stats
             t0 = time.monotonic()
             with concurrent.futures.ThreadPoolExecutor(16) as pool:
-                futs = list(pool.map(svc.submit, queries))
+                futs = list(pool.map(submit, queries))
             for f in futs:
                 f.result(timeout=600)
             wall = time.monotonic() - t0
         stats = svc.stats()
+    by_type = {}
+    for kind, row in stats["by_type"].items():
+        completed = row["counts"]["completed"]
+        if completed == 0 and mix.get(kind, 0) == 0:
+            continue  # not part of this run's mix
+        by_type[kind] = {
+            "requests": completed,
+            "qps": round(completed / wall, 1) if wall > 0 else 0.0,
+            "p50_ms": round(row["latency_s"]["p50"] * 1e3, 3),
+            "p99_ms": round(row["latency_s"]["p99"] * 1e3, 3),
+        }
     block = {
         "backend": backend_name,
         "qubits": n,
         "depth": depth,
         "queries": n_queries,
+        "mix": mix,
         "wall_s": round(wall, 4),
         "qps": round(n_queries / wall, 1) if wall > 0 else 0.0,
         "batch_size": stats["batch_size"],
         "latency_s": stats["latency_s"],
         "counts": stats["counts"],
+        "by_type": by_type,
     }
     log(
         f"[bench] serving: {block['qps']} q/s over {n_queries} queries "
-        f"(mean batch {stats['batch_size']['mean']:.1f}, "
+        f"(mix {mix}, mean batch {stats['batch_size']['mean']:.1f}, "
         f"p50 {stats['latency_s']['p50'] * 1e3:.2f} ms, "
         f"p99 {stats['latency_s']['p99'] * 1e3:.2f} ms)"
     )
+    for kind, row in sorted(by_type.items()):
+        log(
+            f"[bench]   {kind}: {row['requests']} reqs, {row['qps']} q/s, "
+            f"p50 {row['p50_ms']:.2f} ms, p99 {row['p99_ms']:.2f} ms"
+        )
     return block
 
 

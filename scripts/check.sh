@@ -83,6 +83,9 @@ TNC_TPU_PLATFORM=cpu python scripts/crash_resume_smoke.py
 echo "== serving smoke (concurrent queries vs oracle, plan-cache hit) =="
 TNC_TPU_PLATFORM=cpu python scripts/serve_smoke.py
 
+echo "== query-engine smoke (sampling/expectation/marginal vs statevector oracle, mixed queue) =="
+TNC_TPU_PLATFORM=cpu python scripts/query_smoke.py
+
 echo "== distributed smoke (2-process scatter -> overlapped fan-in -> gather, oracle bit-compare) =="
 python scripts/distributed_smoke.py
 

@@ -36,6 +36,7 @@ REQUIRED_SUBPACKAGES = (
     "obs",
     "ops",
     "parallel",
+    "queries",
     "resilience",
     "serve",
     "tensornetwork",

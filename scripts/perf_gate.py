@@ -26,7 +26,11 @@ failures, unless ``--strict``):
   ``distributed.dispatch_overlap_ratio``) — a reduce phase that got
   slower, or a level schedule that collapsed back toward a serial
   chain (overlap ratio dropped), is flagged even when the probe's
-  headline absorbs it.
+  headline absorbs it;
+- the mixed-workload serving block (``serving.by_type.<kind>``) —
+  per-query-type qps and p50 latency, so a regression confined to one
+  query type (sampling, expectation, marginal) is flagged even when
+  amplitude traffic dominates the overall numbers.
 
 Exit codes: 0 pass, 1 regression, 2 unusable input (missing files,
 error records, mismatched metrics).
@@ -174,6 +178,28 @@ def compare(
             f"warning: fan-in dispatch-overlap ratio dropped "
             f"{float(bo):.2f} -> {float(co):.2f} (schedule went serial?)"
         )
+
+    # serving per-query-type cross-check: qps and p50 latency per type
+    # from the mixed-workload serving block — a regression in ONE query
+    # type (sampling chain got slower, expectation batching broke) is
+    # localized even when amplitude traffic dominates the headline
+    bst = (base.get("serving") or {}).get("by_type") or {}
+    cst = (cand.get("serving") or {}).get("by_type") or {}
+    for kind in sorted(set(bst) & set(cst)):
+        bq, cq = (bst[kind] or {}).get("qps"), (cst[kind] or {}).get("qps")
+        if bq and cq and float(cq) < float(bq) / 1.5:
+            msgs.append(
+                f"warning: serving type '{kind}' qps dropped "
+                f"{float(bq) / float(cq):.2f}x ({bq:.4g} -> {cq:.4g})"
+            )
+        bp = (bst[kind] or {}).get("p50_ms")
+        cp50 = (cst[kind] or {}).get("p50_ms")
+        if bp and cp50 and float(cp50) / float(bp) > 1.5:
+            msgs.append(
+                f"warning: serving type '{kind}' p50 latency regressed "
+                f"{float(cp50) / float(bp):.2f}x ({bp:.4g}ms -> "
+                f"{cp50:.4g}ms)"
+            )
 
     # kernel-ladder per-bucket cross-check: effective-flop-credited MFU
     # when both records carry it, achieved FLOP/s otherwise — a bucket

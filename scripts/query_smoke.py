@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Query-engine smoke for scripts/check.sh: the three query types on
+CPU against the dense statevector oracle.
+
+- chain-rule sampling: per-qubit conditional marginals BIT-compare to
+  the oracle on a GHZ chain (exact-arithmetic sums), and a seeded
+  sampler stream equals the oracle's chain-rule stream;
+- one Pauli expectation value and a batched Pauli sum;
+- one wildcard marginal sweep (through the lifted amplitude_sweep
+  entry point);
+- all three as submit()-able types on one mixed ContractionService
+  queue, with per-type stats asserted.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+from tnc_tpu.builders.circuit_builder import Circuit  # noqa: E402
+from tnc_tpu.queries import statevector as sv  # noqa: E402
+from tnc_tpu.queries.expectation import (  # noqa: E402
+    pauli_expectation,
+    pauli_sum_expectation,
+)
+from tnc_tpu.queries.sampling import ChainSampler  # noqa: E402
+from tnc_tpu.serve import ContractionService  # noqa: E402
+from tnc_tpu.tensornetwork.sweep import amplitude_sweep  # noqa: E402
+from tnc_tpu.tensornetwork.tensordata import TensorData  # noqa: E402
+
+N_QUBITS = 6
+
+
+def ghz() -> Circuit:
+    c = Circuit()
+    reg = c.allocate_register(N_QUBITS)
+    c.append_gate(TensorData.gate("h"), [reg.qubit(0)])
+    for i in range(N_QUBITS - 1):
+        c.append_gate(
+            TensorData.gate("cx"), [reg.qubit(i), reg.qubit(i + 1)]
+        )
+    return c
+
+
+def rotations() -> Circuit:
+    rng = np.random.default_rng(29)
+    c = Circuit()
+    reg = c.allocate_register(N_QUBITS)
+    for q in range(N_QUBITS):
+        c.append_gate(
+            TensorData.gate("ry", [float(rng.uniform(0, 3))]), [reg.qubit(q)]
+        )
+    for q in range(N_QUBITS - 1):
+        c.append_gate(TensorData.gate("cx"), [reg.qubit(q), reg.qubit(q + 1)])
+    return c
+
+
+def main() -> int:
+    # 1) sampling conditionals: bitwise vs the oracle on GHZ
+    state = sv.statevector(ghz())
+    sampler = ChainSampler(ghz())
+    checked = 0
+    for prefix in ["", "0", "1", "01", "00000", "11111"]:
+        got = sampler.marginals([prefix])[0]
+        want = sv.conditional_distribution(state, prefix)
+        assert got[0] == want[0] and got[1] == want[1], (
+            f"conditional mismatch at prefix {prefix!r}: "
+            f"{got.tolist()} != {want}"
+        )
+        checked += 1
+    seeded = sampler.sample(16, seed=4)
+    oracle_stream = sv.sample_oracle(state, 16, np.random.default_rng(4))
+    assert seeded == oracle_stream, (seeded, oracle_stream)
+    print(
+        f"[query_smoke] sampling: {checked} conditional marginals "
+        f"bit-match the statevector oracle; seeded stream == oracle "
+        f"stream ({len(set(seeded))} distinct outcomes)"
+    )
+
+    # 2) expectation values: single Pauli + batched Pauli sum
+    rot_state = sv.statevector(rotations())
+    pauli = "z" * N_QUBITS
+    got = pauli_expectation(rotations(), pauli)
+    want = sv.pauli_expectation(rot_state, pauli)
+    assert abs(got - want) < 1e-12, (got, want)
+    terms = [(0.5, "z" + "i" * (N_QUBITS - 1)), (1.5, "xx" + "i" * (N_QUBITS - 2))]
+    got_sum = pauli_sum_expectation(rotations(), terms)
+    want_sum = sum(c * sv.pauli_expectation(rot_state, p) for c, p in terms)
+    assert abs(got_sum - want_sum) < 1e-12, (got_sum, want_sum)
+    print(
+        f"[query_smoke] expectation: ⟨{pauli}⟩ and a 2-term Pauli sum "
+        f"match the oracle (1e-12)"
+    )
+
+    # 3) wildcard marginal sweep through amplitude_sweep
+    patterns = ["01" + "*" * (N_QUBITS - 2), "11" + "*" * (N_QUBITS - 2)]
+    probs = amplitude_sweep(rotations(), patterns)
+    for pattern, p in zip(patterns, probs):
+        want_p = sv.marginal_probability(rot_state, pattern)
+        assert abs(p - want_p) < 1e-12, (pattern, p, want_p)
+    print(
+        f"[query_smoke] marginal sweep: {patterns} -> "
+        f"{[round(float(p), 6) for p in probs]} match the oracle"
+    )
+
+    # 4) one mixed queue serves all types, per-type stats recorded
+    with ContractionService.from_circuit(
+        rotations(), queries=True, max_batch=8, max_wait_ms=5.0
+    ) as svc:
+        futs = [
+            svc.submit("0" * N_QUBITS),
+            svc.submit_sample(4, seed=11),
+            svc.submit_expectation(pauli),
+            svc.submit_marginal("0*" * (N_QUBITS // 2)),
+        ]
+        results = [f.result(timeout=120) for f in futs]
+        stats = svc.stats()
+    assert abs(results[0] - sv.amplitude(rot_state, "0" * N_QUBITS)) < 1e-12
+    assert len(results[1]) == 4
+    assert abs(results[2] - want) < 1e-12
+    by_type = stats["by_type"]
+    for kind in ("amplitude", "sample", "expectation", "marginal"):
+        assert by_type[kind]["counts"]["completed"] == 1, by_type
+    print(
+        "[query_smoke] mixed queue: amplitude + sample + expectation + "
+        "marginal served by one service, per-type stats recorded"
+    )
+    print("[query_smoke] OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -79,10 +79,21 @@ def main() -> int:
             assert amp == want, f"{bits}: served {amp} != oracle {want}"
         stats = svc.stats()
         assert stats["counts"]["completed"] == N_QUERIES, stats
+        # per-query-type breakdown: all traffic above is amplitudes and
+        # must be fully accounted under its own type row
+        amp_row = stats["by_type"]["amplitude"]
+        assert amp_row["counts"]["submitted"] == N_QUERIES, amp_row
+        assert amp_row["counts"]["completed"] == N_QUERIES, amp_row
+        assert amp_row["counts"]["failed"] == 0, amp_row
+        assert amp_row["counts"]["batches"] == stats["counts"]["batches"], (
+            amp_row, stats["counts"],
+        )
+        assert amp_row["latency_s"]["p50"] > 0.0, amp_row
         print(
             f"[serve_smoke] {N_QUERIES} concurrent queries bit-match the "
             f"oracle (batches: {stats['batch_size']}, "
-            f"p50 {stats['latency_s']['p50'] * 1e3:.2f} ms)"
+            f"p50 {stats['latency_s']['p50'] * 1e3:.2f} ms; per-type "
+            f"amplitude row consistent)"
         )
 
         # second, structurally identical circuit: the plan cache must

@@ -118,6 +118,70 @@ def test_observable_lightcone_skips_gates():
     assert len(tn) == 0
 
 
+def _contract_scalar(tn) -> complex:
+    result = Greedy(OptMethod.GREEDY).find_path(tn)
+    return complex(
+        contract_tensor_network(tn, result.replace_path()).data.into_data()
+    )
+
+
+def _expectation_circuit():
+    """A small parameterized 2-qubit circuit for the direct
+    into_expectation_value_network oracle pins."""
+    from tnc_tpu.builders.circuit_builder import Circuit
+    from tnc_tpu.tensornetwork.tensordata import TensorData
+
+    c = Circuit()
+    reg = c.allocate_register(2)
+    c.append_gate(TensorData.gate("ry", [0.6]), [reg.qubit(0)])
+    c.append_gate(TensorData.gate("cx"), [reg.qubit(0), reg.qubit(1)])
+    c.append_gate(TensorData.gate("rx", [1.1]), [reg.qubit(1)])
+    return c
+
+
+def test_expectation_network_identity_is_norm():
+    """⟨ψ|I…I|ψ⟩ == 1: the all-identity observable layer contracts to
+    the state norm."""
+    value = _contract_scalar(
+        _expectation_circuit().into_expectation_value_network("ii")
+    )
+    assert abs(value - 1.0) < 1e-12
+
+
+def test_expectation_network_matches_dense_statevector():
+    """into_expectation_value_network vs dense statevector math for 1-
+    and 2-qubit Pauli observables (incl. the default Z…Z layer and the
+    transpose-sensitive Y)."""
+    from tnc_tpu.queries import statevector as sv
+
+    state = sv.statevector(_expectation_circuit())
+    for observables in ["zz", "zi", "iz", "xi", "iy", "yx", "xx", "yy"]:
+        got = _contract_scalar(
+            _expectation_circuit().into_expectation_value_network(observables)
+        )
+        want = sv.pauli_expectation(state, observables)
+        assert abs(got - want) < 1e-12, (observables, got, want)
+    # default = the reference's Z…Z layer
+    got_default = _contract_scalar(
+        _expectation_circuit().into_expectation_value_network()
+    )
+    want_default = sv.pauli_expectation(state, "zz")
+    assert abs(got_default - want_default) < 1e-12
+
+
+def test_expectation_network_validates_observables():
+    from tnc_tpu.builders.circuit_builder import Circuit
+
+    c = Circuit()
+    c.allocate_register(2)
+    with pytest.raises(ValueError, match="position 1"):
+        c.into_expectation_value_network("zq")
+    c2 = Circuit()
+    c2.allocate_register(2)
+    with pytest.raises(ValueError, match="length"):
+        c2.into_expectation_value_network("z")
+
+
 def test_random_sparse_tensor_data():
     data = random_sparse_tensor_data([5, 4, 3], 0.3)
     assert data.kind is DataKind.MATRIX

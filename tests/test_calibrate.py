@@ -487,6 +487,39 @@ def test_perf_gate_warns_on_kernel_bucket_mfu_drop():
     assert not any("bucket 'small'" in m for m in msgs)
 
 
+def test_perf_gate_warns_on_serving_type_regression():
+    """The mixed-workload serving cross-check: a qps drop or p50
+    latency regression >1.5x confined to ONE query type warns, and
+    healthy types stay silent."""
+    gate = _perf_gate()
+    base = _record(0.01)
+    base["serving"] = {
+        "qps": 900.0,
+        "by_type": {
+            "amplitude": {"requests": 200, "qps": 800.0, "p50_ms": 1.0},
+            "sample": {"requests": 28, "qps": 100.0, "p50_ms": 8.0},
+            "expectation": {"requests": 28, "qps": 100.0, "p50_ms": 2.0},
+        },
+    }
+    cand = _record(0.0101)
+    cand["serving"] = {
+        "qps": 850.0,
+        "by_type": {
+            "amplitude": {"requests": 200, "qps": 790.0, "p50_ms": 1.02},
+            "sample": {"requests": 28, "qps": 40.0, "p50_ms": 20.0},
+            "expectation": {"requests": 28, "qps": 98.0, "p50_ms": 2.1},
+        },
+    }
+    code, msgs = gate.compare(base, cand)
+    assert code == 0
+    assert any("serving type 'sample' qps dropped" in m for m in msgs)
+    assert any(
+        "serving type 'sample' p50 latency regressed" in m for m in msgs
+    )
+    assert not any("'amplitude'" in m for m in msgs)
+    assert not any("'expectation'" in m for m in msgs)
+
+
 def test_perf_gate_kernel_bucket_falls_back_to_flops():
     """Records without MFU (no known device peak) gate on the bucket's
     achieved FLOP/s instead."""

@@ -79,12 +79,35 @@ def _random_circuit_network(bitstring):
     return tn
 
 
-def test_amplitude_sweep_rejects_wildcards_and_ragged():
-    with pytest.raises(ValueError):
-        amplitude_sweep(_ghz(4), ["00*0"])
+def test_amplitude_sweep_rejects_ragged_and_mixed_masks():
     with pytest.raises(ValueError):
         amplitude_sweep(_ghz(4), ["0000", "000"])
+    # wildcard patterns are legal but must share ONE wildcard mask
+    # (the mask is the sandwich structure)
+    with pytest.raises(ValueError, match="wildcard mask"):
+        amplitude_sweep(_ghz(4), ["00*0", "0*00"])
     assert amplitude_sweep(_ghz(4), []).shape == (0,)
+
+
+def test_amplitude_sweep_wildcards_return_marginals():
+    """A '*' position marginalizes the qubit: the sweep returns real
+    born-rule masses of the determined bits, checked against the dense
+    statevector oracle."""
+    from tnc_tpu.queries import statevector as sv
+
+    patterns = ["0**0", "1**1", "0**1", "1**0"]
+    got = amplitude_sweep(_ghz(4), patterns, backend=None)
+    state = sv.statevector(_ghz(4))
+    want = [sv.marginal_probability(state, p) for p in patterns]
+    assert got.dtype == np.float64
+    np.testing.assert_allclose(got, want, rtol=0, atol=1e-12)
+    # GHZ: only the all-equal outcomes carry mass
+    np.testing.assert_allclose(got, [0.5, 0.5, 0.0, 0.0], atol=1e-12)
+
+
+def test_amplitude_sweep_all_wildcards_is_norm():
+    out = amplitude_sweep(_ghz(3), ["***"], backend=None)
+    np.testing.assert_allclose(out, [1.0], atol=1e-12)
 
 
 def test_amplitude_sweep_gradient_matches_finite_difference():

@@ -143,12 +143,50 @@ class Permutor:
         return LeafTensor(self.target_leg_order, bond_dims, TensorData.matrix(data))
 
 
+# The canonical computational-basis one-hot values. This table is THE
+# single definition of the ⟨0|/⟨1| (equivalently |0⟩/|1⟩ — they are
+# real) vectors in the codebase: the builder's ket/bra leaves, the
+# serving layer's rebind bras (:mod:`tnc_tpu.serve.rebind`), and the
+# sweep layer's stacked kets (:mod:`tnc_tpu.tensornetwork.sweep`) all
+# read it, so a future dtype/layout change cannot skew them apart.
+BASIS_STATES: dict[str, np.ndarray] = {
+    "0": np.array([1.0 + 0.0j, 0.0 + 0.0j]),
+    "1": np.array([0.0 + 0.0j, 1.0 + 0.0j]),
+}
+
+# Single-qubit Pauli matrices in the gate storage layout ``[out, in]``
+# — the observable alphabet of expectation-value networks
+# (:meth:`Circuit.into_expectation_value_network`,
+# :mod:`tnc_tpu.queries.expectation`).
+PAULI_MATRICES: dict[str, np.ndarray] = {
+    "i": np.eye(2, dtype=np.complex128),
+    "x": np.array([[0, 1], [1, 0]], dtype=np.complex128),
+    "y": np.array([[0, -1j], [1j, 0]], dtype=np.complex128),
+    "z": np.array([[1, 0], [0, -1]], dtype=np.complex128),
+}
+
+
+def observable_leaf_data(matrix: np.ndarray) -> TensorData:
+    """Leaf data for an observable ``O`` inserted between a sandwich
+    network's ket and adjoint layers (legs ``[edge, edge + offset]``).
+
+    The contraction computes ``sum_{a,b} psi_a T[a, b] conj(psi)_b``
+    for leaf data ``T`` — that is ⟨ψ|Tᵀ|ψ⟩ — so the leaf stores the
+    TRANSPOSE of the operator to make the network value ⟨ψ|O|ψ⟩.
+    (Symmetric observables — i, x, z and the reference's Z layer — are
+    unchanged by this; y is where the convention matters.)
+    """
+    return TensorData.matrix(
+        np.asarray(matrix, dtype=np.complex128).T.copy()
+    )
+
+
 def _ket0() -> TensorData:
-    return TensorData.from_values((2,), [1.0 + 0.0j, 0.0 + 0.0j])
+    return TensorData.matrix(BASIS_STATES["0"].copy())
 
 
 def _ket1() -> TensorData:
-    return TensorData.from_values((2,), [0.0 + 0.0j, 1.0 + 0.0j])
+    return TensorData.matrix(BASIS_STATES["1"].copy())
 
 
 class Circuit:
@@ -177,6 +215,27 @@ class Circuit:
 
     def num_qubits(self) -> int:
         return len(self.open_edges)
+
+    def copy(self) -> "Circuit":
+        """An independent, un-finalized copy of this circuit.
+
+        Finalizers consume a circuit; query layers that need several
+        networks from one logical circuit — e.g. the chain-rule sampler
+        builds one marginal network per prefix length
+        (:mod:`tnc_tpu.queries.sampling`) — copy first and finalize the
+        copies. Leaf *data* is shared (finalizers only append tensors,
+        never mutate existing ones); the tensor list and edge
+        bookkeeping are fresh.
+        """
+        if self._finalized:
+            raise RuntimeError(
+                "Circuit was already converted to a network; nothing to copy"
+            )
+        dup = Circuit()
+        dup.open_edges = list(self.open_edges)
+        dup.next_edge = self.next_edge
+        dup.tensor_network = self.tensor_network.copy()
+        return dup
 
     def allocate_register(self, size: int) -> QuantumRegister:
         """Allocate ``size`` qubits initialized to |0⟩."""
@@ -279,21 +338,127 @@ class Circuit:
         bond_dims = tensor.bond_dims[half:] + tensor.bond_dims[:half]
         return LeafTensor(legs, bond_dims, tensor.data.adjoint())
 
-    def into_expectation_value_network(self) -> CompositeTensor:
-        """⟨ψ|Z…Z|ψ⟩ network: circuit ++ adjoint mirror ++ Z layer
-        (``circuit_builder.rs:304-326``).
-        """
+    def _mirror_adjoint(self) -> int:
+        """Finalize and append the adjoint mirror of every circuit
+        tensor; returns the leg ``offset`` such that qubit ``q``'s
+        adjoint-layer open leg is ``self.open_edges[q] + offset``."""
         self._finalize()
         offset = self.next_edge
         adjoints = [
             self._tensor_adjoint(t, offset) for t in self.tensor_network.tensors
         ]
         self.tensor_network.push_tensors(adjoints)
-        for edge in self.open_edges:
+        return offset
+
+    def into_expectation_value_network(
+        self, observables: str | None = None
+    ) -> CompositeTensor:
+        """⟨ψ|P₁⊗…⊗Pₙ|ψ⟩ network: circuit ++ adjoint mirror ++ an
+        observable layer (``circuit_builder.rs:304-326``).
+
+        ``observables``: one Pauli character per qubit (``i``/``x``/
+        ``y``/``z``); default ``"z" * n`` — the reference's ⟨ψ|Z…Z|ψ⟩
+        layer. ``i`` traces the qubit out (its contribution is the
+        identity between the layers). The network contracts to the
+        scalar expectation value (real for Hermitian observables, up to
+        roundoff).
+        """
+        if observables is None:
+            observables = "z" * self.num_qubits()
+        observables = str(observables).lower()
+        if len(observables) != self.num_qubits():
+            raise ValueError(
+                f"observable string length {len(observables)} != qubit "
+                f"count {self.num_qubits()}"
+            )
+        for pos, c in enumerate(observables):
+            if c not in PAULI_MATRICES:
+                raise ValueError(
+                    f"invalid observable {c!r} at position {pos} "
+                    "(only 'i', 'x', 'y' and 'z' are allowed)"
+                )
+        offset = self._mirror_adjoint()
+        for c, edge in zip(observables, self.open_edges):
             observable = LeafTensor.from_const([edge, edge + offset], 2)
-            observable.data = TensorData.gate("z")
+            observable.data = observable_leaf_data(PAULI_MATRICES[c])
             self.tensor_network.push_tensor(observable)
         return self.tensor_network
+
+    def into_sandwich_template(
+        self, spec: str | Iterable
+    ) -> "SandwichTemplate":
+        """Close the circuit ++ adjoint mirror *sandwich* with one
+        closure per qubit — the query-engine finalizer
+        (:mod:`tnc_tpu.queries`). ``spec`` gives one character per
+        qubit:
+
+        - ``?`` — **determined**: placeholder ⟨b| bras on BOTH layers
+          (the ket-layer bra and its adjoint-layer mirror), rebound
+          per request like amplitude-template bras;
+        - ``*`` — **marginalized**: the qubit's ket-layer leg is traced
+          against its adjoint-layer mirror (an identity leaf), summing
+          the born-rule probability over that qubit;
+        - ``o`` — **open**: both legs stay open (the result carries a
+          ``(2, 2)`` density block for the qubit — its diagonal is the
+          pair of marginal probabilities);
+        - ``p`` — **observable placeholder**: one rebindable 2×2
+          operator leaf between the layers (identity until rebound;
+          see :func:`observable_leaf_data` for the stored layout).
+
+        The rebindable leaves are the TRAILING leaves of the network,
+        in qubit order — for each ``?`` qubit the ket-layer bra then
+        the adjoint-layer bra, one leaf per ``p`` qubit — the slot
+        contract :func:`tnc_tpu.serve.rebind.bind_template` relies on.
+        ``?`` and ``p`` cannot be mixed in one template (a template is
+        either bra-rebindable or observable-rebindable).
+        """
+        spec = "".join(spec)
+        if len(spec) != self.num_qubits():
+            raise ValueError(
+                f"sandwich spec length {len(spec)} != qubit count "
+                f"{self.num_qubits()}"
+            )
+        for pos, c in enumerate(spec):
+            if c not in "?*op":
+                raise ValueError(
+                    f"invalid sandwich spec character {c!r} at position "
+                    f"{pos} (only '?', '*', 'o' and 'p' are allowed)"
+                )
+        if "?" in spec and "p" in spec:
+            raise ValueError(
+                "a sandwich template is either bra-rebindable ('?') or "
+                "observable-rebindable ('p'), not both"
+            )
+        offset = self._mirror_adjoint()
+        open_legs: list[EdgeIndex] = []
+        determined: list[int] = []
+        rebind: list[LeafTensor] = []
+        for q, (c, edge) in enumerate(zip(spec, self.open_edges)):
+            if c == "*":
+                trace = LeafTensor.from_const([edge, edge + offset], 2)
+                trace.data = observable_leaf_data(PAULI_MATRICES["i"])
+                self.tensor_network.push_tensor(trace)
+            elif c == "o":
+                open_legs.extend((edge, edge + offset))
+            elif c == "?":
+                for leg in (edge, edge + offset):
+                    bra = LeafTensor.from_const([leg], 2)
+                    bra.data = _ket0()
+                    rebind.append(bra)
+                determined.extend((q, q))
+            else:  # 'p'
+                op = LeafTensor.from_const([edge, edge + offset], 2)
+                op.data = observable_leaf_data(PAULI_MATRICES["i"])
+                rebind.append(op)
+                determined.append(q)
+        self.tensor_network.push_tensors(rebind)
+        return SandwichTemplate(
+            network=self.tensor_network,
+            permutor=Permutor(open_legs),
+            num_qubits=len(spec),
+            determined=tuple(determined),
+            spec=spec,
+        )
 
 
 @dataclass(frozen=True)
@@ -351,3 +516,55 @@ class AmplitudeTemplate:
         ``len(self.determined)``-char ``0``/``1`` string, qubit order)."""
         bits = self.normalize_request(bitstring)
         return "".join(bits[p] for p in self.determined)
+
+
+@dataclass(frozen=True)
+class SandwichTemplate:
+    """A circuit ++ adjoint sandwich closed with rebindable leaves
+    (:meth:`Circuit.into_sandwich_template`).
+
+    Shares the :class:`AmplitudeTemplate` slot contract — the trailing
+    ``len(determined)`` leaves of ``network`` are the rebindable slots
+    — so :func:`tnc_tpu.serve.rebind.bind_template` plans, caches and
+    compiles it unchanged. ``determined[i]`` is the qubit index slot
+    ``i`` serves: a ``?`` qubit contributes TWO consecutive slots (its
+    ket-layer bra, then the adjoint-layer mirror), a ``p`` qubit one
+    observable slot.
+    """
+
+    network: CompositeTensor
+    permutor: Permutor
+    num_qubits: int
+    determined: tuple[int, ...]  # one qubit index per rebindable slot
+    spec: str  # per-qubit '?', '*', 'o' or 'p'
+
+    @property
+    def bra_qubits(self) -> tuple[int, ...]:
+        """The determined ('?') qubit positions, in qubit order."""
+        return tuple(q for q, c in enumerate(self.spec) if c == "?")
+
+    @property
+    def observable_qubits(self) -> tuple[int, ...]:
+        """The observable-placeholder ('p') positions, in qubit order."""
+        return tuple(q for q, c in enumerate(self.spec) if c == "p")
+
+    def request_bits(self, bits: str | Iterable) -> str:
+        """Per-slot bra bits for a request that fixes each determined
+        qubit: one ``0``/``1`` per ``?`` qubit, in qubit order, doubled
+        per slot (both layers carry the same one-hot value — the bras
+        are real). The :class:`~tnc_tpu.serve.rebind.BoundProgram`
+        dispatch contract.
+
+        >>> from tnc_tpu.tensornetwork.tensordata import TensorData
+        >>> c = Circuit(); _ = c.allocate_register(3)
+        >>> c.into_sandwich_template("??*").request_bits("01")
+        '0011'
+        """
+        bits = normalize_bitstring(bits, len(self.bra_qubits))
+        for pos, c in enumerate(bits):
+            if c == "*":
+                raise ValueError(
+                    f"sandwich request bit {pos} must be '0' or '1' "
+                    "(wildcards are fixed by the template spec)"
+                )
+        return "".join(c + c for c in bits)

@@ -1,0 +1,303 @@
+"""Pauli-string expectation values over sandwich networks.
+
+⟨ψ|P|ψ⟩ for a Pauli string ``P = P₁⊗…⊗Pₙ`` is one contraction of the
+circuit ++ adjoint sandwich with the Pauli operators inserted between
+the layers (:meth:`~tnc_tpu.builders.circuit_builder.Circuit.
+into_expectation_value_network`). Every Pauli string shares the SAME
+network structure — only the 2×2 observable leaf values differ — so
+this module treats the observable layer exactly like the serving
+layer treats bras: the structure plans and compiles once
+(:func:`~tnc_tpu.serve.rebind.bind_template` on an
+observable-placeholder :class:`~tnc_tpu.builders.circuit_builder.
+SandwichTemplate`, plan cache honored) and the terms of a Pauli sum
+stack along a batch leg into ONE dispatch
+(:mod:`tnc_tpu.ops.batched`).
+
+Gradients ride the existing autodiff-capable jax executors: the
+sandwich is an ordinary contraction program, so
+``jax.value_and_grad`` through :func:`~tnc_tpu.ops.backends._run_steps`
+(or the batched step runner for Pauli sums) differentiates the
+expectation w.r.t. any leaf tensor — both circuit layers carry a
+parameterized gate (the ket-layer leaf and its adjoint mirror), and
+the cotangent convention ``df = Re(sum(g * dT))`` composes them into
+d/dθ via the chain rule (see ``tests/test_queries.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from tnc_tpu import obs
+from tnc_tpu.builders.circuit_builder import (
+    PAULI_MATRICES,
+    Circuit,
+    SandwichTemplate,
+)
+from tnc_tpu.queries.statevector import normalize_pauli
+
+__all__ = [
+    "ExpectationProgram",
+    "bind_expectation",
+    "normalize_terms",
+    "pauli_expectation",
+    "pauli_sum_expectation",
+    "pauli_expectation_value_and_grad",
+]
+
+
+def stacked_observables(paulis: Sequence[str]) -> np.ndarray:
+    """Observable leaf values for a batch of Pauli strings:
+    ``(B, n, 2, 2)`` in qubit order, in the sandwich leaf layout —
+    values come from the ONE layout rule
+    (:func:`~tnc_tpu.builders.circuit_builder.observable_leaf_data`,
+    which stores the operator transpose), so the batched rebind path
+    can never skew from the template networks."""
+    from tnc_tpu.builders.circuit_builder import observable_leaf_data
+
+    return np.stack(
+        [
+            np.stack(
+                [
+                    observable_leaf_data(PAULI_MATRICES[c]).into_data()
+                    for c in pauli
+                ]
+            )
+            for pauli in paulis
+        ]
+    )
+
+
+def normalize_terms(
+    terms, num_qubits: int
+) -> tuple[tuple[complex, str], ...]:
+    """Canonicalize a Pauli-sum spec: an iterable of ``(coeff, pauli)``
+    pairs (or a bare Pauli string = one unit-coefficient term)."""
+    if isinstance(terms, str):
+        terms = [(1.0, terms)]
+    out = []
+    for coeff, pauli in terms:
+        out.append((complex(coeff), normalize_pauli(pauli, num_qubits)))
+    if not out:
+        raise ValueError("a Pauli sum needs at least one term")
+    return tuple(out)
+
+
+class ExpectationProgram:
+    """A compiled sandwich program with rebindable observable leaves —
+    the ⟨ψ|P|ψ⟩ counterpart of :class:`~tnc_tpu.serve.rebind.
+    BoundProgram` (which it wraps: same planning, plan-cache and
+    slicing machinery; only the rebound leaf values differ)."""
+
+    def __init__(self, bound) -> None:
+        template: SandwichTemplate = bound.template
+        if "?" in template.spec:
+            raise ValueError(
+                "expectation programs rebind observables, not bras "
+                "(template spec must be all 'p')"
+            )
+        self.bound = bound
+        self.num_qubits = template.num_qubits
+
+    def values(
+        self, paulis: Sequence[str], backend=None
+    ) -> np.ndarray:
+        """⟨ψ|P|ψ⟩ for every Pauli string, one batched dispatch
+        (complex ``(B,)``; imaginary parts are roundoff for the
+        Hermitian Pauli alphabet)."""
+        from tnc_tpu.ops.backends import JaxBackend, NumpyBackend
+        from tnc_tpu.ops.batched import stacked_rows
+
+        paulis = [normalize_pauli(p, self.num_qubits) for p in paulis]
+        if not paulis:
+            return np.zeros((0,), dtype=np.complex128)
+        bound = self.bound
+        if backend is None:
+            backend = NumpyBackend()
+        slots = bound.bra_slots  # observable slots (shared slot contract)
+        stacked = stacked_observables(paulis)  # (B, n, 2, 2)
+        buffers = list(bound.arrays)
+        for i, slot in enumerate(slots):
+            buffers[slot] = np.ascontiguousarray(stacked[:, i])
+        b = len(paulis)
+        if bound.sliced is not None:
+            # budget-sliced structures run the slice loop per term
+            obs.counter_add("queries.expectation.dispatch", mode="sliced")
+            rows = stacked_rows(
+                lambda per: backend.execute_sliced(bound.sliced, per),
+                buffers, slots, b, bound.program.result_shape,
+            )
+        elif isinstance(backend, (NumpyBackend, JaxBackend)):
+            obs.counter_add("queries.expectation.dispatch", mode="batched")
+            rows = backend.execute_batched(bound.program, buffers, slots)
+        else:
+            obs.counter_add("queries.expectation.dispatch", mode="loop")
+            rows = stacked_rows(
+                lambda per: backend.execute(bound.program, per),
+                buffers, slots, b, bound.program.result_shape,
+            )
+        return np.asarray(rows).reshape(b).astype(np.complex128)
+
+    def pauli_sum(
+        self, terms, backend=None
+    ) -> tuple[complex, np.ndarray]:
+        """``(sum_t coeff_t ⟨ψ|P_t|ψ⟩, per-term values)`` — the terms
+        share this one structure and batch like bras."""
+        terms = normalize_terms(terms, self.num_qubits)
+        vals = self.values([p for _, p in terms], backend)
+        total = complex(sum(c * v for (c, _), v in zip(terms, vals)))
+        return total, vals
+
+
+def bind_expectation(
+    circuit: Circuit,
+    pathfinder=None,
+    plan_cache=None,
+    target_size: float | None = None,
+) -> ExpectationProgram:
+    """Plan/compile the observable-placeholder sandwich of ``circuit``
+    (consumed — finalizer semantics; ``copy()`` first to keep it)."""
+    from tnc_tpu.serve.rebind import bind_template
+
+    template = circuit.into_sandwich_template("p" * circuit.num_qubits())
+    return ExpectationProgram(
+        bind_template(template, pathfinder, plan_cache, target_size)
+    )
+
+
+def pauli_expectation(
+    circuit: Circuit,
+    pauli: str,
+    pathfinder=None,
+    backend=None,
+    plan_cache=None,
+    target_size: float | None = None,
+) -> complex:
+    """⟨ψ|P|ψ⟩ for one Pauli string (``circuit`` consumed).
+
+    >>> from tnc_tpu.tensornetwork.tensordata import TensorData
+    >>> c = Circuit(); reg = c.allocate_register(2)
+    >>> c.append_gate(TensorData.gate("x"), [reg.qubit(0)])
+    >>> pauli_expectation(c, "zi")
+    (-1+0j)
+    """
+    prog = bind_expectation(circuit, pathfinder, plan_cache, target_size)
+    return complex(prog.values([pauli], backend)[0])
+
+
+def pauli_sum_expectation(
+    circuit: Circuit,
+    terms,
+    pathfinder=None,
+    backend=None,
+    plan_cache=None,
+    target_size: float | None = None,
+) -> complex:
+    """``sum_t coeff_t ⟨ψ|P_t|ψ⟩`` with every term sharing one planned
+    sandwich structure and one batched dispatch (``circuit``
+    consumed)."""
+    prog = bind_expectation(circuit, pathfinder, plan_cache, target_size)
+    total, _vals = prog.pauli_sum(terms, backend)
+    return total
+
+
+def pauli_expectation_value_and_grad(
+    circuit: Circuit,
+    terms,
+    wrt: Sequence[int] | None = None,
+    dtype: str = "complex64",
+):
+    """Value and gradient of ``f = Re(sum_t coeff_t ⟨ψ|P_t|ψ⟩)`` w.r.t.
+    selected sandwich leaf tensors, through the existing
+    autodiff-capable jax executors (``circuit`` consumed).
+
+    The terms batch along the observable leaves exactly like the
+    forward path (one structure, one traced program). ``wrt`` indexes
+    the sandwich's flat leaf order — the first ``L`` slots are the
+    circuit layer (kets then gates, build order), the next ``L`` their
+    adjoint mirrors, and the trailing ``n`` the observable slots
+    (which carry the batch leg and cannot be differentiated here); the
+    default differentiates every circuit-layer AND adjoint-layer gate
+    leaf. A parameterized gate θ appears in BOTH layers: with ``g_ket``
+    and ``g_adj`` the two cotangents, ``df/dθ = Re(sum(g_ket * dG/dθ))
+    + Re(sum(g_adj * d(G†)/dθ))`` (cotangent convention of
+    :mod:`tnc_tpu.ops.autodiff`).
+
+    Returns ``(value, per_term_values, grads)`` where ``value`` is the
+    real scalar and ``grads[i]`` is the cotangent for ``wrt[i]``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from tnc_tpu.ops.autodiff import _validate_wrt
+    from tnc_tpu.ops.backends import _run_steps
+    from tnc_tpu.ops.batched import run_steps_batched, thread_batch
+    from tnc_tpu.ops.program import flat_leaf_tensors
+    from tnc_tpu.serve.rebind import plan_structure
+
+    n = circuit.num_qubits()
+    n_circuit = len(circuit.tensor_network.tensors)
+    terms = normalize_terms(terms, n)
+    template = circuit.into_sandwich_template("p" * n)
+    tn = template.network
+    leaves = flat_leaf_tensors(tn)
+    obs_slots = list(range(len(leaves) - n, len(leaves)))
+    obs_set = set(obs_slots)
+
+    path, _slicing, program, _sliced, _result = plan_structure(tn)
+    arrays = [
+        jnp.asarray(leaf.data.into_data(), dtype=dtype) for leaf in leaves
+    ]
+
+    if wrt is None:
+        # every gate leaf, both layers (kets and observables excluded)
+        wrt = [
+            s
+            for s in range(2 * n_circuit)
+            if len(leaves[s].legs) > 1
+        ]
+    wrt = _validate_wrt(wrt, len(arrays))
+    for s in wrt:
+        if s in obs_set:
+            raise ValueError(
+                "observable slots carry the Pauli-term batch leg; "
+                "not differentiable here"
+            )
+
+    coeffs = jnp.asarray([c for c, _ in terms], dtype=dtype)
+    stacked = jnp.asarray(
+        stacked_observables([p for _, p in terms]), dtype=dtype
+    )  # (B, n, 2, 2)
+    flags, threadable = thread_batch(program, obs_slots)
+
+    def forward(diff_arrays):
+        buffers = list(arrays)
+        for slot, arr in zip(wrt, diff_arrays):
+            buffers[slot] = arr
+        for i, slot in enumerate(obs_slots):
+            buffers[slot] = stacked[:, i]
+        if threadable:
+            vals = run_steps_batched(
+                jnp, program, list(buffers), flags
+            ).reshape(-1)
+        else:
+
+            def single(obs_values):
+                per = list(buffers)
+                for i, slot in enumerate(obs_slots):
+                    per[slot] = obs_values[i]
+                return _run_steps(jnp, program, per).reshape(-1)[0]
+
+            vals = jax.vmap(single)(stacked)
+        return jnp.sum(jnp.real(coeffs * vals)), vals
+
+    diff_in = tuple(arrays[slot] for slot in wrt)
+    (value, vals), grads = jax.value_and_grad(forward, has_aux=True)(
+        diff_in
+    )
+    return (
+        float(value),
+        np.asarray(vals).reshape(len(terms)),
+        [np.asarray(g) for g in grads],
+    )

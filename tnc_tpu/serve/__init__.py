@@ -1,11 +1,13 @@
-"""tnc_tpu.serve — amplitude serving: plan cache, bra rebinding,
-batched queries, micro-batching front end.
+"""tnc_tpu.serve — query serving: plan cache, bra rebinding, batched
+queries, micro-batching front end.
 
 The serving pipeline, front to back:
 
-- :class:`ContractionService` (``service.py``) — async request queue,
-  micro-batching window, deadlines, admission control, retry +
-  batch→singleton degradation.
+- :class:`ContractionService` (``service.py``) — async MIXED request
+  queue (amplitudes + the :mod:`tnc_tpu.queries` query types:
+  bitstring sampling, Pauli expectation values, marginal sweeps, each
+  with a per-type batching key), micro-batching window, deadlines,
+  admission control, retry + batch→singleton degradation.
 - :class:`BoundProgram` / :func:`bind_circuit` (``rebind.py``) — one
   compiled program per circuit *structure*; per-request bra leaf data
   is rebound (and B requests batched into one dispatch) without

@@ -37,7 +37,7 @@ from typing import Any, Iterable, Sequence
 import numpy as np
 
 from tnc_tpu import obs
-from tnc_tpu.builders.circuit_builder import AmplitudeTemplate
+from tnc_tpu.builders.circuit_builder import BASIS_STATES, AmplitudeTemplate
 from tnc_tpu.ops.backends import Backend, JaxBackend, NumpyBackend
 from tnc_tpu.ops.batched import (  # noqa: F401 — re-exported serving API
     apply_step_batched,
@@ -54,20 +54,17 @@ from tnc_tpu.ops.sliced import build_sliced_program
 
 logger = logging.getLogger(__name__)
 
-_BRA = {
-    "0": np.array([1.0 + 0.0j, 0.0 + 0.0j]),
-    "1": np.array([0.0 + 0.0j, 1.0 + 0.0j]),
-}
-
-
 def stacked_bras(batch_bits: Sequence[str]) -> np.ndarray:
     """One-hot bra values for a batch: ``(B, n_det, 2)``, qubit order.
+    Values come from the builder's canonical
+    :data:`~tnc_tpu.builders.circuit_builder.BASIS_STATES` table (one
+    definition for kets, bras and sweep values alike).
 
     >>> stacked_bras(["01"]).tolist()[0]
     [[(1+0j), 0j], [0j, (1+0j)]]
     """
     return np.stack(
-        [np.stack([_BRA[c] for c in bits]) for bits in batch_bits]
+        [np.stack([BASIS_STATES[c] for c in bits]) for bits in batch_bits]
     )
 
 

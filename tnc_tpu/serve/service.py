@@ -1,4 +1,4 @@
-"""Amplitude-serving front end: async queue + micro-batching dispatcher.
+"""Query-serving front end: one mixed queue + micro-batching dispatcher.
 
 :class:`ContractionService` turns a :class:`~tnc_tpu.serve.rebind.
 BoundProgram` into a request server. Callers submit bitstrings (from
@@ -7,6 +7,19 @@ collects requests into micro-batches — up to ``max_batch`` riders or
 ``max_wait_ms`` after the first arrival, whichever comes first — and
 issues ONE rebind dispatch per batch, the TPU-native shape for
 amplitude traffic (one compiled program, B bitstrings per dispatch).
+
+Beyond amplitudes, the queue is **mixed**: bitstring sampling, Pauli
+expectation values and marginal sweeps are ``submit()``-able query
+types (:meth:`~ContractionService.submit_sample` /
+:meth:`~ContractionService.submit_expectation` /
+:meth:`~ContractionService.submit_marginal`), each handled by a
+registered :mod:`tnc_tpu.queries.handlers` handler. Every request
+carries a per-type **batching key** (the marginal key includes the
+wildcard mask); the dispatcher partitions each micro-batch window by
+key, so a dispatched batch never mixes structures while all types
+share one queue, one deadline/admission policy, and one plan cache.
+Per-type counters and latency histograms ride ``stats()["by_type"]``
+and the ``serve.query.*`` obs metrics.
 
 Production posture:
 
@@ -72,10 +85,14 @@ class ServiceClosedError(ServeError):
 
 @dataclass
 class _Request:
-    bits: str | Iterable
+    bits: object  # the validated payload (determined bits for amplitudes)
     future: concurrent.futures.Future
     deadline: float | None  # absolute monotonic, None = no deadline
     t_submit: float = field(default_factory=time.monotonic)
+    kind: str = "amplitude"
+    # batching key: requests dispatch together ONLY when keys match
+    # (per-type, plus structure discriminators like the marginal mask)
+    key: tuple = ("amplitude",)
 
 
 _STATS_CAP = 4096  # bounded in-memory samples for stats()/bench
@@ -135,6 +152,14 @@ class ContractionService:
         }
         self._batch_sizes: deque[int] = deque(maxlen=_STATS_CAP)
         self._latencies: deque[float] = deque(maxlen=_STATS_CAP)
+        # per-query-type breakdowns (kind -> counts / latency samples);
+        # "amplitude" is pre-seeded so dashboards always see the
+        # primary type even before traffic arrives
+        self._by_type: dict[str, dict] = {}
+        self._latencies_by_type: dict[str, deque] = {}
+        self._ensure_type("amplitude")
+        # registered query handlers (sampling / expectation / marginal)
+        self._handlers: dict[str, object] = {}
         # an improved BoundProgram staged by the background replanner;
         # the dispatcher adopts it at the next batch boundary
         self._pending_bound: BoundProgram | None = None
@@ -154,9 +179,16 @@ class ContractionService:
         replan_options: dict | None = None,
         shared_cache_watch: bool = False,
         watch_options: dict | None = None,
+        queries: bool = False,
         **kwargs,
     ) -> "ContractionService":
         """Build (plan/compile once, plan cache honored) and start.
+
+        ``queries=True`` additionally registers the sampling /
+        expectation / marginal query handlers for the same circuit
+        (:func:`tnc_tpu.queries.handlers.attach_query_handlers`),
+        sharing ``plan_cache``/``target_size``; the circuit is copied
+        before the amplitude finalizer consumes it.
 
         ``background_replan=True`` (requires ``plan_cache``) attaches a
         :class:`~tnc_tpu.serve.replan.BackgroundReplanner`: a cache miss
@@ -174,10 +206,18 @@ class ContractionService:
             raise ValueError("background_replan requires a plan_cache")
         if shared_cache_watch and plan_cache is None:
             raise ValueError("shared_cache_watch requires a plan_cache")
+        query_circuit = circuit.copy() if queries else None
         bound = bind_circuit(circuit, mask, pathfinder, plan_cache, target_size)
         svc = cls(bound, backend=backend, **kwargs)
         svc.start()
         try:
+            if queries:
+                svc.enable_queries(
+                    query_circuit,
+                    pathfinder=pathfinder,
+                    plan_cache=plan_cache,
+                    target_size=target_size,
+                )
             if background_replan:
                 from tnc_tpu.serve.replan import BackgroundReplanner
 
@@ -293,7 +333,75 @@ class ContractionService:
     def __exit__(self, *exc) -> None:
         self.stop()
 
+    # -- query handlers ----------------------------------------------------
+
+    def register_query_handler(self, handler) -> None:
+        """Register a query-type handler (``kind`` attribute +
+        ``validate(payload) -> (payload, key)`` at admission +
+        ``dispatch(payloads, backend) -> results`` per batch — the
+        :mod:`tnc_tpu.queries.handlers` protocol). One handler per
+        kind; re-registering replaces."""
+        self._handlers[str(handler.kind)] = handler
+
+    def enable_queries(
+        self,
+        circuit,
+        pathfinder=None,
+        plan_cache=None,
+        target_size=None,
+    ) -> "ContractionService":
+        """Register the sampling / expectation / marginal handlers for
+        ``circuit`` (copied, not consumed) — the query-engine
+        attachment point (lazy import: :mod:`tnc_tpu.queries` depends
+        on this module's package)."""
+        from tnc_tpu.queries.handlers import attach_query_handlers
+
+        attach_query_handlers(
+            self, circuit,
+            pathfinder=pathfinder, plan_cache=plan_cache,
+            target_size=target_size,
+        )
+        return self
+
     # -- submission --------------------------------------------------------
+
+    def _enqueue(
+        self,
+        kind: str,
+        key: tuple,
+        payload,
+        timeout_s: float | None,
+    ) -> concurrent.futures.Future:
+        """Shared admission path for every query type: bounded queue,
+        deadline arming, global + per-type accounting."""
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        deadline = (
+            time.monotonic() + float(timeout_s) if timeout_s is not None else None
+        )
+        with self._cond:
+            if not self._running:
+                self._count("rejected")
+                self._count_type(kind, "rejected")
+                obs.counter_add("serve.requests.rejected", reason="closed")
+                raise ServiceClosedError("service is not running")
+            if len(self._queue) >= self.max_queue:
+                self._count("rejected")
+                self._count_type(kind, "rejected")
+                obs.counter_add("serve.requests.rejected", reason="queue_full")
+                raise QueueFullError(
+                    f"queue at max_queue={self.max_queue}; retry later"
+                )
+            self._queue.append(
+                _Request(payload, fut, deadline, kind=kind, key=key)
+            )
+            depth = len(self._queue)
+            self._cond.notify()
+        self._count("submitted")
+        self._count_type(kind, "submitted")
+        obs.counter_add("serve.requests.submitted")
+        obs.counter_add("serve.query.submitted", type=kind)
+        obs.gauge_set("serve.queue_depth", depth)
+        return fut
 
     def submit(
         self, bitstring: str | Iterable, timeout_s: float | None = None
@@ -307,28 +415,71 @@ class ContractionService:
         # queued: a one-shot iterable is consumed by this validation,
         # and dispatch never re-validates
         bitstring = self.bound.template.request_bits(bitstring)
-        fut: concurrent.futures.Future = concurrent.futures.Future()
-        deadline = (
-            time.monotonic() + float(timeout_s) if timeout_s is not None else None
+        return self._enqueue(
+            "amplitude", ("amplitude",), bitstring, timeout_s
         )
-        with self._cond:
-            if not self._running:
-                self._count("rejected")
-                obs.counter_add("serve.requests.rejected", reason="closed")
-                raise ServiceClosedError("service is not running")
-            if len(self._queue) >= self.max_queue:
-                self._count("rejected")
-                obs.counter_add("serve.requests.rejected", reason="queue_full")
-                raise QueueFullError(
-                    f"queue at max_queue={self.max_queue}; retry later"
-                )
-            self._queue.append(_Request(bitstring, fut, deadline))
-            depth = len(self._queue)
-            self._cond.notify()
-        self._count("submitted")
-        obs.counter_add("serve.requests.submitted")
-        obs.gauge_set("serve.queue_depth", depth)
-        return fut
+
+    def submit_query(
+        self, kind: str, payload, timeout_s: float | None = None
+    ) -> concurrent.futures.Future:
+        """Enqueue one typed query request through its registered
+        handler; the handler validates the payload at admission and
+        assigns the batching key."""
+        handler = self._handlers.get(kind)
+        if handler is None:
+            raise ValueError(
+                f"no handler registered for query kind {kind!r} "
+                "(enable_queries / register_query_handler first)"
+            )
+        payload, key = handler.validate(payload)
+        return self._enqueue(kind, tuple(key), payload, timeout_s)
+
+    def submit_sample(
+        self,
+        n_samples: int = 1,
+        seed=None,
+        timeout_s: float | None = None,
+    ) -> concurrent.futures.Future:
+        """Sample ``n_samples`` bitstrings from |⟨b|C|0⟩|² (chain-rule
+        sampler); the future resolves to a list of bitstrings. A seeded
+        request's stream is deterministic regardless of co-riders."""
+        return self.submit_query(
+            "sample", {"n_samples": n_samples, "seed": seed}, timeout_s
+        )
+
+    def submit_expectation(
+        self, terms, timeout_s: float | None = None
+    ) -> concurrent.futures.Future:
+        """⟨ψ|P|ψ⟩ (a Pauli string) or a Pauli sum (iterable of
+        ``(coeff, pauli)``); the future resolves to the complex
+        value. Terms batch through one sandwich structure."""
+        return self.submit_query("expectation", terms, timeout_s)
+
+    def submit_marginal(
+        self, pattern, timeout_s: float | None = None
+    ) -> concurrent.futures.Future:
+        """Marginal probability of ``pattern``'s determined bits
+        (``'*'`` = marginalized); the future resolves to a float."""
+        return self.submit_query("marginal", pattern, timeout_s)
+
+    def sample(self, n_samples: int = 1, seed=None,
+               timeout_s: float | None = None) -> list:
+        """Blocking :meth:`submit_sample`."""
+        return self.submit_sample(n_samples, seed, timeout_s).result(
+            timeout=None if timeout_s is None else float(timeout_s) + 60.0
+        )
+
+    def expectation(self, terms, timeout_s: float | None = None) -> complex:
+        """Blocking :meth:`submit_expectation`."""
+        return self.submit_expectation(terms, timeout_s).result(
+            timeout=None if timeout_s is None else float(timeout_s) + 60.0
+        )
+
+    def marginal(self, pattern, timeout_s: float | None = None) -> float:
+        """Blocking :meth:`submit_marginal`."""
+        return self.submit_marginal(pattern, timeout_s).result(
+            timeout=None if timeout_s is None else float(timeout_s) + 60.0
+        )
 
     def amplitude(self, bitstring, timeout_s: float | None = None):
         """Blocking single-amplitude query (deadline doubles as the
@@ -416,12 +567,25 @@ class ContractionService:
         # already-delivered result)
         return complex(out) if out.shape == () else np.array(out)
 
+    def _dispatch_group(
+        self, kind: str, payloads: list, bound: BoundProgram
+    ) -> list:
+        """One batched execution of a same-key group; returns one
+        result object per payload."""
+        if kind == "amplitude":
+            amps = self._dispatch_amps(bound, payloads)
+            return [
+                self._per_request(amps, i) for i in range(len(payloads))
+            ]
+        return self._handlers[kind].dispatch(payloads, self.backend)
+
     def _run_batch(self, batch: list[_Request]) -> None:
         now = time.monotonic()
         live: list[_Request] = []
         for req in batch:
             if req.deadline is not None and now > req.deadline:
                 self._count("expired")
+                self._count_type(req.kind, "expired")
                 obs.counter_add("serve.requests.expired")
                 self._complete(
                     req,
@@ -434,36 +598,50 @@ class ContractionService:
                 live.append(req)
         if not live:
             return
-        self._count("batches")
-        with self._lock:
-            self._batch_sizes.append(len(live))
-        obs.observe("serve.batch_size", len(live))
         for req in live:
             obs.observe("serve.wait_s", now - req.t_submit)
-
-        bits = [req.bits for req in live]
-        # one bound per batch: adopt a staged replan at this boundary,
-        # then every rider of the batch (including singleton-degrade
+        # one bound per window: adopt a staged replan at this boundary,
+        # then every group of the window (including singleton-degrade
         # re-dispatches) runs under the SAME program
         bound = self._current_bound()
+        # partition the window by batching key (insertion order): one
+        # dispatch per key — a batch never mixes query types or
+        # structures, while all types share the queue and the window
+        groups: dict[tuple, list[_Request]] = {}
+        for req in live:
+            groups.setdefault(req.key, []).append(req)
+        for group in groups.values():
+            self._run_group(group, bound)
+
+    def _run_group(
+        self, group: list[_Request], bound: BoundProgram
+    ) -> None:
+        kind = group[0].kind
+        self._count("batches")
+        self._count_type(kind, "batches")
+        with self._lock:
+            self._batch_sizes.append(len(group))
+        obs.observe("serve.batch_size", len(group))
+        obs.observe("serve.query.batch_size", len(group), type=kind)
+        payloads = [req.bits for req in group]
         try:
-            with obs.span("serve.dispatch", batch=len(live)):
-                amps = self.retry_policy.run(
-                    lambda: self._dispatch_amps(bound, bits),
+            with obs.span("serve.dispatch", batch=len(group), kind=kind):
+                results = self.retry_policy.run(
+                    lambda: self._dispatch_group(kind, payloads, bound),
                     label="serve.dispatch",
                 )
         except Exception as exc:  # noqa: BLE001 — degrade to singletons
             logger.warning(
-                "batch of %d failed (%s: %s); degrading to singleton "
-                "requests", len(live), type(exc).__name__, exc,
+                "%s batch of %d failed (%s: %s); degrading to singleton "
+                "requests", kind, len(group), type(exc).__name__, exc,
             )
             self._count("degraded_batches")
             obs.counter_add("serve.batch_degraded")
-            self._run_singletons(live, bound)
+            self._run_singletons(group, bound)
             return
         done = time.monotonic()
-        for i, req in enumerate(live):
-            if self._complete(req, result=self._per_request(amps, i)):
+        for req, result in zip(group, results):
+            if self._complete(req, result=result):
                 self._finish(req, done)
 
     def _run_singletons(self, batch: list[_Request], bound=None) -> None:
@@ -476,28 +654,53 @@ class ContractionService:
             bound = self.bound
         for req in batch:
             try:
-                amps = self._dispatch_amps(bound, [req.bits])
+                results = self._dispatch_group(req.kind, [req.bits], bound)
             except Exception as exc:  # noqa: BLE001 — per-request verdict
                 self._count("failed")
+                self._count_type(req.kind, "failed")
                 obs.counter_add("serve.requests.failed")
+                obs.counter_add("serve.query.failed", type=req.kind)
                 self._complete(req, exc=exc)
                 continue
-            if self._complete(req, result=self._per_request(amps, 0)):
+            if self._complete(req, result=results[0]):
                 self._finish(req, time.monotonic())
 
     def _finish(self, req: _Request, done: float) -> None:
         self._count("completed")
+        self._count_type(req.kind, "completed")
         obs.counter_add("serve.requests.completed")
+        obs.counter_add("serve.query.completed", type=req.kind)
         latency = done - req.t_submit
         with self._lock:
             self._latencies.append(latency)
+            self._latencies_by_type[req.kind].append(latency)
         obs.observe("serve.latency_s", latency)
+        obs.observe("serve.query.latency_s", latency, type=req.kind)
 
     # -- stats -------------------------------------------------------------
+
+    _TYPE_KEYS = (
+        "submitted", "completed", "failed", "expired", "rejected",
+        "batches",
+    )
+
+    def _ensure_type(self, kind: str) -> dict:
+        """Per-type accounting row (callers hold no lock; dict writes
+        are guarded by ``_lock`` in the callers that mutate)."""
+        row = self._by_type.get(kind)
+        if row is None:
+            row = {k: 0 for k in self._TYPE_KEYS}
+            self._by_type[kind] = row
+            self._latencies_by_type[kind] = deque(maxlen=_STATS_CAP)
+        return row
 
     def _count(self, key: str) -> None:
         with self._lock:
             self._counts[key] += 1
+
+    def _count_type(self, kind: str, key: str) -> None:
+        with self._lock:
+            self._ensure_type(kind)[key] += 1
 
     def reset_stats(self) -> None:
         """Zero the in-memory counts and samples — benchmarks call this
@@ -508,20 +711,41 @@ class ContractionService:
                 self._counts[key] = 0
             self._batch_sizes.clear()
             self._latencies.clear()
+            for kind, row in self._by_type.items():
+                for key in row:
+                    row[key] = 0
+                self._latencies_by_type[kind].clear()
+
+    @staticmethod
+    def _pct(sorted_vals: list, q: float) -> float:
+        if not sorted_vals:
+            return 0.0
+        idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1)))
+        return float(sorted_vals[idx])
 
     def stats(self) -> dict:
         """Snapshot for dashboards and ``bench.py --serve``: request
-        counts, batch-size distribution, and latency percentiles."""
+        counts, batch-size distribution, latency percentiles, and the
+        per-query-type breakdown (``by_type``: one row per kind with
+        request/batch counts and latency percentiles)."""
         with self._lock:
             counts = dict(self._counts)
             sizes = list(self._batch_sizes)
             lats = sorted(self._latencies)
+            by_type = {
+                kind: (
+                    dict(row),
+                    sorted(self._latencies_by_type[kind]),
+                )
+                for kind, row in self._by_type.items()
+            }
 
-        def pct(sorted_vals: list[float], q: float) -> float:
-            if not sorted_vals:
-                return 0.0
-            idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1)))
-            return float(sorted_vals[idx])
+        def latency_block(sorted_lats: list) -> dict:
+            return {
+                "p50": round(self._pct(sorted_lats, 0.50), 6),
+                "p99": round(self._pct(sorted_lats, 0.99), 6),
+                "max": round(sorted_lats[-1], 6) if sorted_lats else 0.0,
+            }
 
         return {
             "counts": counts,
@@ -531,9 +755,9 @@ class ContractionService:
                 "max": int(max(sizes)) if sizes else 0,
                 "mean": float(np.mean(sizes)) if sizes else 0.0,
             },
-            "latency_s": {
-                "p50": round(pct(lats, 0.50), 6),
-                "p99": round(pct(lats, 0.99), 6),
-                "max": round(lats[-1], 6) if lats else 0.0,
+            "latency_s": latency_block(lats),
+            "by_type": {
+                kind: {"counts": row, "latency_s": latency_block(tl)}
+                for kind, (row, tl) in by_type.items()
             },
         }

@@ -24,14 +24,13 @@ from typing import Sequence
 
 import numpy as np
 
-from tnc_tpu.builders.circuit_builder import Circuit
+from tnc_tpu.builders.circuit_builder import BASIS_STATES, Circuit
 from tnc_tpu.contractionpath.paths.base import Pathfinder
 from tnc_tpu.ops.program import build_program, flat_leaf_tensors
 
-_KET = {
-    "0": np.array([1.0 + 0.0j, 0.0 + 0.0j]),
-    "1": np.array([0.0 + 0.0j, 1.0 + 0.0j]),
-}
+# the builder's canonical one-hot table (shared with serve/rebind.py so
+# a dtype/layout change cannot skew sweep kets and serving bras apart)
+_KET = BASIS_STATES
 
 
 def _sweep_program(circuit, bitstrings, pathfinder):
@@ -49,8 +48,9 @@ def _sweep_program(circuit, bitstrings, pathfinder):
             raise ValueError("all bitstrings must have equal length")
         if any(c not in "01" for c in b):
             raise ValueError(
-                "amplitude sweeps require fully determined bitstrings "
-                "(no '*' wildcards)"
+                "the amplitude branch of a sweep requires fully "
+                "determined bitstrings ('*' wildcards route to the "
+                "marginal branch before this point)"
             )
 
     tn, _ = circuit.into_amplitude_network(bitstrings[0])
@@ -81,8 +81,22 @@ def amplitude_sweep(
     array in input order.
 
     ``circuit`` is consumed (finalizer semantics, like every
-    ``into_*_network``). All bitstrings must be fully determined (no
-    ``*`` wildcards) and of equal length.
+    ``into_*_network``). All bitstrings must be of equal length.
+
+    **Wildcards**: a ``'*'`` position marginalizes that qubit — the
+    sweep returns the real marginal *probabilities* of the determined
+    positions (``Σ_wildcards |⟨b|C|0⟩|²``) instead of complex
+    amplitudes, contracted as traced sandwich legs by
+    :func:`tnc_tpu.queries.marginal.marginal_sweep`. All bitstrings of
+    one sweep must then share the same wildcard mask (the mask IS the
+    network structure; split per-mask to mix).
+
+    >>> from tnc_tpu.builders.circuit_builder import Circuit as _C
+    >>> from tnc_tpu.tensornetwork.tensordata import TensorData as _T
+    >>> c = _C(); reg = c.allocate_register(2)
+    >>> c.append_gate(_T.gate("x"), [reg.qubit(0)])
+    >>> amplitude_sweep(c, ["1*", "0*"]).tolist()
+    [1.0, 0.0]
 
     >>> import math
     >>> from tnc_tpu.builders.circuit_builder import Circuit
@@ -98,6 +112,16 @@ def amplitude_sweep(
     """
     if not bitstrings:
         return np.zeros((0,), dtype=np.complex128)
+    if any("*" in str(b) for b in bitstrings):
+        # wildcard sweep = marginal probabilities over the sandwich
+        # network (lazy import: queries builds on the serve layer,
+        # which imports this module's package)
+        from tnc_tpu.queries.marginal import marginal_sweep
+
+        return marginal_sweep(
+            circuit, list(bitstrings), pathfinder=pathfinder,
+            backend=backend,
+        )
     program, arrays, bra_slots = _sweep_program(
         circuit, bitstrings, pathfinder
     )
