@@ -1841,13 +1841,16 @@ def _serve_bench() -> dict:
     BENCH_SERVE_MIX amplitude/sample/expectation/marginal mix are fired
     from a thread pool through the mixed micro-batching queue; the
     block reports overall queries/sec, the realized batch-size
-    distribution, p50/p99 latency, and the same per query type
+    distribution, p50/p99 latency, the same per query type
     (``by_type``: requests, qps, p50/p99 ms — the per-type serving
-    surface scripts/perf_gate.py cross-checks)."""
+    surface scripts/perf_gate.py cross-checks), and the ``slo`` block
+    (burn rates, the drift detector's worst measured-vs-baseline
+    dispatch ratio, fired alerts — gate-checked at 1.5x drift)."""
     import concurrent.futures
 
     from tnc_tpu import obs
     from tnc_tpu.builders.random_circuit import brickwork_circuit
+    from tnc_tpu.obs.slo import BurnWindow, LatencyObjective, SLOConfig
     from tnc_tpu.serve import ContractionService
 
     n = _env_int("BENCH_SERVE_QUBITS", 10)
@@ -1904,6 +1907,27 @@ def _serve_bench() -> dict:
             return svc.submit(payload)
         return svc.submit_query(kind, payload)
 
+    # SLO engine riding the measured run: a deliberately loose latency
+    # objective — the bench fires its whole query set as one burst, so
+    # per-request latency includes queueing behind the burst and only a
+    # deadline-scale stall should alert; drift (self-baselined per
+    # bucket on the first measured dispatches) is the signal the perf
+    # gate actually watches
+    slo_cfg = SLOConfig(
+        objectives=(
+            LatencyObjective(
+                "*",
+                float(os.environ.get("BENCH_SERVE_SLO_MS", "30000")) / 1e3,
+                target=0.99,
+            ),
+        ),
+        windows=(BurnWindow(60.0, 300.0, 14.4),),
+        drift_threshold=float(
+            os.environ.get("BENCH_SERVE_DRIFT_THRESHOLD", "3.0")
+        ),
+        drift_baseline_samples=4,
+        drift_min_samples=8,
+    )
     with obs.span("bench.serve", queries=n_queries):
         with ContractionService.from_circuit(
             circuit,
@@ -1928,6 +1952,10 @@ def _serve_bench() -> dict:
                 if kind != "amplitude" and weight > 0:
                     submit(make_query(kind)).result(timeout=600)
             svc.reset_stats()  # warmup must not skew the published stats
+            # SLO engine attaches AFTER warmup: compile-time requests
+            # must neither burn the latency objective nor seed the
+            # drift detector's per-bucket baselines
+            svc.attach_slo(slo_cfg)
             t0 = time.monotonic()
             with concurrent.futures.ThreadPoolExecutor(16) as pool:
                 futs = list(pool.map(submit, queries))
@@ -1946,6 +1974,31 @@ def _serve_bench() -> dict:
             "p50_ms": round(row["latency_s"]["p50"] * 1e3, 3),
             "p99_ms": round(row["latency_s"]["p99"] * 1e3, 3),
         }
+    slo_stats = stats.get("slo") or {}
+    drift_ratios = [
+        row["ratio"] for row in (slo_stats.get("drift") or {}).values()
+        if row.get("n", 0) >= slo_cfg.drift_min_samples
+        and row.get("ratio", 0) > 0
+    ]
+    slo_block = {
+        "alerts": [a["key"] for a in slo_stats.get("alerts", [])],
+        "alerts_total": slo_stats.get("alerts_total", 0),
+        "drift_max_ratio": (
+            round(max(max(drift_ratios), 1.0 / min(drift_ratios)), 4)
+            if drift_ratios
+            else None
+        ),
+        "burn": [
+            {
+                "type": obj["type"],
+                "burn_short": w["burn_short"],
+                "burn_long": w["burn_long"],
+                "factor": w["factor"],
+            }
+            for obj in slo_stats.get("objectives", [])
+            for w in obj.get("windows", [])
+        ],
+    }
     block = {
         "backend": backend_name,
         "qubits": n,
@@ -1958,6 +2011,7 @@ def _serve_bench() -> dict:
         "latency_s": stats["latency_s"],
         "counts": stats["counts"],
         "by_type": by_type,
+        "slo": slo_block,
     }
     log(
         f"[bench] serving: {block['qps']} q/s over {n_queries} queries "
@@ -1970,6 +2024,10 @@ def _serve_bench() -> dict:
             f"[bench]   {kind}: {row['requests']} reqs, {row['qps']} q/s, "
             f"p50 {row['p50_ms']:.2f} ms, p99 {row['p99_ms']:.2f} ms"
         )
+    log(
+        f"[bench]   slo: drift_max_ratio {slo_block['drift_max_ratio']}, "
+        f"alerts {slo_block['alerts'] or 'none'}"
+    )
     return block
 
 

@@ -86,6 +86,9 @@ TNC_TPU_PLATFORM=cpu python scripts/serve_smoke.py
 echo "== query-engine smoke (sampling/expectation/marginal vs statevector oracle, mixed queue) =="
 TNC_TPU_PLATFORM=cpu python scripts/query_smoke.py
 
+echo "== SLO smoke (live /metrics==stats, >=95% trace attribution, injected slowdown flips burn+drift) =="
+TNC_TPU_PLATFORM=cpu python scripts/slo_smoke.py
+
 echo "== distributed smoke (2-process scatter -> overlapped fan-in -> gather, oracle bit-compare) =="
 python scripts/distributed_smoke.py
 

@@ -30,7 +30,11 @@ failures, unless ``--strict``):
 - the mixed-workload serving block (``serving.by_type.<kind>``) —
   per-query-type qps and p50 latency, so a regression confined to one
   query type (sampling, expectation, marginal) is flagged even when
-  amplitude traffic dominates the overall numbers.
+  amplitude traffic dominates the overall numbers;
+- the serving SLO block (``serving.slo``) — the candidate's worst
+  measured-vs-baseline dispatch drift ratio (warn beyond 1.5x: the
+  hardware/schedule moved away from what the run itself calibrated)
+  and any burn/drift alerts the measured run fired.
 
 Exit codes: 0 pass, 1 regression, 2 unusable input (missing files,
 error records, mismatched metrics).
@@ -200,6 +204,24 @@ def compare(
                 f"{float(cp50) / float(bp):.2f}x ({bp:.4g}ms -> "
                 f"{cp50:.4g}ms)"
             )
+
+    # serving SLO cross-check: a candidate whose serve bench drifted
+    # >1.5x from its own warmup baseline, or fired burn/drift alerts
+    # during the measured run, is suspect even when the headline and
+    # per-type numbers absorbed it
+    cslo = (cand.get("serving") or {}).get("slo") or {}
+    drift_ratio = cslo.get("drift_max_ratio")
+    if drift_ratio and float(drift_ratio) > 1.5:
+        msgs.append(
+            f"warning: serving dispatch drift ratio {float(drift_ratio):.2f}x "
+            f"(measured vs calibrated baseline; threshold 1.5x)"
+        )
+    slo_alerts = cslo.get("alerts") or []
+    if slo_alerts:
+        msgs.append(
+            "warning: serving SLO alerts fired during the candidate "
+            f"bench run: {', '.join(str(a) for a in slo_alerts)}"
+        )
 
     # kernel-ladder per-bucket cross-check: effective-flop-credited MFU
     # when both records carry it, achieved FLOP/s otherwise — a bucket

@@ -1,0 +1,218 @@
+#!/usr/bin/env python
+"""`top` for a serving replica: poll its telemetry endpoint and render
+a refreshing ops view.
+
+Points at the ``/metrics`` + ``/healthz`` + ``/slo`` endpoint a
+:class:`~tnc_tpu.serve.service.ContractionService` exposes
+(``serve_telemetry()`` / ``from_circuit(..., telemetry_port=...)``;
+worker replicas via ``serve_cluster(..., telemetry_port=...)``) and
+shows, per refresh:
+
+- health + queue depth,
+- per-query-type qps (derived from successive completed-counter
+  samples), p50/p90/p99 latency,
+- plan-cache hit rate and replanner swap counts (obs registry
+  counters, present when the replica runs with ``TNC_TPU_TRACE``),
+- SLO burn rates per objective/window, drift ratio per executor
+  bucket, and the currently-firing alerts.
+
+Usage:
+    python scripts/serve_top.py http://127.0.0.1:9100
+    python scripts/serve_top.py --interval 5 http://host:9100
+    python scripts/serve_top.py --once http://host:9100   # one frame (CI)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def fetch_json(base: str, path: str, timeout: float = 5.0) -> dict:
+    try:
+        with urllib.request.urlopen(base + path, timeout=timeout) as r:
+            return json.load(r)
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        return {"error": str(e)}
+
+
+def fetch_metrics(base: str, timeout: float = 5.0) -> dict[str, float]:
+    from tnc_tpu.obs.http import parse_prometheus
+
+    try:
+        with urllib.request.urlopen(base + "/metrics", timeout=timeout) as r:
+            return parse_prometheus(r.read().decode("utf-8"))
+    except (urllib.error.URLError, OSError) as e:
+        return {"__error__": 0.0, "__error_msg__": str(e)}  # type: ignore[dict-item]
+
+
+def _series(metrics: dict, family: str) -> dict[str, float]:
+    """All series of one family: ``{label_block: value}``."""
+    out = {}
+    for key, value in metrics.items():
+        if key == family:
+            out[""] = value
+        elif key.startswith(family + "{"):
+            out[key[len(family):]] = value
+    return out
+
+
+def _label(block: str, name: str) -> str | None:
+    marker = f'{name}="'
+    i = block.find(marker)
+    if i < 0:
+        return None
+    j = block.index('"', i + len(marker))
+    return block[i + len(marker): j]
+
+
+def per_type_rows(metrics: dict) -> dict[str, dict]:
+    """{type: {completed, p50, p90, p99}} from the service families."""
+    rows: dict[str, dict] = {}
+    for block, value in _series(
+        metrics, "tnc_tpu_serve_type_requests_total"
+    ).items():
+        kind, outcome = _label(block, "type"), _label(block, "outcome")
+        if kind is None or outcome is None:
+            continue
+        rows.setdefault(kind, {})[outcome] = value
+    for block, value in _series(
+        metrics, "tnc_tpu_serve_type_latency_seconds"
+    ).items():
+        kind, q = _label(block, "type"), _label(block, "quantile")
+        if kind is None or q is None:
+            continue
+        rows.setdefault(kind, {})[f"p{q}"] = value
+    return rows
+
+
+def cache_hit_rate(metrics: dict) -> float | None:
+    hits = sum(_series(metrics, "tnc_tpu_serve_plan_cache_hit_total").values())
+    misses = sum(
+        _series(metrics, "tnc_tpu_serve_plan_cache_miss_total").values()
+    )
+    total = hits + misses
+    return hits / total if total > 0 else None
+
+
+def render_frame(
+    base: str,
+    health: dict,
+    slo: dict,
+    metrics: dict,
+    prev: dict[str, float] | None,
+    dt: float,
+) -> tuple[str, dict[str, float]]:
+    lines = [
+        f"serve_top — {base}   {time.strftime('%H:%M:%S')}",
+        f"health: {health.get('status', '?')}  "
+        f"queue_depth={health.get('queue_depth', '?')}  "
+        f"role={health.get('role', 'service')}",
+    ]
+    rows = per_type_rows(metrics)
+    completed_now: dict[str, float] = {}
+    head = (
+        f"{'type':<14} {'done':>8} {'qps':>7} "
+        f"{'p50 ms':>8} {'p90 ms':>8} {'p99 ms':>8}"
+    )
+    lines += [head, "-" * len(head)]
+    for kind in sorted(rows):
+        row = rows[kind]
+        done = row.get("completed", 0.0)
+        completed_now[kind] = done
+        qps = (
+            (done - prev.get(kind, done)) / dt
+            if prev is not None and dt > 0
+            else 0.0
+        )
+        lines.append(
+            f"{kind:<14} {done:>8.0f} {qps:>7.1f} "
+            f"{row.get('p0.5', 0.0) * 1e3:>8.2f} "
+            f"{row.get('p0.9', 0.0) * 1e3:>8.2f} "
+            f"{row.get('p0.99', 0.0) * 1e3:>8.2f}"
+        )
+    hit = cache_hit_rate(metrics)
+    swaps = _series(metrics, "tnc_tpu_serve_plan_swaps_total").get("", 0.0)
+    lines.append(
+        "plan cache: "
+        + (f"{hit:.1%} hit" if hit is not None else "n/a (trace off?)")
+        + f"   replan swaps: {swaps:.0f}"
+    )
+    if slo.get("enabled"):
+        for obj in slo.get("objectives", []):
+            for w in obj.get("windows", []):
+                lines.append(
+                    f"burn[{obj['type']} <= {obj['threshold_s'] * 1e3:g}ms "
+                    f"@{obj['target']:.0%}] "
+                    f"{w['short_s']:g}s/{w['long_s']:g}s: "
+                    f"{w['burn_short']:.2f}x / {w['burn_long']:.2f}x "
+                    f"(alert > {w['factor']:g}x)"
+                )
+        for bucket, d in sorted(slo.get("drift", {}).items()):
+            lines.append(
+                f"drift[{bucket}]: ratio {d['ratio']:.2f} "
+                f"(n={d['n']}{', ALERTING' if d['alerting'] else ''})"
+            )
+        alerts = slo.get("alerts", [])
+        lines.append(
+            f"ALERTS FIRING: {len(alerts)}"
+            + ("" if not alerts else " — " + "; ".join(
+                a["key"] for a in alerts
+            ))
+        )
+    else:
+        lines.append("slo: engine not attached")
+    return "\n".join(lines), completed_now
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Refresh-loop ops view over a serving replica's "
+        "telemetry endpoint"
+    )
+    parser.add_argument("url", help="endpoint base, e.g. http://host:9100")
+    parser.add_argument(
+        "--interval", type=float, default=2.0, help="refresh seconds"
+    )
+    parser.add_argument(
+        "--once", action="store_true",
+        help="print one frame and exit (no screen clearing) — CI/tests",
+    )
+    args = parser.parse_args(argv)
+    base = args.url.rstrip("/")
+
+    prev: dict[str, float] | None = None
+    t_prev = time.monotonic()
+    while True:
+        health = fetch_json(base, "/healthz")
+        slo = fetch_json(base, "/slo")
+        metrics = fetch_metrics(base)
+        if "error" in health and "__error_msg__" in metrics:
+            print(f"serve_top: endpoint unreachable: {health['error']}",
+                  file=sys.stderr)
+            if args.once:
+                return 1
+            time.sleep(args.interval)
+            continue
+        now = time.monotonic()
+        frame, prev = render_frame(
+            base, health, slo, metrics, prev, now - t_prev
+        )
+        t_prev = now
+        if args.once:
+            print(frame)
+            return 0
+        sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+        sys.stdout.flush()
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,239 @@
+#!/usr/bin/env python
+"""SLO-engine smoke for scripts/check.sh: a live mixed-query
+ContractionService with the telemetry endpoint, pinned three ways.
+
+1. **Surface agreement**: the per-type latency percentiles scraped off
+   ``/metrics`` equal ``stats()``'s (same QuantileSummary objects —
+   byte-equal after the block's rounding).
+2. **Trace attribution**: the exported trace's ``--serve`` rollup
+   attributes >= 95% of ``serve.dispatch`` wall time to request ids.
+3. **Alert flip**: a healthy control run fires NO alerts; the same
+   service under an injected slowdown (fault DSL ``serve.dispatch=
+   slow:...``) fires exactly the burn + drift alerts.
+
+Deterministic on CPU: the slowdown is a scripted sleep, the drift
+baseline is self-calibrated from the healthy phase, and the burn
+objective's threshold sits far above healthy latency and far below the
+injected sleep.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+import tnc_tpu.obs as obs  # noqa: E402
+from tnc_tpu.builders.random_circuit import brickwork_circuit  # noqa: E402
+from tnc_tpu.obs.core import MetricsRegistry  # noqa: E402
+from tnc_tpu.obs.http import parse_prometheus, wait_port_released  # noqa: E402
+from tnc_tpu.obs.slo import (  # noqa: E402
+    BurnWindow,
+    LatencyObjective,
+    SLOConfig,
+)
+from tnc_tpu.resilience.faultinject import faults  # noqa: E402
+from tnc_tpu.serve import ContractionService  # noqa: E402
+
+N_QUBITS = 6
+DEPTH = 4
+HEALTHY_QUERIES = 24
+SLOW_QUERIES = 12
+SERIAL_SINGLES = 6  # singleton amplitudes per phase: a pinned b1 bucket
+SLOW_S = 0.4  # injected per-dispatch sleep
+LATENCY_SLO_S = 0.2  # healthy CPU dispatch is ~ms; the sleep busts it
+
+
+def slo_config() -> SLOConfig:
+    return SLOConfig(
+        objectives=(LatencyObjective("*", LATENCY_SLO_S, target=0.9),),
+        # windows sized to the smoke's seconds-long run; factor 2 means
+        # "burning budget at twice the sustainable rate on BOTH windows"
+        windows=(BurnWindow(15.0, 60.0, 2.0),),
+        min_requests=8,
+        # threshold 3x (not the production 1.5x): ms-scale CPU dispatch
+        # timing is noisy and the injected ratio is ~100x — wide margin
+        # on the quiet side, no margin needed on the firing side
+        drift_threshold=3.0,
+        drift_alpha=0.3,
+        drift_min_samples=3,
+        # self-baseline per bucket on the healthy phase: drift means
+        # "changed since this service started", the incident signal
+        drift_baseline_samples=4,
+    )
+
+
+def settle(svc, expect_completed: int, timeout_s: float = 30.0) -> None:
+    """Wait until the dispatcher's bookkeeping catches up: futures
+    resolve BEFORE `_finish` observes the latency, so an exact
+    stats-vs-/metrics comparison must first quiesce."""
+    import time
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if svc.stats()["counts"]["completed"] >= expect_completed:
+            return
+        time.sleep(0.01)
+    raise AssertionError(
+        f"service never settled at {expect_completed} completed requests"
+    )
+
+
+def run_traffic(svc, rng, n: int) -> None:
+    futs = []
+    for i in range(n):
+        if i % 4 == 3:
+            futs.append(svc.submit_marginal(
+                "".join(rng.choice(["0", "1"], N_QUBITS - 2)) + "**"
+            ))
+        elif i % 8 == 5:
+            futs.append(svc.submit_sample(1, seed=int(i)))
+        else:
+            futs.append(svc.submit("".join(rng.choice(["0", "1"], N_QUBITS))))
+    for f in futs:
+        f.result(timeout=600)
+
+
+def fetch(url: str):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        body = r.read().decode("utf-8")
+    return body
+
+
+def check_metrics_match_stats(svc, base: str) -> None:
+    """Pin 1: /metrics percentiles == stats() percentiles, per type."""
+    stats = svc.stats()
+    pm = parse_prometheus(fetch(base + "/metrics"))
+    checked = 0
+    for kind, row in stats["by_type"].items():
+        if row["counts"]["completed"] == 0:
+            continue
+        for q, qlabel in (("p50", "0.5"), ("p90", "0.9"), ("p99", "0.99")):
+            key = (
+                f'tnc_tpu_serve_type_latency_seconds'
+                f'{{quantile="{qlabel}",type="{kind}"}}'
+            )
+            got = pm.get(key)
+            want = row["latency_s"][q]
+            assert got == want, (
+                f"/metrics vs stats() mismatch for {kind} {q}: "
+                f"{got} != {want}"
+            )
+            checked += 1
+    assert checked >= 6, f"too few percentile series checked ({checked})"
+    print(f"[slo_smoke] /metrics == stats() on {checked} percentile series")
+
+
+def check_attribution() -> None:
+    """Pin 2: >= 95% of dispatch wall attributed to request ids."""
+    from tnc_tpu.obs.export import serve_trace_rollup
+
+    path = os.path.join(tempfile.mkdtemp(), "serve_trace.json")
+    obs.export_chrome_trace(path)
+    rollup = serve_trace_rollup(obs.load_trace_events(path))
+    share = rollup["attributed_share"]
+    assert share >= 0.95, (
+        f"only {share:.1%} of dispatch wall time attributed to request ids"
+    )
+    assert rollup["requests"], "rollup found no serve.request timelines"
+    types = {r["type"] for r in rollup["requests"].values()}
+    assert {"amplitude", "marginal"} <= types, types
+    print(
+        f"[slo_smoke] trace rollup: {share:.1%} of "
+        f"{rollup['dispatch_wall_ms']:.1f} ms dispatch wall attributed "
+        f"across {len(rollup['requests'])} requests ({sorted(types)})"
+    )
+
+
+def main() -> int:
+    obs.configure(enabled=True, registry=MetricsRegistry())
+    rng = np.random.default_rng(11)
+    circuit = brickwork_circuit(N_QUBITS, DEPTH, np.random.default_rng(0))
+
+    with ContractionService.from_circuit(
+        circuit,
+        queries=True,
+        slo=slo_config(),
+        telemetry_port=0,
+        max_batch=8,
+        max_wait_ms=1.0,
+    ) as svc:
+        base = svc._telemetry.url
+        port = svc._telemetry.port
+
+        # structure warmup: every query structure plans/compiles before
+        # the pinned phases, so planning time never rides a pinned
+        # request's latency
+        svc.amplitude("0" * N_QUBITS)
+        svc.marginal("0" * (N_QUBITS - 2) + "**")
+        svc.sample(1, seed=0)
+
+        # ---- healthy control phase -----------------------------------
+        # serial singleton amplitudes pin the amplitude/b1 drift bucket
+        # (deterministic batch size 1), completing its self-baseline
+        for _ in range(SERIAL_SINGLES):
+            svc.amplitude("".join(rng.choice(["0", "1"], N_QUBITS)))
+        run_traffic(svc, rng, HEALTHY_QUERIES)
+        settle(svc, 3 + SERIAL_SINGLES + HEALTHY_QUERIES)
+        healthy = svc.stats()
+        assert healthy["slo"]["alerts"] == [], (
+            f"healthy run fired alerts: {healthy['slo']['alerts']}"
+        )
+        health = json.loads(fetch(base + "/healthz"))
+        assert health["status"] == "ok", health
+        slo_body = json.loads(fetch(base + "/slo"))
+        assert slo_body["enabled"] and slo_body["alerts"] == [], slo_body
+        assert slo_body["recent_requests"], "no request timelines on /slo"
+        check_metrics_match_stats(svc, base)
+        print(
+            "[slo_smoke] healthy: "
+            f"{healthy['counts']['completed']} completed, 0 alerts"
+        )
+
+        # ---- injected slowdown ---------------------------------------
+        with faults(f"serve.dispatch=slow:{SLOW_S}*-1"):
+            # serial singles again: the baselined amplitude/b1 bucket
+            # sees the slowdown for certain, whatever the batching of
+            # the mixed burst does
+            for _ in range(4):
+                svc.amplitude("".join(rng.choice(["0", "1"], N_QUBITS)))
+            run_traffic(svc, rng, SLOW_QUERIES)
+        settle(
+            svc, 3 + SERIAL_SINGLES + HEALTHY_QUERIES + 4 + SLOW_QUERIES
+        )
+        slow = svc.stats()["slo"]
+        kinds = sorted({a["kind"] for a in slow["alerts"]})
+        assert kinds == ["burn", "drift"], (
+            f"injected slowdown flipped {kinds or 'no alerts'}, "
+            f"expected exactly ['burn', 'drift']: {slow['alerts']}"
+        )
+        drifting = [
+            b for b, d in slow["drift"].items() if d["alerting"]
+        ]
+        print(
+            f"[slo_smoke] injected {SLOW_S}s slowdown: alerts "
+            f"{[a['key'] for a in slow['alerts']]} (drifting buckets: "
+            f"{drifting})"
+        )
+
+    # ---- endpoint lifecycle ------------------------------------------
+    assert wait_port_released("127.0.0.1", port), (
+        f"telemetry port {port} still accepting connections after stop()"
+    )
+    print(f"[slo_smoke] telemetry port {port} released on stop()")
+
+    check_attribution()
+    print("[slo_smoke] OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

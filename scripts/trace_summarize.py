@@ -14,10 +14,17 @@ with its achieved throughput (GFLOP/s, GB/s) over its measured wall
 time — the roofline view of where the cost model and the hardware
 disagree (docs/observability.md).
 
+``--serve`` rolls ``serve.*`` spans up per request id and query type:
+each ``serve.request`` span's args are that request's timeline
+(queue-age / batch-wait / dispatch breakdown), and each
+``serve.dispatch`` span's wall time is attributed back to the rider id
+list it carries — the per-request complement of the per-stage views.
+
 Usage:
     python scripts/trace_summarize.py bench_trace.json
     python scripts/trace_summarize.py --top 10 bench_trace.json
     python scripts/trace_summarize.py --roofline bench_trace.json
+    python scripts/trace_summarize.py --serve serve_trace.json
 """
 
 from __future__ import annotations
@@ -43,13 +50,32 @@ def main(argv: list[str] | None = None) -> int:
         help="per-stage predicted flops/bytes and achieved throughput "
              "instead of the plain time table",
     )
+    parser.add_argument(
+        "--serve", action="store_true",
+        help="per-request/per-query-type rollup of serve.* spans "
+             "(queue-age / batch-wait / dispatch attribution)",
+    )
     args = parser.parse_args(argv)
 
     from tnc_tpu.obs.export import (
+        format_serve_rollup,
         format_summary_table,
         load_trace_events,
+        serve_trace_rollup,
         trace_summary,
     )
+
+    if args.serve:
+        rollup = serve_trace_rollup(load_trace_events(args.trace))
+        if not rollup["requests"] and rollup["dispatch_wall_ms"] == 0.0:
+            print(
+                "no serve.* spans in trace (record a served workload "
+                "with TNC_TPU_TRACE)",
+                file=sys.stderr,
+            )
+            return 1
+        print(format_serve_rollup(rollup))
+        return 0
 
     rows = trace_summary(load_trace_events(args.trace))
     if not rows:

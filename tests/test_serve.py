@@ -625,6 +625,108 @@ class TestService:
             svc.stop()
         assert svc.stats()["counts"]["cancelled"] == 1
 
+    # -- per-type terminal-outcome accounting (one regression test per
+    # outcome: every terminal state must land in its by_type row, not
+    # just the global counters) --------------------------------------
+
+    def test_by_type_counts_completed(self):
+        svc = self._service(max_batch=4)
+        try:
+            [svc.submit(b).result(timeout=30) for b in random_bits(5, 3, 21)]
+        finally:
+            svc.stop()
+        row = svc.stats()["by_type"]["amplitude"]["counts"]
+        assert row["submitted"] == 3 and row["completed"] == 3
+
+    def test_by_type_counts_expired(self):
+        backend = SlowBackend(delay_s=0.5)
+        svc = self._service(backend=backend, max_batch=1, max_wait_ms=0.0)
+        try:
+            first = svc.submit("00000")
+            time.sleep(0.1)
+            doomed = svc.submit("11111", timeout_s=0.05)
+            with pytest.raises(DeadlineExceededError):
+                doomed.result(timeout=30)
+            first.result(timeout=30)
+        finally:
+            svc.stop()
+        row = svc.stats()["by_type"]["amplitude"]["counts"]
+        assert row["expired"] == 1
+        assert row["completed"] == 1
+
+    def test_by_type_counts_rejected(self):
+        backend = SlowBackend(delay_s=0.5)
+        svc = self._service(
+            backend=backend, max_batch=1, max_wait_ms=0.0, max_queue=1
+        )
+        try:
+            ok1 = svc.submit("00000")
+            time.sleep(0.1)
+            ok2 = svc.submit("00001")
+            with pytest.raises(QueueFullError):
+                svc.submit("00010")
+            ok1.result(timeout=30)
+            ok2.result(timeout=30)
+        finally:
+            svc.stop()
+        row = svc.stats()["by_type"]["amplitude"]["counts"]
+        assert row["rejected"] == 1
+
+    def test_by_type_counts_cancelled(self):
+        backend = SlowBackend(delay_s=0.3)
+        svc = self._service(backend=backend, max_batch=1, max_wait_ms=0.0)
+        try:
+            first = svc.submit("00000")
+            time.sleep(0.1)
+            doomed = svc.submit("11111")
+            assert doomed.cancel()
+            first.result(timeout=30)
+            svc.submit("01010").result(timeout=30)
+        finally:
+            svc.stop()
+        row = svc.stats()["by_type"]["amplitude"]["counts"]
+        assert row["cancelled"] == 1
+
+    def test_by_type_counts_failed(self):
+        svc = self._service(
+            backend=PoisonBackend("10101"), max_batch=4, max_wait_ms=100.0
+        )
+        try:
+            good = svc.submit("00000")
+            bad = svc.submit("10101")
+            good.result(timeout=30)
+            with pytest.raises(ValueError, match="poisoned"):
+                bad.result(timeout=30)
+        finally:
+            svc.stop()
+        row = svc.stats()["by_type"]["amplitude"]["counts"]
+        assert row["failed"] == 1
+        assert row["completed"] == 1
+
+    def test_request_timeline_spans(self, enabled_obs):
+        """Every request's terminal serve.request span carries its
+        timeline; serve.dispatch spans carry the rider id list."""
+        svc = self._service(max_batch=4)
+        try:
+            futs = [svc.submit(b) for b in random_bits(5, 4, 22)]
+            [f.result(timeout=30) for f in futs]
+        finally:
+            svc.stop()
+        recs = enabled_obs.span_records()
+        req_spans = [r for r in recs if r.name == "serve.request"]
+        assert len(req_spans) == 4
+        rids = {r.args["rid"] for r in req_spans}
+        assert len(rids) == 4  # unique ids
+        for r in req_spans:
+            assert r.args["outcome"] == "completed"
+            assert r.args["latency_s"] >= r.args["dispatch_s"] >= 0.0
+            assert r.args["queue_age_s"] >= 0.0
+        dispatch = [r for r in recs if r.name == "serve.dispatch"]
+        carried = set()
+        for d in dispatch:
+            carried.update(d.args["riders"].split(","))
+        assert rids <= carried  # every request attributed to a dispatch
+
     def test_one_shot_iterable_request(self):
         """A generator request is consumed exactly once (at admission
         validation) — the normalized string is what gets dispatched."""

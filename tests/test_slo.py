@@ -1,0 +1,564 @@
+"""Serving SLO engine + telemetry endpoint (tnc_tpu.obs.slo / .http).
+
+Pins the observability-layer contracts:
+
+- **burn-rate math** on synthetic timelines under an injected clock:
+  crossing both windows alerts, crossing only the short window (long
+  diluted by old good traffic) does not, thin traffic below
+  ``min_requests`` never alerts, objectives filter by query type;
+- **drift EWMA** under injected model error: slowdowns AND speedups
+  alert, min-sample and baseline guards hold, raw measured seconds
+  without a baseline never alert (unitless comparison);
+- **Prometheus rendering**: label escaping, deterministic ordering,
+  counter ``_total`` convention, summary quantiles off the same
+  QuantileSummary that stats() reads;
+- **endpoint lifecycle**: scrape while serving, 404/503 behavior, and
+  port release on ``stop()``;
+- **streaming quantiles**: P² accuracy within tolerance on known
+  distributions, exact count/sum/min/max.
+"""
+
+import json
+import socket
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import tnc_tpu.obs as obs
+from tnc_tpu.obs.core import MetricsRegistry, QuantileSummary
+from tnc_tpu.obs.http import (
+    TelemetryServer,
+    escape_label_value,
+    parse_prometheus,
+    render_prometheus,
+    wait_port_released,
+)
+from tnc_tpu.obs.slo import (
+    BurnWindow,
+    DriftDetector,
+    LatencyObjective,
+    SLOConfig,
+    SLOEngine,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def engine(clock, objectives=None, windows=None, min_requests=4, **drift_kw):
+    cfg = SLOConfig(
+        objectives=objectives
+        or (LatencyObjective("*", 0.1, target=0.9),),
+        windows=windows or (BurnWindow(60.0, 300.0, 2.0),),
+        min_requests=min_requests,
+        **drift_kw,
+    )
+    return SLOEngine(cfg, clock=clock)
+
+
+class TestBurnRates:
+    def test_crossing_both_windows_alerts(self):
+        clock = FakeClock()
+        eng = engine(clock)
+        for _ in range(10):  # all bad: latency 10x the threshold
+            eng.record_request("amplitude", 1.0)
+        alerts = eng.check()
+        assert [a["kind"] for a in alerts] == ["burn"]
+        # burn = bad_frac / budget = 1.0 / 0.1 = 10 on both windows
+        w = eng.burn_rates()[0]["windows"][0]
+        assert w["burn_short"] == pytest.approx(10.0)
+        assert w["burn_long"] == pytest.approx(10.0)
+
+    def test_short_spike_diluted_long_window_stays_quiet(self):
+        clock = FakeClock(1000.0)
+        eng = engine(clock)
+        # 200s of healthy traffic inside the long window only
+        for i in range(40):
+            eng.record_request("amplitude", 0.01, t=1000.0 + i * 5.0)
+        clock.t = 1250.0
+        # recent spike: 5 bad requests inside the 60s short window
+        for _ in range(5):
+            eng.record_request("amplitude", 1.0, t=1245.0)
+        # short burn high, long burn diluted below factor 2:
+        # long: 5/45 / 0.1 = 1.11 < 2 — no alert
+        w = eng.burn_rates()[0]["windows"][0]
+        assert w["burn_short"] > 2.0
+        assert w["burn_long"] < 2.0
+        assert eng.check() == []
+
+    def test_min_requests_guard(self):
+        clock = FakeClock()
+        eng = engine(clock, min_requests=10)
+        for _ in range(5):  # all bad, but too few to trust
+            eng.record_request("amplitude", 1.0)
+        assert eng.check() == []
+
+    def test_non_completed_outcomes_burn_budget(self):
+        clock = FakeClock()
+        eng = engine(clock)
+        for outcome in ("failed", "expired", "rejected", "cancelled"):
+            eng.record_request("amplitude", 0.0, outcome)
+        for _ in range(4):
+            eng.record_request("amplitude", 0.01)  # fast + completed
+        # 4 bad of 8 → burn 5 > 2 on both windows
+        assert [a["kind"] for a in eng.check()] == ["burn"]
+        assert eng.stats()["outcomes"]["failed"] == 1
+
+    def test_per_type_objective_filters(self):
+        clock = FakeClock()
+        eng = engine(
+            clock,
+            objectives=(
+                LatencyObjective("amplitude", 0.1, target=0.9),
+                LatencyObjective("sample", 10.0, target=0.9),
+            ),
+        )
+        for _ in range(10):
+            eng.record_request("sample", 1.0)  # fine under sample's SLO
+        assert eng.check() == []
+        for _ in range(10):
+            eng.record_request("amplitude", 1.0)  # busts amplitude's
+        alerts = eng.check()
+        assert len(alerts) == 1 and alerts[0]["type"] == "amplitude"
+
+    def test_events_age_out_of_windows(self):
+        clock = FakeClock(1000.0)
+        eng = engine(clock)
+        for _ in range(10):
+            eng.record_request("amplitude", 1.0, t=1000.0)
+        assert eng.check(t=1001.0)  # firing now
+        clock.t = 1000.0 + 400.0  # beyond the 300s long window
+        assert eng.check() == []  # aged out: alert clears
+
+    def test_alert_edge_trigger_counts_once(self):
+        clock = FakeClock()
+        reg = obs.configure(enabled=True, registry=MetricsRegistry())
+        try:
+            eng = engine(clock)
+            for _ in range(10):
+                eng.record_request("amplitude", 1.0)
+            eng.check()
+            eng.check()
+            eng.check()  # still firing: no re-count
+            assert reg.counters()[("slo.alerts", (("kind", "burn"),))] == 1.0
+            assert eng.stats()["alerts_total"] == 1
+        finally:
+            obs.configure(enabled=False, registry=MetricsRegistry())
+
+
+class TestDriftDetector:
+    def test_slowdown_alerts(self):
+        d = DriftDetector(threshold=1.5, alpha=0.5, min_samples=2)
+        for _ in range(4):
+            d.update("amp/b8", 0.01, 0.01)
+        assert d.alerting() == {}
+        for _ in range(8):  # injected 10x model error
+            d.update("amp/b8", 0.01, 0.1)
+        assert "amp/b8" in d.alerting()
+
+    def test_speedup_alerts_too(self):
+        d = DriftDetector(threshold=1.5, alpha=0.5, min_samples=2)
+        for _ in range(8):
+            d.update("amp/b8", 0.01, 0.001)  # 10x faster than predicted
+        ratio = d.alerting().get("amp/b8")
+        assert ratio is not None and ratio < 1.0 / 1.5
+
+    def test_min_samples_guard(self):
+        d = DriftDetector(threshold=1.5, min_samples=5)
+        for _ in range(4):
+            d.update("amp/b8", 0.01, 0.1)
+        assert d.alerting() == {}
+
+    def test_ewma_damps_single_spike(self):
+        d = DriftDetector(threshold=1.5, alpha=0.1, min_samples=2)
+        for _ in range(20):
+            d.update("amp/b8", 0.01, 0.01)
+        d.update("amp/b8", 0.01, 0.05)  # one 5x spike
+        # ewma = 0.1*5 + 0.9*1 = 1.4 < 1.5: a lone spike is not drift
+        assert d.alerting() == {}
+        assert d.stats()["amp/b8"]["ratio"] < 1.5
+
+    def test_raw_measured_without_baseline_never_alerts(self):
+        # no prediction + no self-baseline: seconds vs a unitless band
+        d = DriftDetector(threshold=1.5, min_samples=2)
+        for _ in range(10):
+            d.update("amp/b1", None, 0.0001)  # "ratio" 1e-4 — meaningless
+        assert d.alerting() == {}
+
+    def test_self_baseline_makes_raw_seconds_a_signal(self):
+        d = DriftDetector(
+            threshold=1.5, alpha=0.5, min_samples=2, baseline_samples=4
+        )
+        for _ in range(6):
+            d.update("amp/b1", None, 0.001)  # healthy: baseline 1ms
+        assert d.alerting() == {}
+        for _ in range(6):
+            d.update("amp/b1", None, 0.1)  # 100x slowdown
+        assert d.alerting()["amp/b1"] > 1.5
+
+    def test_raw_first_sample_upgrades_to_calibrated(self):
+        """A cost-model hiccup on a bucket's FIRST dispatch must not
+        freeze the bucket raw forever — calibrated samples restart it."""
+        d = DriftDetector(threshold=1.5, alpha=0.5, min_samples=2)
+        d.update("amp/b1", None, 0.01)  # hiccup: raw first sample
+        for _ in range(8):
+            d.update("amp/b1", 0.01, 0.1)  # calibrated 10x drift
+        assert "amp/b1" in d.alerting()
+
+    def test_calibrated_bucket_drops_raw_hiccup(self):
+        d = DriftDetector(threshold=1.5, alpha=0.5, min_samples=2)
+        for _ in range(4):
+            d.update("amp/b1", 0.01, 0.01)
+        d.update("amp/b1", None, 5.0)  # hiccup: dropped, not folded in
+        assert d.alerting() == {}
+        assert d.stats()["amp/b1"]["n"] == 4
+
+    def test_per_bucket_isolation(self):
+        d = DriftDetector(threshold=1.5, alpha=0.5, min_samples=2)
+        for _ in range(8):
+            d.update("amp/b1", 0.01, 0.1)  # drifting
+            d.update("amp/b8", 0.01, 0.01)  # healthy
+        assert set(d.alerting()) == {"amp/b1"}
+
+    def test_engine_drift_alert_kind(self):
+        clock = FakeClock()
+        # baseline 0: pure-calibrated mode, ratio compared to 1 directly
+        eng = engine(
+            clock, drift_min_samples=2, drift_alpha=0.5,
+            drift_baseline_samples=0,
+        )
+        for _ in range(8):
+            eng.record_dispatch("amplitude/b8", 0.01, 0.1)
+        alerts = eng.check()
+        assert [a["kind"] for a in alerts] == ["drift"]
+        assert alerts[0]["bucket"] == "amplitude/b8"
+
+
+class TestQuantileSummary:
+    def test_exact_aggregates(self):
+        s = QuantileSummary()
+        vals = [3.0, 1.0, 2.0, 10.0]
+        for v in vals:
+            s.observe(v)
+        snap = s.snapshot()
+        assert snap["count"] == 4
+        assert snap["sum"] == pytest.approx(16.0)
+        assert snap["min"] == 1.0 and snap["max"] == 10.0
+
+    def test_small_sample_percentiles_exact(self):
+        s = QuantileSummary()
+        for v in (5.0, 1.0, 3.0):
+            s.observe(v)
+        assert s.quantile(0.5) == 3.0
+
+    def test_p2_accuracy_uniform(self):
+        rng = np.random.default_rng(0)
+        s = QuantileSummary()
+        data = rng.uniform(0.0, 100.0, 5000)
+        for v in data:
+            s.observe(float(v))
+        assert s.quantile(0.5) == pytest.approx(50.0, abs=5.0)
+        assert s.quantile(0.9) == pytest.approx(90.0, abs=5.0)
+        assert s.quantile(0.99) == pytest.approx(99.0, abs=3.0)
+
+    def test_p2_accuracy_lognormal_tail(self):
+        rng = np.random.default_rng(1)
+        s = QuantileSummary()
+        data = rng.lognormal(0.0, 1.0, 5000)
+        for v in data:
+            s.observe(float(v))
+        true = np.percentile(data, [50, 90, 99])
+        assert s.quantile(0.5) == pytest.approx(true[0], rel=0.15)
+        assert s.quantile(0.9) == pytest.approx(true[1], rel=0.25)
+        assert s.quantile(0.99) == pytest.approx(true[2], rel=0.35)
+
+    def test_registry_histograms_carry_quantiles(self):
+        reg = MetricsRegistry()
+        for v in range(100):
+            reg.observe("lat", float(v))
+        h = reg.histograms()[("lat", ())]
+        assert h["count"] == 100
+        assert {"p50", "p90", "p99"} <= set(h)
+        assert 30.0 <= h["p50"] <= 70.0
+
+
+class TestPrometheusRendering:
+    def test_counter_total_and_escaping(self):
+        reg = MetricsRegistry()
+        reg.counter_add("serve.requests", 2, label='va"l\\ue\nx')
+        text = render_prometheus(reg)
+        assert "# TYPE tnc_tpu_serve_requests_total counter" in text
+        assert '\\"' in text and "\\\\" in text and "\\n" in text
+        assert "\n " not in text.strip()  # no raw newline inside a line
+
+    def test_deterministic_ordering(self):
+        a, b = MetricsRegistry(), MetricsRegistry()
+        a.counter_add("z.last", 1)
+        a.counter_add("a.first", 1, x="2")
+        a.counter_add("a.first", 1, x="1")
+        a.gauge_set("m.mid", 5)
+        b.gauge_set("m.mid", 5)
+        b.counter_add("a.first", 1, x="1")
+        b.counter_add("a.first", 1, x="2")
+        b.counter_add("z.last", 1)
+        assert render_prometheus(a) == render_prometheus(b)
+        lines = [
+            ln for ln in render_prometheus(a).splitlines()
+            if not ln.startswith("#")
+        ]
+        assert lines == sorted(lines)
+
+    def test_histogram_renders_summary_series(self):
+        reg = MetricsRegistry()
+        reg.observe("serve.latency_s", 1.0, type="amplitude")
+        reg.observe("serve.latency_s", 3.0, type="amplitude")
+        pm = parse_prometheus(render_prometheus(reg))
+        base = "tnc_tpu_serve_latency_s"
+        assert pm[f'{base}_count{{type="amplitude"}}'] == 2.0
+        assert pm[f'{base}_sum{{type="amplitude"}}'] == 4.0
+        assert f'{base}{{quantile="0.5",type="amplitude"}}' in pm
+
+    def test_escape_label_value_roundtrip_chars(self):
+        assert escape_label_value('a"b') == 'a\\"b'
+        assert escape_label_value("a\\b") == "a\\\\b"
+        assert escape_label_value("a\nb") == "a\\nb"
+
+    def test_extra_overrides_registry_duplicate_series(self):
+        """A provider sample with the same family + labels as a
+        registry series replaces it — a Prometheus server rejects a
+        scrape containing duplicate samples outright."""
+        reg = MetricsRegistry()
+        reg.gauge_set("serve.queue_depth", 3.0)  # traced gauge (stale)
+        text = render_prometheus(
+            reg, [("gauge", "serve.queue_depth", {}, 5.0)]
+        )
+        samples = [
+            ln for ln in text.splitlines()
+            if ln.startswith("tnc_tpu_serve_queue_depth ")
+        ]
+        assert samples == ["tnc_tpu_serve_queue_depth 5.0"]
+
+    def test_extra_families_merge(self):
+        reg = MetricsRegistry()
+        extra = [
+            ("gauge", "serve.queue_depth", {}, 3),
+            ("counter", "serve.requests", {"outcome": "completed"}, 7),
+        ]
+        pm = parse_prometheus(render_prometheus(reg, extra))
+        assert pm["tnc_tpu_serve_queue_depth"] == 3.0
+        assert (
+            pm['tnc_tpu_serve_requests_total{outcome="completed"}'] == 7.0
+        )
+
+
+class TestTelemetryServer:
+    def _get(self, url, timeout=10):
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read().decode("utf-8")
+
+    def test_endpoints_and_port_release(self):
+        reg = MetricsRegistry()
+        reg.counter_add("demo.hits", 4)
+        srv = TelemetryServer(
+            registry=reg,
+            health_fn=lambda: {"status": "ok", "queue_depth": 0},
+            slo_fn=lambda: {"alerts": [], "enabled": True},
+        ).start()
+        try:
+            port = srv.port
+            assert port > 0
+            status, text = self._get(srv.url + "/metrics")
+            assert status == 200
+            assert (
+                parse_prometheus(text)["tnc_tpu_demo_hits_total"] == 4.0
+            )
+            status, body = self._get(srv.url + "/healthz")
+            assert status == 200 and json.loads(body)["status"] == "ok"
+            status, body = self._get(srv.url + "/slo")
+            assert status == 200 and json.loads(body)["enabled"] is True
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(srv.url + "/nope")
+            assert ei.value.code == 404
+        finally:
+            srv.stop()
+        # lifecycle pin: stop() must release the listening port
+        assert wait_port_released("127.0.0.1", port)
+        # and the port is rebindable immediately (SO_REUSEADDR, as a
+        # restarted server would bind — plain bind can hit TIME_WAIT
+        # from this test's own scrape connections)
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            s.bind(("127.0.0.1", port))
+        finally:
+            s.close()
+
+    def test_unhealthy_answers_503(self):
+        srv = TelemetryServer(
+            registry=MetricsRegistry(),
+            health_fn=lambda: {"status": "stopped"},
+        ).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(srv.url + "/healthz")
+            assert ei.value.code == 503
+        finally:
+            srv.stop()
+
+    def test_stop_idempotent(self):
+        srv = TelemetryServer(registry=MetricsRegistry()).start()
+        srv.stop()
+        srv.stop()  # second stop is a no-op
+
+
+class TestServeTraceRollup:
+    @staticmethod
+    def _span(name, ts_us, dur_us, **args):
+        return [
+            {"name": name, "ph": "B", "ts": ts_us, "pid": 1, "tid": 1,
+             "args": args},
+            {"name": name, "ph": "E", "ts": ts_us + dur_us, "pid": 1,
+             "tid": 1},
+        ]
+
+    def test_attribution_math(self):
+        from tnc_tpu.obs.export import serve_trace_rollup
+
+        events = []
+        # one 3-rider dispatch of 9ms, one singleton of 2ms
+        events += self._span(
+            "serve.dispatch", 0.0, 9000.0,
+            kind="amplitude", riders="r1,r2,r3", batch=3,
+        )
+        events += self._span(
+            "serve.dispatch", 10000.0, 2000.0,
+            kind="sample", riders="r4", batch=1,
+        )
+        for rid, kind in (("r1", "amplitude"), ("r2", "amplitude"),
+                          ("r3", "amplitude"), ("r4", "sample")):
+            events += self._span(
+                "serve.request", 20000.0, 0.0,
+                rid=rid, type=kind, outcome="completed",
+                latency_s=0.02, queue_age_s=0.001, batch_wait_s=0.0,
+                dispatch_s=0.009, riders=3 if kind == "amplitude" else 1,
+                generation=0,
+            )
+        rollup = serve_trace_rollup(events)
+        assert rollup["attributed_share"] == pytest.approx(1.0)
+        assert rollup["requests"]["r1"]["attributed_ms"] == pytest.approx(3.0)
+        assert rollup["requests"]["r4"]["attributed_ms"] == pytest.approx(2.0)
+        assert rollup["by_type"]["amplitude"]["requests"] == 3
+        assert rollup["by_type"]["amplitude"]["dispatch_ms"] == pytest.approx(
+            9.0
+        )
+
+    def test_riderless_dispatch_counts_as_unattributed(self):
+        from tnc_tpu.obs.export import serve_trace_rollup
+
+        events = self._span(
+            "serve.dispatch", 0.0, 5000.0, kind="amplitude", riders="r1",
+            batch=1,
+        ) + self._span(
+            "serve.dispatch", 6000.0, 5000.0, kind="amplitude", batch=1,
+        )
+        rollup = serve_trace_rollup(events)
+        assert rollup["attributed_share"] == pytest.approx(0.5)
+
+
+class TestServiceIntegration:
+    """The service-side wiring, on a tiny circuit."""
+
+    def _circuit(self):
+        from tests.test_serve import make_circuit
+
+        return make_circuit(n=4, depth=2, seed=3)
+
+    def test_stats_and_metrics_share_percentiles(self):
+        from tnc_tpu.serve import ContractionService
+
+        import time
+
+        with ContractionService.from_circuit(
+            self._circuit(), telemetry_port=0
+        ) as svc:
+            rng = np.random.default_rng(0)
+            for _ in range(9):
+                svc.amplitude("".join(rng.choice(["0", "1"], 4)))
+            # quiesce: futures resolve before _finish records latency
+            deadline = time.monotonic() + 30.0
+            while (
+                svc.stats()["counts"]["completed"] < 9
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.01)
+            stats = svc.stats()
+            with urllib.request.urlopen(
+                svc._telemetry.url + "/metrics", timeout=10
+            ) as r:
+                pm = parse_prometheus(r.read().decode("utf-8"))
+            blk = stats["by_type"]["amplitude"]["latency_s"]
+            for q, lab in (("p50", "0.5"), ("p90", "0.9"), ("p99", "0.99")):
+                key = (
+                    "tnc_tpu_serve_type_latency_seconds"
+                    f'{{quantile="{lab}",type="amplitude"}}'
+                )
+                assert pm[key] == blk[q]
+            assert (
+                pm['tnc_tpu_serve_type_requests_total'
+                   '{outcome="completed",type="amplitude"}'] == 9.0
+            )
+
+    def test_slo_block_in_stats_and_injected_slowdown(self):
+        from tnc_tpu.resilience.faultinject import faults
+        from tnc_tpu.serve import ContractionService
+
+        cfg = SLOConfig(
+            objectives=(LatencyObjective("*", 0.05, target=0.9),),
+            windows=(BurnWindow(30.0, 120.0, 2.0),),
+            min_requests=4,
+            drift_threshold=3.0,
+            drift_alpha=0.5,
+            drift_min_samples=2,
+            drift_baseline_samples=3,
+        )
+        with ContractionService.from_circuit(self._circuit(), slo=cfg) as svc:
+            for _ in range(6):
+                svc.amplitude("0000")
+            assert svc.stats()["slo"]["alerts"] == []
+            with faults("serve.dispatch=slow:0.2*-1"):
+                for _ in range(6):
+                    svc.amplitude("0000")
+            kinds = sorted({a["kind"] for a in svc.stats()["slo"]["alerts"]})
+            assert kinds == ["burn", "drift"]
+
+    def test_telemetry_port_released_on_service_stop(self):
+        from tnc_tpu.serve import ContractionService
+
+        svc = ContractionService.from_circuit(
+            self._circuit(), telemetry_port=0
+        )
+        port = svc._telemetry.port
+        svc.stop()
+        assert wait_port_released("127.0.0.1", port)
+
+
+class TestServeClusterTelemetry:
+    def test_worker_telemetry_single_process_guard(self):
+        """serve_cluster refuses to run single-process (its precondition)
+        — the telemetry wiring must not change that."""
+        from tnc_tpu.serve import bind_circuit, serve_cluster
+        from tests.test_serve import make_circuit
+
+        bound = bind_circuit(make_circuit(n=4, depth=2, seed=3))
+        with pytest.raises(RuntimeError, match="NON-root"):
+            serve_cluster(bound, telemetry_port=0)

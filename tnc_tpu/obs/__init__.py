@@ -10,6 +10,7 @@ Chrome-trace/Perfetto timeline at interpreter exit. See
 from tnc_tpu.obs.core import (  # noqa: F401
     MetricsRegistry,
     NULL_SPAN,
+    QuantileSummary,
     Span,
     SpanRecord,
     configure,
@@ -32,8 +33,10 @@ from tnc_tpu.obs.export import (  # noqa: F401
     emit_metrics,
     export_chrome_trace,
     export_jsonl,
+    format_serve_rollup,
     format_summary_table,
     load_trace_events,
+    serve_trace_rollup,
     trace_summary,
 )
 from tnc_tpu.obs.calibrate import (  # noqa: F401
@@ -44,3 +47,22 @@ from tnc_tpu.obs.calibrate import (  # noqa: F401
     fit_device_model,
     step_samples,
 )
+from tnc_tpu.obs.slo import (  # noqa: F401
+    BurnWindow,
+    DriftDetector,
+    LatencyObjective,
+    SLOConfig,
+    SLOEngine,
+)
+# the HTTP endpoint layer re-exports lazily (PEP 562): `from tnc_tpu
+# import obs` happens in every module of the library, and only
+# telemetry-serving processes should pay the http.server import
+_HTTP_EXPORTS = ("TelemetryServer", "parse_prometheus", "render_prometheus")
+
+
+def __getattr__(name: str):
+    if name in _HTTP_EXPORTS:
+        from tnc_tpu.obs import http as _http
+
+        return getattr(_http, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

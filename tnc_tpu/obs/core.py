@@ -69,6 +69,140 @@ class SpanRecord:
     args: dict = field(default_factory=dict)
 
 
+class _P2Quantile:
+    """One streaming quantile via the P² algorithm (Jain & Chlamtac
+    1985): five markers tracked in O(1) memory per observation — no
+    retained samples. Below 5 observations the estimate is the exact
+    nearest-rank percentile of what was seen."""
+
+    __slots__ = ("p", "_q", "_n", "_np", "_dn", "_count")
+
+    def __init__(self, p: float):
+        if not 0.0 < p < 1.0:
+            raise ValueError("quantile must be in (0, 1)")
+        self.p = float(p)
+        self._q: list[float] = []  # marker heights (sorted samples < 5)
+        self._n = [0.0, 1.0, 2.0, 3.0, 4.0]  # marker positions
+        self._np = [0.0, 2 * p, 4 * p, 2 + 2 * p, 4.0]  # desired positions
+        self._dn = [0.0, p / 2, p, (1 + p) / 2, 1.0]
+        self._count = 0
+
+    def observe(self, x: float) -> None:
+        self._count += 1
+        q = self._q
+        if len(q) < 5:
+            q.append(x)
+            q.sort()
+            return
+        n = self._n
+        if x < q[0]:
+            q[0] = x
+            k = 0
+        elif x >= q[4]:
+            q[4] = x
+            k = 3
+        else:
+            k = 0
+            for i in range(1, 5):
+                if x < q[i]:
+                    k = i - 1
+                    break
+        for i in range(k + 1, 5):
+            n[i] += 1.0
+        for i in range(5):
+            self._np[i] += self._dn[i]
+        for i in (1, 2, 3):
+            d = self._np[i] - n[i]
+            if (d >= 1.0 and n[i + 1] - n[i] > 1.0) or (
+                d <= -1.0 and n[i - 1] - n[i] < -1.0
+            ):
+                sign = 1 if d > 0 else -1
+                cand = self._parabolic(i, sign)
+                if not (q[i - 1] < cand < q[i + 1]):
+                    cand = self._linear(i, sign)
+                q[i] = cand
+                n[i] += sign
+
+    def _parabolic(self, i: int, d: int) -> float:
+        q, n = self._q, self._n
+        return q[i] + d / (n[i + 1] - n[i - 1]) * (
+            (n[i] - n[i - 1] + d) * (q[i + 1] - q[i]) / (n[i + 1] - n[i])
+            + (n[i + 1] - n[i] - d) * (q[i] - q[i - 1]) / (n[i] - n[i - 1])
+        )
+
+    def _linear(self, i: int, d: int) -> float:
+        q, n = self._q, self._n
+        return q[i] + d * (q[i + d] - q[i]) / (n[i + d] - n[i])
+
+    def value(self) -> float:
+        if self._count == 0:
+            return 0.0
+        if self._count <= 5:
+            s = self._q
+            return float(s[min(len(s) - 1, int(self.p * (len(s) - 1)))])
+        return float(self._q[2])
+
+
+class QuantileSummary:
+    """Bounded streaming distribution summary: count / sum / min / max
+    plus P² estimates for a fixed quantile set — p50/p90/p99 without
+    retaining raw samples, however long the stream runs. The shared
+    percentile surface of :meth:`MetricsRegistry.observe`, the serving
+    ``stats()`` latency blocks, and the ``/metrics`` Prometheus
+    rendering — one object, identical numbers everywhere it is read.
+
+    >>> s = QuantileSummary()
+    >>> for v in range(1, 101):
+    ...     s.observe(float(v))
+    >>> snap = s.snapshot()
+    >>> (snap["count"], snap["min"], snap["max"])
+    (100, 1.0, 100.0)
+    >>> 40.0 <= snap["p50"] <= 60.0
+    True
+    """
+
+    QUANTILES = (0.5, 0.9, 0.99)
+    __slots__ = ("count", "sum", "min", "max", "_estimators")
+
+    def __init__(self, quantiles: tuple = QUANTILES):
+        self.count = 0
+        self.sum = 0.0
+        self.min = 0.0
+        self.max = 0.0
+        self._estimators = {float(q): _P2Quantile(q) for q in quantiles}
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        if self.count == 0:
+            self.min = self.max = value
+        else:
+            self.min = min(self.min, value)
+            self.max = max(self.max, value)
+        self.count += 1
+        self.sum += value
+        for est in self._estimators.values():
+            est.observe(value)
+
+    def quantile(self, q: float) -> float:
+        est = self._estimators.get(float(q))
+        if est is None:
+            raise KeyError(f"quantile {q} is not tracked")
+        return est.value()
+
+    def quantiles(self) -> dict[float, float]:
+        return {q: est.value() for q, est in self._estimators.items()}
+
+    def snapshot(self) -> dict:
+        """Plain-data view; quantiles rendered as ``p50``-style keys."""
+        out = {
+            "count": self.count, "sum": self.sum,
+            "min": self.min, "max": self.max,
+        }
+        for q, est in self._estimators.items():
+            out[f"p{q * 100:g}".replace(".", "_")] = est.value()
+        return out
+
+
 class MetricsRegistry:
     """Process-local metric + span store. Thread-safe; one module-level
     instance serves the whole process (:func:`get_registry`), tests may
@@ -85,13 +219,15 @@ class MetricsRegistry:
     >>> h = reg.histograms()[("step_ms", ())]
     >>> (h["count"], h["sum"], h["min"], h["max"])
     (2, 4.0, 1.5, 2.5)
+    >>> sorted(k for k in h if k.startswith("p"))
+    ['p50', 'p90', 'p99']
     """
 
     def __init__(self, max_spans: int | None = None) -> None:
         self._lock = threading.Lock()
         self._counters: dict[tuple, float] = {}
         self._gauges: dict[tuple, float] = {}
-        self._hists: dict[tuple, dict] = {}
+        self._hists: dict[tuple, QuantileSummary] = {}
         self._spans: list[SpanRecord] = []
         self._active: dict[int, "Span"] = {}
         self._dropped = 0
@@ -118,16 +254,11 @@ class MetricsRegistry:
 
     def observe(self, name: str, value: float, **labels) -> None:
         key = self._key(name, labels)
-        value = float(value)
         with self._lock:
             h = self._hists.get(key)
             if h is None:
-                h = {"count": 0, "sum": 0.0, "min": value, "max": value}
-                self._hists[key] = h
-            h["count"] += 1
-            h["sum"] += value
-            h["min"] = min(h["min"], value)
-            h["max"] = max(h["max"], value)
+                h = self._hists[key] = QuantileSummary()
+            h.observe(value)
 
     def counters(self) -> dict[tuple, float]:
         with self._lock:
@@ -138,8 +269,11 @@ class MetricsRegistry:
             return dict(self._gauges)
 
     def histograms(self) -> dict[tuple, dict]:
+        """Plain-data snapshots (taken under the lock, so each block is
+        internally consistent) — also what the ``/metrics`` renderer
+        reads."""
         with self._lock:
-            return {k: dict(v) for k, v in self._hists.items()}
+            return {k: v.snapshot() for k, v in self._hists.items()}
 
     # -- spans -----------------------------------------------------------
     def _span_opened(self, sp: "Span") -> None:

@@ -219,6 +219,144 @@ def trace_summary(events: Iterable[dict]) -> list[dict]:
     return sorted(agg.values(), key=lambda r: -r["total_ms"])
 
 
+def _completed_spans(events: Iterable[dict]) -> list[dict]:
+    """Balanced ``B``/``E`` pairs → ``[{name, dur_ms, args}]``. A
+    sibling of :func:`trace_summary`'s pairing walk, kept separate
+    because that one needs the live stack for its self-nesting rule —
+    keep the unbalanced-span handling of the two in agreement."""
+    open_spans: dict[tuple[int, int], list[tuple[str, float, dict]]] = {}
+    out: list[dict] = []
+    for ev in events:
+        ph = ev.get("ph")
+        if ph not in ("B", "E"):
+            continue
+        key = (ev.get("pid", 0), ev.get("tid", 0))
+        stack = open_spans.setdefault(key, [])
+        if ph == "B":
+            stack.append((ev["name"], ev["ts"], ev.get("args", {})))
+            continue
+        if not stack or stack[-1][0] != ev["name"]:  # unbalanced: skip
+            continue
+        name, ts0, args = stack.pop()
+        out.append(
+            {"name": name, "dur_ms": (ev["ts"] - ts0) / 1e3, "args": args}
+        )
+    return out
+
+
+def serve_trace_rollup(events: Iterable[dict]) -> dict:
+    """Roll ``serve.*`` spans up per request id and per query type.
+
+    Two span families feed it (``tnc_tpu.serve.service``):
+
+    - ``serve.request`` — one terminal span per request whose args ARE
+      the request timeline (rid, type, outcome, queue_age_s,
+      batch_wait_s, dispatch_s, riders, generation);
+    - ``serve.dispatch`` — one span per batched execution, its wall
+      time shared by the ``riders`` id list it carries; the rollup
+      attributes ``dur / len(riders)`` to each rider, so shared batch
+      time lands on requests and query types without double counting.
+
+    Returns ``{"requests": {rid: {...}}, "by_type": {kind: {...}},
+    "dispatch_wall_ms", "attributed_ms", "attributed_share"}`` —
+    ``attributed_share`` is the CI pin: the fraction of total dispatch
+    wall time the rider lists account for (≥ 0.95 on a healthy trace).
+    """
+    requests: dict[str, dict] = {}
+    by_type: dict[str, dict] = {}
+    dispatch_wall = 0.0
+    attributed = 0.0
+    spans = _completed_spans(events)
+    # two passes: request rows first, THEN dispatch attribution — a
+    # request's serve.request span always closes after the dispatch
+    # span that served it, so a single in-order pass would attribute
+    # into rows that don't exist yet
+    for span in spans:
+        args = span["args"]
+        if span["name"] == "serve.request":
+            rid = str(args.get("rid", "?"))
+            requests[rid] = {
+                "type": args.get("type", "?"),
+                "outcome": args.get("outcome", "?"),
+                "latency_s": float(args.get("latency_s", 0.0) or 0.0),
+                "queue_age_s": float(args.get("queue_age_s", 0.0) or 0.0),
+                "batch_wait_s": float(args.get("batch_wait_s", 0.0) or 0.0),
+                "dispatch_s": float(args.get("dispatch_s", 0.0) or 0.0),
+                "riders": int(args.get("riders", 1) or 1),
+                "generation": int(args.get("generation", 0) or 0),
+                "attributed_ms": 0.0,
+            }
+    for span in spans:
+        args = span["args"]
+        if span["name"] == "serve.dispatch":
+            dispatch_wall += span["dur_ms"]
+            riders = [
+                r for r in str(args.get("riders", "")).split(",") if r
+            ]
+            if not riders:
+                continue
+            share = span["dur_ms"] / len(riders)
+            attributed += span["dur_ms"]
+            kind = str(args.get("kind", "?"))
+            row = by_type.setdefault(
+                kind,
+                {"dispatches": 0, "dispatch_ms": 0.0, "requests": 0},
+            )
+            row["dispatches"] += 1
+            row["dispatch_ms"] += span["dur_ms"]
+            for rid in riders:
+                req = requests.get(rid)
+                if req is not None:
+                    req["attributed_ms"] += share
+    for req in requests.values():
+        row = by_type.setdefault(
+            req["type"],
+            {"dispatches": 0, "dispatch_ms": 0.0, "requests": 0},
+        )
+        row["requests"] += 1
+        for fld in ("latency_s", "queue_age_s", "batch_wait_s", "dispatch_s"):
+            row[f"{fld}_sum"] = row.get(f"{fld}_sum", 0.0) + req[fld]
+    for row in by_type.values():
+        n = max(row["requests"], 1)
+        for fld in ("latency_s", "queue_age_s", "batch_wait_s", "dispatch_s"):
+            row[f"{fld}_mean"] = row.pop(f"{fld}_sum", 0.0) / n
+    return {
+        "requests": requests,
+        "by_type": by_type,
+        "dispatch_wall_ms": dispatch_wall,
+        "attributed_ms": attributed,
+        "attributed_share": (
+            attributed / dispatch_wall if dispatch_wall > 0 else 0.0
+        ),
+    }
+
+
+def format_serve_rollup(rollup: dict) -> str:
+    """Aligned text rendering of :func:`serve_trace_rollup` (the
+    ``trace_summarize.py --serve`` output): one row per query type,
+    then the attribution line."""
+    head = (
+        f"{'query type':<14} {'reqs':>6} {'dispatches':>11} "
+        f"{'q-age ms':>9} {'wait ms':>9} {'disp ms':>9} {'lat ms':>9}"
+    )
+    lines = [head, "-" * len(head)]
+    for kind in sorted(rollup["by_type"]):
+        row = rollup["by_type"][kind]
+        lines.append(
+            f"{kind:<14} {row['requests']:>6} {row['dispatches']:>11} "
+            f"{row.get('queue_age_s_mean', 0.0) * 1e3:>9.2f} "
+            f"{row.get('batch_wait_s_mean', 0.0) * 1e3:>9.2f} "
+            f"{row.get('dispatch_s_mean', 0.0) * 1e3:>9.2f} "
+            f"{row.get('latency_s_mean', 0.0) * 1e3:>9.2f}"
+        )
+    lines.append(
+        f"{len(rollup['requests'])} requests; dispatch wall "
+        f"{rollup['dispatch_wall_ms']:.2f} ms, "
+        f"{rollup['attributed_share']:.1%} attributed to request ids"
+    )
+    return "\n".join(lines)
+
+
 def format_summary_table(rows: list[dict]) -> str:
     """Render :func:`trace_summary` rows as an aligned text table with a
     time-share column (used by ``scripts/trace_summarize.py`` and the

@@ -30,6 +30,7 @@ from typing import Sequence
 
 import numpy as np
 
+from tnc_tpu import obs
 from tnc_tpu.builders.circuit_builder import Circuit, normalize_bitstring
 from tnc_tpu.queries.expectation import (
     ExpectationProgram,
@@ -60,6 +61,10 @@ class SampleQueryHandler:
     rides along."""
 
     kind = "sample"
+    # per-dispatch work scales with each request's n_samples, not the
+    # batch size — measured seconds per batch-size bucket are not
+    # comparable, so the SLO drift detector must not track this kind
+    drift_stable = False
 
     def __init__(self, sampler: ChainSampler) -> None:
         self.sampler = sampler
@@ -79,9 +84,13 @@ class SampleQueryHandler:
         return {"n_samples": n_samples, "seed": seed}, (self.kind,)
 
     def dispatch(self, payloads: Sequence[dict], backend) -> list:
-        return self.sampler.sample_groups(
-            [(p["n_samples"], p["seed"]) for p in payloads], backend
-        )
+        # the per-type timeline tag: the handler's whole batched
+        # execution nests under the service's `serve.dispatch` span, so
+        # a trace rollup attributes chain-step time to the query type
+        with obs.span("serve.handler", type=self.kind, batch=len(payloads)):
+            return self.sampler.sample_groups(
+                [(p["n_samples"], p["seed"]) for p in payloads], backend
+            )
 
 
 class ExpectationQueryHandler:
@@ -92,6 +101,10 @@ class ExpectationQueryHandler:
     rebind batch."""
 
     kind = "expectation"
+    # per-dispatch work scales with the UNIQUE Pauli strings across the
+    # batch (plus a compile per new unique-count bucket) — not
+    # drift-comparable per batch-size bucket
+    drift_stable = False
 
     def __init__(
         self,
@@ -125,7 +138,11 @@ class ExpectationQueryHandler:
         for terms in payloads:
             for _c, pauli in terms:
                 unique.setdefault(pauli, len(unique))
-        vals = self.program().values(list(unique), backend)
+        with obs.span(
+            "serve.handler", type=self.kind, batch=len(payloads),
+            unique_terms=len(unique),
+        ):
+            vals = self.program().values(list(unique), backend)
         return [
             complex(sum(c * vals[unique[p]] for c, p in terms))
             for terms in payloads
@@ -140,6 +157,9 @@ class MarginalQueryHandler:
     plans."""
 
     kind = "marginal"
+    # one structure per mask, work linear in batch rows: batch-size
+    # buckets see comparable seconds — drift tracking is meaningful
+    drift_stable = True
 
     def __init__(
         self,
@@ -173,8 +193,11 @@ class MarginalQueryHandler:
         return bound
 
     def dispatch(self, payloads: Sequence[str], backend) -> list:
-        bound = self.bound_for(wildcard_mask(payloads[0]))
-        probs = marginal_probabilities(bound, list(payloads), backend)
+        with obs.span(
+            "serve.handler", type=self.kind, batch=len(payloads),
+        ):
+            bound = self.bound_for(wildcard_mask(payloads[0]))
+            probs = marginal_probabilities(bound, list(payloads), backend)
         return [float(p) for p in np.asarray(probs)]
 
 

@@ -27,7 +27,9 @@ separated by ``;``, each
 - ``kind`` — ``oom`` (raises with a ``RESOURCE_EXHAUSTED`` message →
   classified RESOURCE), ``transient``/``preempt`` (``UNAVAILABLE:
   injected preemption`` → TRANSIENT), ``fatal`` (``INTERNAL`` →
-  FATAL), ``kill`` (SIGKILL the process — crash-resume smokes).
+  FATAL), ``kill`` (SIGKILL the process — crash-resume smokes), or
+  ``slow[:seconds]`` (sleep instead of raise — the SLO smoke's
+  injected slowdown; default 0.05 s, e.g. ``serve.dispatch=slow:0.2*-1``).
 - ``* count`` — how many times the rule fires (default 1; ``*-1`` =
   unlimited).
 
@@ -90,7 +92,10 @@ _KINDS = {
         "INTERNAL: injected fatal failure at {site}",
     ),
     "kill": (None, None),  # SIGKILL, no exception to raise
+    "slow": (None, None),  # sleep, no exception — latency injection
 }
+
+_SLOW_DEFAULT_S = 0.05
 
 
 @dataclass
@@ -99,6 +104,7 @@ class _Rule:
     conds: dict[str, str]
     kind: str
     remaining: int  # -1 = unlimited
+    arg: float = 0.0  # kind parameter (sleep seconds for ``slow``)
 
 
 _RULES: list[_Rule] = []
@@ -137,6 +143,15 @@ def parse_spec(spec: str) -> list[_Rule]:
             count = int(cnt.strip())
         else:
             kind = right
+        arg = 0.0
+        if kind.startswith("slow"):
+            base, _, dur = kind.partition(":")
+            if base != "slow":
+                raise ValueError(f"unknown fault kind {kind!r}")
+            arg = float(dur) if dur else _SLOW_DEFAULT_S
+            if arg < 0.0:
+                raise ValueError(f"slow duration must be >= 0: {kind!r}")
+            kind = "slow"
         if kind not in _KINDS:
             raise ValueError(
                 f"unknown fault kind {kind!r}; one of {sorted(_KINDS)}"
@@ -157,7 +172,7 @@ def parse_spec(spec: str) -> list[_Rule]:
                 conds[k.strip()] = v.strip()
         if not site.strip():
             raise ValueError(f"fault rule missing site: {raw!r}")
-        rules.append(_Rule(site.strip(), conds, kind, count))
+        rules.append(_Rule(site.strip(), conds, kind, count, arg))
     return rules
 
 
@@ -225,6 +240,11 @@ def _fire(site: str, ctx: dict) -> None:
     if rule.kind == "kill":
         os.kill(os.getpid(), signal.SIGKILL)
         return  # pragma: no cover — unreachable
+    if rule.kind == "slow":
+        import time
+
+        time.sleep(rule.arg)
+        return
     exc_type, msg = _KINDS[rule.kind]
     raise exc_type(msg.format(site=site))
 

@@ -309,7 +309,12 @@ class ClusterDispatcher:
 
 
 def serve_cluster(
-    bound: BoundProgram, backend=None, root: int = 0, plan_cache=None
+    bound: BoundProgram,
+    backend=None,
+    root: int = 0,
+    plan_cache=None,
+    telemetry_port: int | None = None,
+    telemetry_host: str = "127.0.0.1",
 ) -> int:
     """Worker-process serving loop: park on the root's command channel
     and answer each batch's shard until the root's
@@ -317,6 +322,15 @@ def serve_cluster(
     served. Every process must hold a ``bound`` for the SAME circuit
     structure (bind through one shared plan cache so only the first
     process pays the planner).
+
+    ``telemetry_port`` (0 = ephemeral) exposes THIS replica's live
+    telemetry (:class:`~tnc_tpu.obs.http.TelemetryServer`) while it
+    serves: ``/metrics`` renders the process-local obs registry (shard
+    spans, worker rebind/batch counters), ``/healthz`` reports the
+    worker's role/process index/batches served. The root process gets
+    its endpoint from :meth:`~tnc_tpu.serve.service.ContractionService.
+    serve_telemetry` instead — one scrape target per replica either
+    way. The endpoint stops (port released) when the loop exits.
 
     Every command carries the root's plan signature; a mismatch (the
     root's service adopted a background-replanner/shared-cache swap)
@@ -332,6 +346,33 @@ def serve_cluster(
         raise RuntimeError(
             "serve_cluster is the NON-root side of a multi-process fleet"
         )
+    progress = {"served": 0}
+    telemetry = None
+    if telemetry_port is not None:
+        from tnc_tpu.obs.http import TelemetryServer
+
+        telemetry = TelemetryServer(
+            host=telemetry_host,
+            port=telemetry_port,
+            health_fn=lambda: {
+                "status": "ok",
+                "role": "worker",
+                "process": me,
+                "batches_served": progress["served"],
+            },
+        ).start()
+    try:
+        return _serve_cluster_loop(
+            bound, backend, root, plan_cache, n, me, progress
+        )
+    finally:
+        if telemetry is not None:
+            telemetry.stop()
+
+
+def _serve_cluster_loop(
+    bound, backend, root, plan_cache, n, me, progress
+) -> int:
     served = 0
     my_sig = bound.program.signature_digest()
     while True:
@@ -379,4 +420,5 @@ def serve_cluster(
         else:  # unknown command: the fleet is version-skewed — stop loud
             raise RuntimeError(f"serve_cluster: unknown command {cmd!r}")
         served += 1
+        progress["served"] = served
         obs.counter_add("serve.cluster.worker_batches")

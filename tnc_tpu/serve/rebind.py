@@ -54,6 +54,20 @@ from tnc_tpu.ops.sliced import build_sliced_program
 
 logger = logging.getLogger(__name__)
 
+def pow2_bucket(n: int) -> int:
+    """Round a batch size up to the next power of two — THE bucketing
+    rule for batched serving shapes: XLA compiles one executable per
+    padded batch shape (below), and the SLO drift detector groups
+    dispatch measurements by the same rule
+    (:func:`tnc_tpu.serve.service.batch_bucket`) so its buckets stay in
+    one-to-one correspondence with compiled executables.
+
+    >>> [pow2_bucket(n) for n in (1, 2, 3, 8, 9)]
+    [1, 2, 4, 8, 16]
+    """
+    return 1 << max(int(n) - 1, 0).bit_length()
+
+
 def stacked_bras(batch_bits: Sequence[str]) -> np.ndarray:
     """One-hot bra values for a batch: ``(B, n_det, 2)``, qubit order.
     Values come from the builder's canonical
@@ -232,7 +246,7 @@ class BoundProgram:
                 # XLA compiles one executable per shape, and service
                 # traffic otherwise produces a fresh trace per distinct
                 # batch size
-                padded = 1 << (b - 1).bit_length()
+                padded = pow2_bucket(b)
                 if padded != b:
                     obs.counter_add("serve.rebind.batch_padded")
                     for slot in self.bra_slots:

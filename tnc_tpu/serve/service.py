@@ -51,6 +51,7 @@ Production posture:
 from __future__ import annotations
 
 import concurrent.futures
+import itertools
 import logging
 import threading
 import time
@@ -61,10 +62,17 @@ from typing import Iterable
 import numpy as np
 
 from tnc_tpu import obs
+from tnc_tpu.obs.core import QuantileSummary
 from tnc_tpu.resilience import retry as _retry
-from tnc_tpu.serve.rebind import BoundProgram, bind_circuit
+from tnc_tpu.resilience.faultinject import fault_point
+from tnc_tpu.serve.rebind import BoundProgram, bind_circuit, pow2_bucket
 
 logger = logging.getLogger(__name__)
+
+#: drift-bucket granularity == executable granularity: one shared
+#: power-of-two rule (rebind pads batched dispatches to it, so all
+#: measurements inside a bucket ran the same compiled shape)
+batch_bucket = pow2_bucket
 
 
 class ServeError(RuntimeError):
@@ -93,6 +101,12 @@ class _Request:
     # batching key: requests dispatch together ONLY when keys match
     # (per-type, plus structure discriminators like the marginal mask)
     key: tuple = ("amplitude",)
+    # per-request trace id, assigned at admission; every serve.* span
+    # that touches this request carries it, so the whole timeline
+    # (queue age -> batch wait -> dispatch share) is queryable per
+    # request (scripts/trace_summarize.py --serve)
+    rid: int = 0
+    t_collect: float = 0.0  # when batch assembly pulled it off the queue
 
 
 _STATS_CAP = 4096  # bounded in-memory samples for stats()/bench
@@ -121,6 +135,8 @@ class ContractionService:
         max_queue: int = 1024,
         retry_policy: _retry.RetryPolicy | None = None,
         dispatcher=None,
+        slo=None,
+        cost_model=None,
     ):
         """``dispatcher``: optional batch-execution hook
         ``fn(bound, bits, backend) -> (B,)+result_shape array``
@@ -130,7 +146,17 @@ class ContractionService:
         processes and gathers at the root). Everything else (queueing,
         deadlines, retry, degradation, plan swaps) is unchanged: the
         dispatcher is only ever called with a batch and the CURRENT
-        bound, so plan swaps stay batch-atomic across the fleet."""
+        bound, so plan swaps stay batch-atomic across the fleet.
+
+        ``slo``: an :class:`~tnc_tpu.obs.slo.SLOEngine` (or an
+        :class:`~tnc_tpu.obs.slo.SLOConfig` to build one) — every
+        terminal request outcome and every dispatch measurement feeds
+        it, burn/drift alerts surface in ``stats()["slo"]`` and the
+        telemetry endpoint. ``cost_model``: a
+        :class:`~tnc_tpu.obs.calibrate.CalibratedCostModel` giving the
+        drift detector its predicted dispatch seconds (without one,
+        drift tracks raw measured seconds per bucket — still a change
+        signal when the engine self-baselines)."""
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         self.bound = bound
@@ -140,6 +166,7 @@ class ContractionService:
         self.max_wait_s = float(max_wait_ms) / 1e3
         self.max_queue = int(max_queue)
         self.retry_policy = retry_policy or _retry.default_policy()
+        self.cost_model = cost_model
         self._queue: deque[_Request] = deque()
         self._cond = threading.Condition()
         self._running = False
@@ -151,12 +178,18 @@ class ContractionService:
             "batches": 0, "degraded_batches": 0, "plan_swaps": 0,
         }
         self._batch_sizes: deque[int] = deque(maxlen=_STATS_CAP)
-        self._latencies: deque[float] = deque(maxlen=_STATS_CAP)
-        # per-query-type breakdowns (kind -> counts / latency samples);
+        # bounded streaming percentiles (p50/p90/p99 without retained
+        # samples) — the SAME objects back stats() and /metrics, so the
+        # two surfaces can never disagree. Cumulative since start /
+        # reset_stats(): on a long-lived replica they answer "how has
+        # this service served", not "how is it serving right now" — the
+        # windowed view of the present is the SLO engine's burn rates
+        self._latencies = QuantileSummary()
+        # per-query-type breakdowns (kind -> counts / latency summary);
         # "amplitude" is pre-seeded so dashboards always see the
         # primary type even before traffic arrives
         self._by_type: dict[str, dict] = {}
-        self._latencies_by_type: dict[str, deque] = {}
+        self._latencies_by_type: dict[str, QuantileSummary] = {}
         self._ensure_type("amplitude")
         # registered query handlers (sampling / expectation / marginal)
         self._handlers: dict[str, object] = {}
@@ -165,6 +198,15 @@ class ContractionService:
         self._pending_bound: BoundProgram | None = None
         self._replanner = None  # attached BackgroundReplanner, if any
         self._watchers: list = []  # attached SharedCacheWatchers
+        self._rids = itertools.count(1)
+        # plan-swap generation: bumps on every adopted replan/shared
+        # swap; rides the dispatch spans and request timelines so a
+        # latency change is attributable to the plan that served it
+        self._generation = 0
+        self._telemetry = None  # attached TelemetryServer, if any
+        self._slo = None
+        self._slo_last_check = 0.0
+        self.attach_slo(slo)
 
     @classmethod
     def from_circuit(
@@ -180,9 +222,14 @@ class ContractionService:
         shared_cache_watch: bool = False,
         watch_options: dict | None = None,
         queries: bool = False,
+        telemetry_port: int | None = None,
         **kwargs,
     ) -> "ContractionService":
         """Build (plan/compile once, plan cache honored) and start.
+
+        ``telemetry_port`` (0 = ephemeral) additionally starts the live
+        scrape endpoint (:meth:`serve_telemetry`): ``/metrics`` +
+        ``/healthz`` + ``/slo``.
 
         ``queries=True`` additionally registers the sampling /
         expectation / marginal query handlers for the same circuit
@@ -232,6 +279,8 @@ class ContractionService:
                 )
                 svc._watchers.append(watcher)
                 watcher.start()
+            if telemetry_port is not None:
+                svc.serve_telemetry(port=telemetry_port)
         except Exception:
             # a bad option kwarg must not leak a running dispatcher
             # thread (or half the attachments) the caller can't reach
@@ -263,6 +312,9 @@ class ContractionService:
         watchers, self._watchers = list(self._watchers), []
         for watcher in watchers:
             watcher.stop()
+        telemetry, self._telemetry = self._telemetry, None
+        if telemetry is not None:
+            telemetry.stop()  # releases the port
         with self._cond:
             if not self._running:
                 return
@@ -317,10 +369,24 @@ class ContractionService:
             if pending is not None:
                 self.bound = pending
                 self._counts["plan_swaps"] += 1
+                self._generation += 1
         if pending is not None:
             obs.counter_add("serve.replan.adopted")
             logger.info("adopted replanned program for serving")
         return self.bound
+
+    def attach_slo(self, slo) -> "ContractionService":
+        """Attach (or replace, or None-detach) the SLO engine — an
+        :class:`~tnc_tpu.obs.slo.SLOEngine` or an
+        :class:`~tnc_tpu.obs.slo.SLOConfig` to build one. Benchmarks
+        attach AFTER their warmup, so compile-time requests never
+        count against the objectives or seed the drift baselines."""
+        if slo is not None and not hasattr(slo, "record_request"):
+            from tnc_tpu.obs.slo import SLOEngine
+
+            slo = SLOEngine(slo)
+        self._slo = slo
+        return self
 
     def queue_depth(self) -> int:
         """Instantaneous queue length (the replanner's idleness probe)."""
@@ -373,7 +439,8 @@ class ContractionService:
         timeout_s: float | None,
     ) -> concurrent.futures.Future:
         """Shared admission path for every query type: bounded queue,
-        deadline arming, global + per-type accounting."""
+        deadline arming, request-id assignment, global + per-type
+        accounting."""
         fut: concurrent.futures.Future = concurrent.futures.Future()
         deadline = (
             time.monotonic() + float(timeout_s) if timeout_s is not None else None
@@ -383,16 +450,21 @@ class ContractionService:
                 self._count("rejected")
                 self._count_type(kind, "rejected")
                 obs.counter_add("serve.requests.rejected", reason="closed")
+                self._slo_request(kind, 0.0, "rejected")
                 raise ServiceClosedError("service is not running")
             if len(self._queue) >= self.max_queue:
                 self._count("rejected")
                 self._count_type(kind, "rejected")
                 obs.counter_add("serve.requests.rejected", reason="queue_full")
+                self._slo_request(kind, 0.0, "rejected")
                 raise QueueFullError(
                     f"queue at max_queue={self.max_queue}; retry later"
                 )
             self._queue.append(
-                _Request(payload, fut, deadline, kind=kind, key=key)
+                _Request(
+                    payload, fut, deadline, kind=kind, key=key,
+                    rid=next(self._rids),
+                )
             )
             depth = len(self._queue)
             self._cond.notify()
@@ -535,7 +607,18 @@ class ContractionService:
                 # keep serving
                 logger.exception("dispatcher batch processing failed")
                 for req in batch:
-                    self._complete(req, exc=ServeError(f"dispatcher error: {exc}"))
+                    if not self._complete(
+                        req, exc=ServeError(f"dispatcher error: {exc}")
+                    ):
+                        continue  # cancelled: _complete counted it
+                    self._count("failed")
+                    self._count_type(req.kind, "failed")
+                    obs.counter_add("serve.requests.failed")
+                    obs.counter_add("serve.query.failed", type=req.kind)
+                    self._slo_request(
+                        req.kind, time.monotonic() - req.t_submit, "failed"
+                    )
+                    self._trace_request(req, "failed")
 
     def _complete(self, req: _Request, result=None, exc=None) -> bool:
         """Resolve a request's future, tolerating caller-side
@@ -550,7 +633,13 @@ class ContractionService:
             return True
         except concurrent.futures.InvalidStateError:
             self._count("cancelled")
+            self._count_type(req.kind, "cancelled")
             obs.counter_add("serve.requests.cancelled")
+            obs.counter_add("serve.query.cancelled", type=req.kind)
+            self._slo_request(
+                req.kind, time.monotonic() - req.t_submit, "cancelled"
+            )
+            self._trace_request(req, "cancelled")
             return False
 
     def _dispatch_amps(self, bound: BoundProgram, bits: list) -> np.ndarray:
@@ -572,6 +661,11 @@ class ContractionService:
     ) -> list:
         """One batched execution of a same-key group; returns one
         result object per payload."""
+        # injectable boundary (TNC_TPU_FAULTS): the SLO smoke scripts a
+        # `slow` rule here to trip burn/drift alerts deterministically,
+        # and raising kinds exercise the retry->degrade ladder exactly
+        # where production dispatch failures surface
+        fault_point("serve.dispatch", kind=kind, batch=len(payloads))
         if kind == "amplitude":
             amps = self._dispatch_amps(bound, payloads)
             return [
@@ -583,20 +677,30 @@ class ContractionService:
         now = time.monotonic()
         live: list[_Request] = []
         for req in batch:
+            # every request was just pulled off the queue at `now` —
+            # set it on the expired branch too, or an expired request's
+            # timeline would report its whole queue wait as batch_wait
+            req.t_collect = now
             if req.deadline is not None and now > req.deadline:
-                self._count("expired")
-                self._count_type(req.kind, "expired")
-                obs.counter_add("serve.requests.expired")
-                self._complete(
+                # complete FIRST: a caller-cancelled future takes the
+                # cancelled outcome inside _complete, and exactly one
+                # terminal outcome may count per request
+                if self._complete(
                     req,
                     exc=DeadlineExceededError(
                         f"deadline exceeded after "
                         f"{now - req.t_submit:.3f}s in queue"
                     ),
-                )
+                ):
+                    self._count("expired")
+                    self._count_type(req.kind, "expired")
+                    obs.counter_add("serve.requests.expired")
+                    self._slo_request(req.kind, now - req.t_submit, "expired")
+                    self._trace_request(req, "expired")
             else:
                 live.append(req)
         if not live:
+            self._slo_check()
             return
         for req in live:
             obs.observe("serve.wait_s", now - req.t_submit)
@@ -612,6 +716,7 @@ class ContractionService:
             groups.setdefault(req.key, []).append(req)
         for group in groups.values():
             self._run_group(group, bound)
+        self._slo_check()
 
     def _run_group(
         self, group: list[_Request], bound: BoundProgram
@@ -621,11 +726,21 @@ class ContractionService:
         self._count_type(kind, "batches")
         with self._lock:
             self._batch_sizes.append(len(group))
+            generation = self._generation
         obs.observe("serve.batch_size", len(group))
         obs.observe("serve.query.batch_size", len(group), type=kind)
         payloads = [req.bits for req in group]
+        riders = ",".join(f"r{req.rid}" for req in group)
+        t0 = time.monotonic()
         try:
-            with obs.span("serve.dispatch", batch=len(group), kind=kind):
+            # the batch-level span carries the rider id list so the
+            # trace rollup can attribute shared batch time back to
+            # request ids and query types
+            with obs.span(
+                "serve.dispatch",
+                batch=len(group), kind=kind, riders=riders,
+                generation=generation,
+            ):
                 results = self.retry_policy.run(
                     lambda: self._dispatch_group(kind, payloads, bound),
                     label="serve.dispatch",
@@ -640,9 +755,14 @@ class ContractionService:
             self._run_singletons(group, bound)
             return
         done = time.monotonic()
+        dispatch_s = done - t0
+        self._slo_dispatch(kind, len(group), dispatch_s, bound)
         for req, result in zip(group, results):
             if self._complete(req, result=result):
-                self._finish(req, done)
+                self._finish(
+                    req, done, dispatch_s=dispatch_s,
+                    riders=len(group), generation=generation,
+                )
 
     def _run_singletons(self, batch: list[_Request], bound=None) -> None:
         """Degraded mode: each rider re-dispatched alone — one bad
@@ -652,36 +772,183 @@ class ContractionService:
         batch)."""
         if bound is None:
             bound = self.bound
+        with self._lock:
+            generation = self._generation
         for req in batch:
+            t0 = time.monotonic()
             try:
-                results = self._dispatch_group(req.kind, [req.bits], bound)
+                with obs.span(
+                    "serve.dispatch",
+                    batch=1, kind=req.kind, riders=f"r{req.rid}",
+                    generation=generation, degraded=1,
+                ):
+                    results = self._dispatch_group(req.kind, [req.bits], bound)
             except Exception as exc:  # noqa: BLE001 — per-request verdict
-                self._count("failed")
-                self._count_type(req.kind, "failed")
-                obs.counter_add("serve.requests.failed")
-                obs.counter_add("serve.query.failed", type=req.kind)
-                self._complete(req, exc=exc)
+                if self._complete(req, exc=exc):
+                    self._count("failed")
+                    self._count_type(req.kind, "failed")
+                    obs.counter_add("serve.requests.failed")
+                    obs.counter_add("serve.query.failed", type=req.kind)
+                    self._slo_request(
+                        req.kind, time.monotonic() - req.t_submit, "failed"
+                    )
+                    self._trace_request(req, "failed", degraded=True)
                 continue
+            done = time.monotonic()
+            self._slo_dispatch(req.kind, 1, done - t0, bound)
             if self._complete(req, result=results[0]):
-                self._finish(req, time.monotonic())
+                self._finish(
+                    req, done, dispatch_s=done - t0, riders=1,
+                    generation=generation, degraded=True,
+                )
 
-    def _finish(self, req: _Request, done: float) -> None:
+    def _finish(
+        self,
+        req: _Request,
+        done: float,
+        dispatch_s: float = 0.0,
+        riders: int = 1,
+        generation: int = 0,
+        degraded: bool = False,
+    ) -> None:
         self._count("completed")
         self._count_type(req.kind, "completed")
         obs.counter_add("serve.requests.completed")
         obs.counter_add("serve.query.completed", type=req.kind)
         latency = done - req.t_submit
         with self._lock:
-            self._latencies.append(latency)
-            self._latencies_by_type[req.kind].append(latency)
+            self._latencies.observe(latency)
+            self._latencies_by_type[req.kind].observe(latency)
         obs.observe("serve.latency_s", latency)
         obs.observe("serve.query.latency_s", latency, type=req.kind)
+        timeline = None
+        if self._slo is not None or obs.enabled():
+            timeline = self._timeline(
+                req, "completed", latency, dispatch_s, riders, generation,
+                degraded,
+            )
+        if self._slo is not None:
+            self._slo_request(
+                req.kind, latency, "completed", timeline=timeline
+            )
+        self._trace_request(req, "completed", timeline=timeline)
+
+    # -- per-request timeline + SLO plumbing -------------------------------
+
+    def _timeline(
+        self, req: _Request, outcome: str, latency: float,
+        dispatch_s: float = 0.0, riders: int = 1, generation: int = 0,
+        degraded: bool = False,
+    ) -> dict:
+        """Plain-data per-request trace record: where this request's
+        latency went (queue age -> batch wait -> its share of a
+        ``riders``-wide dispatch) plus the serving context (plan-cache
+        provenance, replan-swap generation)."""
+        t_collect = req.t_collect or req.t_submit
+        return {
+            "rid": f"r{req.rid}",
+            "type": req.kind,
+            "outcome": outcome,
+            "latency_s": round(latency, 6),
+            "queue_age_s": round(max(t_collect - req.t_submit, 0.0), 6),
+            "batch_wait_s": round(
+                max(latency - (t_collect - req.t_submit) - dispatch_s, 0.0), 6
+            ),
+            "dispatch_s": round(dispatch_s, 6),
+            "riders": riders,
+            "generation": generation,
+            "degraded": degraded,
+            "plan_cached": bool(self.bound.plan),
+        }
+
+    def _trace_request(
+        self, req: _Request, outcome: str, timeline: dict | None = None,
+        **extra,
+    ) -> None:
+        """Emit the request's terminal ``serve.request`` span (duration
+        ~0; the timeline lives in the args) so an exported trace can be
+        rolled up per request id and query type
+        (``scripts/trace_summarize.py --serve``). A caller that already
+        built the timeline (``_finish``) passes it in."""
+        if not obs.enabled():
+            return
+        if timeline is None:
+            latency = extra.pop("latency", time.monotonic() - req.t_submit)
+            timeline = self._timeline(
+                req, outcome, latency,
+                extra.pop("dispatch_s", 0.0), extra.pop("riders", 1),
+                extra.pop("generation", 0), extra.pop("degraded", False),
+            )
+        with obs.span("serve.request", **timeline):
+            pass
+
+    def _slo_request(
+        self, kind: str, latency: float, outcome: str, timeline=None
+    ) -> None:
+        if self._slo is not None:
+            self._slo.record_request(
+                kind, latency, outcome, timeline=timeline
+            )
+
+    def _slo_dispatch(
+        self, kind: str, batch: int, measured_s: float, bound: BoundProgram
+    ) -> None:
+        """Feed the drift detector one dispatch observation, bucketed by
+        query type x power-of-two batch size (the executor-bucket
+        granularity at which measured seconds are comparable). Kinds
+        whose handler declares ``drift_stable = False`` (work varies
+        with payload, not batch size — sampling's n_samples,
+        expectation's unique-term count) are excluded: their measured
+        seconds per bucket are not comparable, and feeding them would
+        manufacture drift out of workload mix."""
+        if self._slo is None:
+            return
+        handler = self._handlers.get(kind)
+        if handler is not None and not getattr(handler, "drift_stable", True):
+            return
+        bucket = f"{kind}/b{batch_bucket(batch)}"
+        self._slo.record_dispatch(
+            bucket, self._predict_dispatch_s(kind, bound), measured_s
+        )
+
+    def _predict_dispatch_s(self, kind: str, bound: BoundProgram):
+        """Calibrated prediction for one dispatch of ``kind`` under
+        ``bound`` (None without a cost model, or for handler query
+        types whose flops the service cannot see)."""
+        if self.cost_model is None or kind != "amplitude":
+            return None
+        try:
+            from tnc_tpu.ops.program import steps_flops
+
+            steps = bound.program.steps
+            return self.cost_model.op_seconds(
+                steps_flops(steps), dispatches=max(len(steps), 1)
+            )
+        except Exception:  # noqa: BLE001 — prediction is best-effort
+            return None
+
+    #: minimum seconds between dispatcher-thread SLO evaluations — the
+    #: burn windows are seconds-to-hours, so sub-batch freshness buys
+    #: nothing and the evaluation must stay off the per-batch hot path
+    _SLO_CHECK_INTERVAL_S = 0.2
+
+    def _slo_check(self) -> None:
+        if self._slo is None:
+            return
+        now = time.monotonic()
+        if now - self._slo_last_check < self._SLO_CHECK_INTERVAL_S:
+            return
+        self._slo_last_check = now
+        self._slo.check()
 
     # -- stats -------------------------------------------------------------
 
+    # every terminal outcome increments its per-type row — deadline
+    # expiry, queue rejection and caller-side cancellation included
+    # (audited per outcome by tests/test_serve.py)
     _TYPE_KEYS = (
         "submitted", "completed", "failed", "expired", "rejected",
-        "batches",
+        "cancelled", "batches",
     )
 
     def _ensure_type(self, kind: str) -> dict:
@@ -691,7 +958,7 @@ class ContractionService:
         if row is None:
             row = {k: 0 for k in self._TYPE_KEYS}
             self._by_type[kind] = row
-            self._latencies_by_type[kind] = deque(maxlen=_STATS_CAP)
+            self._latencies_by_type[kind] = QuantileSummary()
         return row
 
     def _count(self, key: str) -> None:
@@ -710,44 +977,49 @@ class ContractionService:
             for key in self._counts:
                 self._counts[key] = 0
             self._batch_sizes.clear()
-            self._latencies.clear()
+            self._latencies = QuantileSummary()
             for kind, row in self._by_type.items():
                 for key in row:
                     row[key] = 0
-                self._latencies_by_type[kind].clear()
+                self._latencies_by_type[kind] = QuantileSummary()
 
     @staticmethod
-    def _pct(sorted_vals: list, q: float) -> float:
-        if not sorted_vals:
-            return 0.0
-        idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1)))
-        return float(sorted_vals[idx])
+    def _latency_block(summary: QuantileSummary) -> dict:
+        """Percentile block from a streaming summary — the ONE source
+        both ``stats()`` and the ``/metrics`` rendering read, so the
+        two surfaces report identical numbers."""
+        return {
+            "count": summary.count,
+            "p50": round(summary.quantile(0.5), 6),
+            "p90": round(summary.quantile(0.9), 6),
+            "p99": round(summary.quantile(0.99), 6),
+            "max": round(summary.max, 6),
+        }
 
     def stats(self) -> dict:
         """Snapshot for dashboards and ``bench.py --serve``: request
-        counts, batch-size distribution, latency percentiles, and the
+        counts, batch-size distribution, latency percentiles, the
         per-query-type breakdown (``by_type``: one row per kind with
-        request/batch counts and latency percentiles)."""
+        request/batch counts and latency percentiles), and — with an
+        SLO engine attached — the ``slo`` block (burn rates, drift,
+        firing alerts)."""
+        # percentile blocks are computed UNDER the lock: the summaries
+        # are live objects the dispatcher observes into, and a block
+        # must be internally consistent (count vs quantiles)
         with self._lock:
             counts = dict(self._counts)
             sizes = list(self._batch_sizes)
-            lats = sorted(self._latencies)
+            latency = self._latency_block(self._latencies)
             by_type = {
-                kind: (
-                    dict(row),
-                    sorted(self._latencies_by_type[kind]),
-                )
+                kind: {
+                    "counts": dict(row),
+                    "latency_s": self._latency_block(
+                        self._latencies_by_type[kind]
+                    ),
+                }
                 for kind, row in self._by_type.items()
             }
-
-        def latency_block(sorted_lats: list) -> dict:
-            return {
-                "p50": round(self._pct(sorted_lats, 0.50), 6),
-                "p99": round(self._pct(sorted_lats, 0.99), 6),
-                "max": round(sorted_lats[-1], 6) if sorted_lats else 0.0,
-            }
-
-        return {
+        out = {
             "counts": counts,
             "batch_size": {
                 "count": len(sizes),
@@ -755,9 +1027,118 @@ class ContractionService:
                 "max": int(max(sizes)) if sizes else 0,
                 "mean": float(np.mean(sizes)) if sizes else 0.0,
             },
-            "latency_s": latency_block(lats),
-            "by_type": {
-                kind: {"counts": row, "latency_s": latency_block(tl)}
-                for kind, (row, tl) in by_type.items()
-            },
+            "latency_s": latency,
+            "by_type": by_type,
         }
+        if self._slo is not None:
+            out["slo"] = self._slo.stats()
+        return out
+
+    # -- live telemetry endpoint -------------------------------------------
+
+    def serve_telemetry(
+        self, host: str = "127.0.0.1", port: int = 0
+    ):
+        """Start (and own) the live scrape endpoint for this service:
+        ``/metrics`` (Prometheus text: the obs registry + the service's
+        own families, percentile-identical to ``stats()``), ``/healthz``
+        and ``/slo``. Returns the started
+        :class:`~tnc_tpu.obs.http.TelemetryServer` (``.port`` carries
+        the bound port when ``port=0``); :meth:`stop` shuts it down and
+        releases the port."""
+        from tnc_tpu.obs.http import TelemetryServer
+
+        if self._telemetry is not None:
+            return self._telemetry
+
+        def health() -> dict:
+            running = self._running
+            return {
+                "status": "ok" if running else "stopped",
+                "running": running,
+                "queue_depth": self.queue_depth() if running else 0,
+            }
+
+        def slo() -> dict:
+            if self._slo is None:
+                return {"enabled": False}
+            body = self._slo.stats()
+            body["enabled"] = True
+            body["recent_requests"] = self._slo.timelines()[-32:]
+            return body
+
+        self._telemetry = TelemetryServer(
+            registry=obs.get_registry(),
+            host=host,
+            port=port,
+            health_fn=health,
+            slo_fn=slo,
+            extra_metrics_fn=self._prometheus_families,
+        ).start()
+        return self._telemetry
+
+    def _prometheus_families(self) -> list:
+        """The service's own metric families for ``/metrics`` —
+        computed from the same counters and quantile summaries
+        ``stats()`` reads, independent of whether obs tracing is on.
+        Summaries are snapshotted under the lock (consistent with the
+        dispatcher's concurrent observes)."""
+        with self._lock:
+            counts = dict(self._counts)
+            overall = (
+                self._latency_block(self._latencies),
+                self._latencies.sum,
+            )
+            by_type = {
+                kind: (
+                    dict(row),
+                    self._latency_block(self._latencies_by_type[kind]),
+                    self._latencies_by_type[kind].sum,
+                )
+                for kind, row in self._by_type.items()
+            }
+        fams: list = [("gauge", "serve.queue_depth", {}, self.queue_depth())]
+        # request-outcome counters get their own family so
+        # sum(serve_requests_total) is a true request count; batch and
+        # plan-swap counters are separate families, not fake "outcomes"
+        outcome_keys = (
+            "submitted", "completed", "failed", "expired", "rejected",
+            "cancelled",
+        )
+        for key in outcome_keys:
+            fams.append(
+                ("counter", "serve.requests", {"outcome": key}, counts[key])
+            )
+        fams.append(("counter", "serve.batches", {}, counts["batches"]))
+        fams.append(
+            ("counter", "serve.batches_degraded", {},
+             counts["degraded_batches"])
+        )
+        fams.append(("counter", "serve.plan_swaps", {}, counts["plan_swaps"]))
+
+        def summary(name: str, labels: dict, block: dict, total: float):
+            for q, qlabel in (("p50", "0.5"), ("p90", "0.9"), ("p99", "0.99")):
+                fams.append(
+                    ("summary", name, {**labels, "quantile": qlabel}, block[q])
+                )
+            fams.append(("summary", f"{name}_count", labels, block["count"]))
+            fams.append(("summary", f"{name}_sum", labels, total))
+            fams.append(("gauge", f"{name}_max", labels, block["max"]))
+
+        summary("serve.latency_seconds", {}, *overall)
+        for kind, (row, block, total) in by_type.items():
+            for key, value in row.items():
+                if key == "batches":
+                    fams.append(
+                        ("counter", "serve.type_batches", {"type": kind},
+                         value)
+                    )
+                else:
+                    fams.append(
+                        (
+                            "counter", "serve.type_requests",
+                            {"type": kind, "outcome": key}, value,
+                        )
+                    )
+            summary("serve.type_latency_seconds", {"type": kind}, block, total)
+        return fams
