@@ -1808,9 +1808,14 @@ CONFIGS = {
 
 def _parse_serve_mix(spec: str) -> dict:
     """``BENCH_SERVE_MIX`` parser: ``"amplitude:6,sample:1,
-    expectation:1"`` → weight per query type (types absent from the
-    spec get weight 0; unknown names are an error)."""
-    known = ("amplitude", "sample", "expectation", "marginal")
+    expectation:1,approx_amplitude:2"`` → weight per query type (types
+    absent from the spec get weight 0; unknown names are an error).
+    ``approx_amplitude`` requests ride the fidelity-tiered approximate
+    tier (``submit(..., rtol=BENCH_SERVE_RTOL)``)."""
+    known = (
+        "amplitude", "sample", "expectation", "marginal",
+        "approx_amplitude",
+    )
     weights = {}
     for part in spec.split(","):
         part = part.strip()
@@ -1845,7 +1850,13 @@ def _serve_bench() -> dict:
     (``by_type``: requests, qps, p50/p99 ms — the per-type serving
     surface scripts/perf_gate.py cross-checks), and the ``slo`` block
     (burn rates, the drift detector's worst measured-vs-baseline
-    dispatch ratio, fired alerts — gate-checked at 1.5x drift)."""
+    dispatch ratio, fired alerts — gate-checked at 1.5x drift), plus
+    the per-fidelity-tier block (``by_tier``: exact vs approx
+    requests, qps, p50/p99, escalations, measured mean dispatch
+    seconds next to the cost model's predicted seconds — the
+    cheaper-tier evidence ``scripts/perf_gate.py`` cross-checks).
+    Fidelity knobs: BENCH_SERVE_RTOL (0.05) is the approx requests'
+    tolerance, BENCH_SERVE_CHI_CAP (64) the ladder's top rung."""
     import concurrent.futures
 
     from tnc_tpu import obs
@@ -1880,8 +1891,10 @@ def _serve_bench() -> dict:
     # serving traffic reuses it), half the qubits marginalized
     marginal_mask = ["?"] * (n - n // 2) + ["*"] * (n // 2)
 
+    rtol = float(os.environ.get("BENCH_SERVE_RTOL", "0.05"))
+
     def make_query(kind: str):
-        if kind == "amplitude":
+        if kind in ("amplitude", "approx_amplitude"):
             return kind, rand_bits()
         if kind == "sample":
             return kind, {
@@ -1899,12 +1912,17 @@ def _serve_bench() -> dict:
     # queue the way mixed fleet traffic would
     cycle = [k for k, w in mix.items() for _ in range(w)]
     queries = [make_query(cycle[i % len(cycle)]) for i in range(n_queries)]
-    use_queries = any(k != "amplitude" for k, _ in queries)
+    use_queries = any(
+        k not in ("amplitude", "approx_amplitude") for k, _ in queries
+    )
+    use_approx = any(k == "approx_amplitude" for k, _ in queries)
 
     def submit(query):
         kind, payload = query
         if kind == "amplitude":
             return svc.submit(payload)
+        if kind == "approx_amplitude":
+            return svc.submit(payload, rtol=rtol)
         return svc.submit_query(kind, payload)
 
     # SLO engine riding the measured run: a deliberately loose latency
@@ -1928,11 +1946,28 @@ def _serve_bench() -> dict:
         drift_baseline_samples=4,
         drift_min_samples=8,
     )
+    # the reference model pricing the approx tier's rung ladder (and
+    # the exact plan) in the record: pinned constants, planner_quality
+    # style, so the predicted-seconds column is reproducible without a
+    # hardware calibration pass
+    from tnc_tpu.obs.calibrate import CalibratedCostModel
+
+    ref_model = CalibratedCostModel(
+        flops_per_s=float(os.environ.get("BENCH_SERVE_REF_FLOPS", "2e9")),
+        dispatch_s=float(os.environ.get("BENCH_SERVE_REF_DISPATCH", "2e-6")),
+        bytes_per_s=float(os.environ.get("BENCH_SERVE_REF_BYTES", "8e9")),
+    )
+    approx_options = {
+        "chi_cap": _env_int("BENCH_SERVE_CHI_CAP", 64),
+        "cost_model": ref_model,
+    }
     with obs.span("bench.serve", queries=n_queries):
         with ContractionService.from_circuit(
             circuit,
             backend=backend,
             queries=use_queries,
+            approx=use_approx,
+            approx_options=approx_options if use_approx else None,
             max_batch=max_batch,
             max_wait_ms=wait_ms,
             max_queue=max(n_queries, 1024),
@@ -1974,6 +2009,44 @@ def _serve_bench() -> dict:
             "p50_ms": round(row["latency_s"]["p50"] * 1e3, 3),
             "p99_ms": round(row["latency_s"]["p99"] * 1e3, 3),
         }
+    # per-fidelity-tier rows: measured qps/latency/dispatch seconds
+    # next to the reference model's predicted seconds per dispatch —
+    # the "approx tier is measurably cheaper" evidence, cross-checked
+    # by scripts/perf_gate.py like the per-type rows
+    by_tier = {}
+    router = svc.fidelity_router
+    for tier, row in stats.get("by_tier", {}).items():
+        completed = row["counts"]["completed"]
+        if completed == 0:
+            continue
+        predicted_s = None
+        if tier == "approx" and router is not None:
+            predicted_s = router.quote_seconds("amplitude")
+        elif tier == "exact":
+            from tnc_tpu.ops.program import steps_flops, steps_bytes
+
+            steps = svc.bound.program.steps
+            predicted_s = ref_model.op_seconds(
+                steps_flops(steps), steps_bytes(steps),
+                dispatches=max(len(steps), 1),
+            )
+        by_tier[tier] = {
+            "requests": completed,
+            "qps": round(completed / wall, 1) if wall > 0 else 0.0,
+            "p50_ms": round(row["latency_s"]["p50"] * 1e3, 3),
+            "p99_ms": round(row["latency_s"]["p99"] * 1e3, 3),
+            "escalated": row["counts"].get("escalated", 0),
+            "escalation_capped": row["counts"].get("escalation_capped", 0),
+            "dispatch_mean_s": row["dispatch"]["mean_s"],
+            "predicted_s": (
+                round(predicted_s, 6) if predicted_s is not None else None
+            ),
+        }
+    ref_constants = {
+        "flops_per_s": ref_model.flops_per_s,
+        "dispatch_s": ref_model.dispatch_s,
+        "bytes_per_s": ref_model.bytes_per_s,
+    }
     slo_stats = stats.get("slo") or {}
     drift_ratios = [
         row["ratio"] for row in (slo_stats.get("drift") or {}).values()
@@ -2011,6 +2084,8 @@ def _serve_bench() -> dict:
         "latency_s": stats["latency_s"],
         "counts": stats["counts"],
         "by_type": by_type,
+        "by_tier": by_tier,
+        "reference_model": ref_constants,
         "slo": slo_block,
     }
     log(
@@ -2023,6 +2098,14 @@ def _serve_bench() -> dict:
         log(
             f"[bench]   {kind}: {row['requests']} reqs, {row['qps']} q/s, "
             f"p50 {row['p50_ms']:.2f} ms, p99 {row['p99_ms']:.2f} ms"
+        )
+    for tier, row in sorted(by_tier.items()):
+        log(
+            f"[bench]   tier {tier}: {row['requests']} reqs, "
+            f"{row['qps']} q/s, p50 {row['p50_ms']:.2f} ms, "
+            f"escalated {row['escalated']}, dispatch "
+            f"{row['dispatch_mean_s'] * 1e3:.3f} ms measured / "
+            f"{row['predicted_s']} s predicted"
         )
     log(
         f"[bench]   slo: drift_max_ratio {slo_block['drift_max_ratio']}, "

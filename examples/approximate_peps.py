@@ -2,7 +2,10 @@
 
 The reference lists approximate contraction as future work; here a
 ``chi`` sweep shows the accuracy-for-cost dial against the exact
-contraction of a 4×4 PEPS ⟨ψ|O|ψ⟩ sandwich.
+contraction of a 4×4 PEPS ⟨ψ|O|ψ⟩ sandwich, then the serving tier's
+chi-ladder answers the same question with a per-answer error estimate
+(docs/approximate.md) and a tolerant amplitude request is served with
+an error bar through the service front end.
 
 Run:  python examples/approximate_peps.py
 """
@@ -49,3 +52,29 @@ for chi in (1, 2, 4, 8, 64):
 
 assert abs(boundary_mps_contract(grid, chi=64) - exact) <= 1e-8 * abs(exact)
 print("chi=64 reproduces the exact value; smaller chi trades accuracy for cost")
+
+# -- the serving tier: chi-ladder with a per-answer error estimate --------
+from tnc_tpu.approx import ApproxProgram, ChiLadder  # noqa: E402
+
+program = ApproxProgram.from_peps_sandwich(tn, LENGTH, DEPTH, LAYERS)
+res = ChiLadder(chi_cap=64).run(program, rtol=1e-6, scale=abs(exact))
+true_err = abs(res.value - exact)
+print(
+    f"chi-ladder: value {res.value:.6e} ± {res.err:.2e} at chi={res.chi_used} "
+    f"after {res.sweeps} sweeps (true err {true_err:.2e})"
+)
+assert res.converged and res.err >= true_err
+
+# -- fidelity-routed serving: rtol= lands on the approx tier --------------
+from tnc_tpu.builders.random_circuit import brickwork_circuit  # noqa: E402
+from tnc_tpu.serve import ContractionService  # noqa: E402
+
+circuit = brickwork_circuit(8, 5, np.random.default_rng(0))
+with ContractionService.from_circuit(circuit, approx=True) as svc:
+    ans = svc.amplitude("10100110", rtol=1e-2)
+    tiers = svc.stats()["by_tier"]
+print(
+    f"service rtol=1e-2: |amp| {abs(ans.value):.6f} ± {ans.err:.1e} "
+    f"(chi={ans.chi_used}, escalated={ans.escalated}; "
+    f"approx tier served {tiers['approx']['counts']['completed']} request)"
+)

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Fidelity-tier smoke: tolerant traffic serves from the approx tier
+with error bars that hold against the dense oracle, a tolerance the
+chi-ladder cannot meet escalates to the exact pipeline, and the
+approximate tier prices measurably cheaper than the exact plan under
+the calibrated reference model. Wired into check.sh.
+
+Pins:
+
+1. a batch of tolerant amplitude + expectation + marginal requests on
+   a brickwork workload all serve from the approx tier (by_tier rows:
+   every tolerant request completed there, zero escalations), every
+   returned error estimate bounds the true error vs the dense
+   statevector oracle, and exact co-traffic stays bit-exact;
+2. mixed exact/approx traffic NEVER cross-batches: every
+   ``serve.dispatch`` span carries a single kind;
+3. a chi-capped ladder asked for an impossible tolerance escalates:
+   the answer is flagged ``escalated`` and matches the oracle to
+   exact-pipeline precision, and the escalation is counted;
+4. pricing: on a deeper brickwork circuit the approx ladder's
+   predicted seconds undercut the exact plan's predicted seconds under
+   the SAME pinned reference cost model (the admission-control quote
+   that routes bulk traffic to the cheap tier).
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+os.environ.setdefault("TNC_TPU_PLATFORM", "cpu")
+
+import numpy as np  # noqa: E402
+
+from tnc_tpu import obs  # noqa: E402
+
+
+def main() -> int:
+    obs.configure(enabled=True)
+    from tnc_tpu.builders.random_circuit import brickwork_circuit
+    from tnc_tpu.obs.calibrate import CalibratedCostModel
+    from tnc_tpu.queries import statevector as sv
+    from tnc_tpu.serve import ApproxAnswer, ContractionService
+
+    rng = np.random.default_rng(42)
+    n, depth = 8, 5
+    circuit = brickwork_circuit(n, depth, rng)
+    oracle = sv.statevector(circuit.copy())
+
+    def rand_bits() -> str:
+        return "".join(rng.choice(["0", "1"], n))
+
+    # -- 1+2: tolerant batch on the approx tier, no cross-batching ------
+    with ContractionService.from_circuit(
+        circuit, queries=True, approx=True, max_wait_ms=20.0
+    ) as svc:
+        bits = [rand_bits() for _ in range(8)]
+        patterns = ["10**01**", "0*1*0*1*"]
+        paulis = ["zzzzzzzz", "ixzyixzy"]
+        futs = [(b, svc.submit(b, rtol=0.05)) for b in bits]
+        efuts = [(p, svc.submit_expectation(p, rtol=0.05)) for p in paulis]
+        mfuts = [(p, svc.submit_marginal(p, rtol=0.05)) for p in patterns]
+        exact_futs = [(b, svc.submit(b)) for b in bits]
+
+        for b, fut in futs:
+            ans = fut.result(timeout=600)
+            assert isinstance(ans, ApproxAnswer), type(ans)
+            true = abs(ans.value - sv.amplitude(oracle, b))
+            assert ans.err >= true, (b, ans.err, true)
+            assert ans.tolerance_met and not ans.escalated, ans
+        for p, fut in efuts:
+            ans = fut.result(timeout=600)
+            true = abs(ans.value - sv.pauli_expectation(oracle, p))
+            assert ans.err >= true, (p, ans.err, true)
+        for p, fut in mfuts:
+            ans = fut.result(timeout=600)
+            true = abs(ans.value - sv.marginal_probability(oracle, p))
+            assert ans.err >= true, (p, ans.err, true)
+        for b, fut in exact_futs:
+            amp = fut.result(timeout=600)
+            assert abs(amp - sv.amplitude(oracle, b)) < 1e-12, b
+
+        stats = svc.stats()
+        tiers = stats["by_tier"]
+        want_approx = len(futs) + len(efuts) + len(mfuts)
+        assert tiers["approx"]["counts"]["completed"] == want_approx, tiers
+        assert tiers["approx"]["counts"]["escalated"] == 0, tiers
+        assert tiers["exact"]["counts"]["completed"] == len(exact_futs)
+        assert tiers["approx"]["dispatch"]["count"] > 0
+
+    # every dispatch span is single-kind (keys partition the window)
+    kinds_per_span = [
+        rec.args.get("kind")
+        for rec in obs.get_registry().span_records()
+        if rec.name == "serve.dispatch"
+    ]
+    assert all(k is not None for k in kinds_per_span)
+    assert {"approx", "amplitude"} <= set(kinds_per_span), kinds_per_span
+    print(
+        f"[approx_smoke] {want_approx} tolerant + {len(exact_futs)} exact "
+        f"requests served; error bars hold vs oracle; "
+        f"{len(kinds_per_span)} single-kind dispatches"
+    )
+
+    # -- 3: forced escalation ------------------------------------------
+    rng2 = np.random.default_rng(7)
+    c2 = brickwork_circuit(10, 8, rng2)
+    oracle2 = sv.statevector(c2.copy())
+    with ContractionService.from_circuit(
+        c2, approx=True, approx_options={"chis": (2, 3)}
+    ) as svc:
+        b = "1010011010"
+        ans = svc.amplitude(b, rtol=1e-10)
+        assert ans.escalated, ans
+        assert abs(ans.value - sv.amplitude(oracle2, b)) < 1e-12
+        row = svc.stats()["by_tier"]["approx"]
+        assert row["counts"]["escalated"] == 1, row
+    print("[approx_smoke] chi-capped ladder escalated; exact answer served")
+
+    # -- 4: predicted cheapness under the pinned reference model -------
+    from tnc_tpu.approx import ladder_seconds
+    from tnc_tpu.ops.program import steps_bytes, steps_flops
+    from tnc_tpu.serve import bind_circuit
+
+    rng3 = np.random.default_rng(11)
+    c3 = brickwork_circuit(26, 20, rng3)
+    model = CalibratedCostModel(
+        flops_per_s=2e9, dispatch_s=2e-6, bytes_per_s=8e9
+    )
+    bound = bind_circuit(c3.copy())
+    steps = bound.program.steps
+    exact_s = model.op_seconds(
+        steps_flops(steps), steps_bytes(steps),
+        dispatches=max(len(steps), 1),
+    )
+    from tnc_tpu.approx import ApproxProgram, ChiLadder
+
+    prog = ApproxProgram.from_circuit(c3)
+    chis = ChiLadder(chi_cap=16).rungs_for(prog)
+    approx_s = ladder_seconds(prog, chis, model)
+    assert approx_s < exact_s, (approx_s, exact_s)
+    print(
+        f"[approx_smoke] 26q x d20 brickwork: full chi ladder {chis} "
+        f"predicted {approx_s:.4f}s vs exact plan {exact_s:.4f}s "
+        f"({exact_s / approx_s:.1f}x cheaper)"
+    )
+    print("[approx_smoke] OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

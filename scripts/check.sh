@@ -89,6 +89,9 @@ TNC_TPU_PLATFORM=cpu python scripts/query_smoke.py
 echo "== SLO smoke (live /metrics==stats, >=95% trace attribution, injected slowdown flips burn+drift) =="
 TNC_TPU_PLATFORM=cpu python scripts/slo_smoke.py
 
+echo "== approx-tier smoke (chi-ladder error bars vs oracle, forced escalation, tier pricing) =="
+TNC_TPU_PLATFORM=cpu python scripts/approx_smoke.py
+
 echo "== distributed smoke (2-process scatter -> overlapped fan-in -> gather, oracle bit-compare) =="
 python scripts/distributed_smoke.py
 

@@ -31,6 +31,7 @@ TOOL = sys.monitoring.COVERAGE_ID
 # disabled path is exactly the kind of code a gate would never notice
 # missing):
 REQUIRED_SUBPACKAGES = (
+    "approx",
     "benchmark",
     "contractionpath",
     "obs",

@@ -31,6 +31,11 @@ failures, unless ``--strict``):
   per-query-type qps and p50 latency, so a regression confined to one
   query type (sampling, expectation, marginal) is flagged even when
   amplitude traffic dominates the overall numbers;
+- the fidelity-tier serving block (``serving.by_tier.<tier>``) —
+  per-tier qps, p50 latency and escalation rate, so the approximate
+  tier getting slower (or its chi-ladder suddenly escalating most
+  requests to the exact pipeline) is flagged independently of the
+  exact tier's numbers;
 - the serving SLO block (``serving.slo``) — the candidate's worst
   measured-vs-baseline dispatch drift ratio (warn beyond 1.5x: the
   hardware/schedule moved away from what the run itself calibrated)
@@ -203,6 +208,44 @@ def compare(
                 f"warning: serving type '{kind}' p50 latency regressed "
                 f"{float(cp50) / float(bp):.2f}x ({bp:.4g}ms -> "
                 f"{cp50:.4g}ms)"
+            )
+
+    # serving per-fidelity-tier cross-check (exact vs approx): a tier
+    # whose qps or p50 regressed — or an approx tier suddenly
+    # escalating — is flagged even when the mixed headline absorbed it
+    btt = (base.get("serving") or {}).get("by_tier") or {}
+    ctt = (cand.get("serving") or {}).get("by_tier") or {}
+    for tier in sorted(set(btt) & set(ctt)):
+        bq, cq = (btt[tier] or {}).get("qps"), (ctt[tier] or {}).get("qps")
+        if bq and cq and float(cq) < float(bq) / 1.5:
+            msgs.append(
+                f"warning: serving tier '{tier}' qps dropped "
+                f"{float(bq) / float(cq):.2f}x ({bq:.4g} -> {cq:.4g})"
+            )
+        bp = (btt[tier] or {}).get("p50_ms")
+        cp50 = (ctt[tier] or {}).get("p50_ms")
+        if bp and cp50 and float(cp50) / float(bp) > 1.5:
+            msgs.append(
+                f"warning: serving tier '{tier}' p50 latency regressed "
+                f"{float(cp50) / float(bp):.2f}x ({bp:.4g}ms -> "
+                f"{cp50:.4g}ms)"
+            )
+        # tolerance misses = escalations served exactly PLUS capped
+        # misses served below tolerance — the cap must not hide the
+        # worst failure mode (tolerance-unmet answers) from the gate
+        def _miss(row):
+            return ((row or {}).get("escalated", 0) or 0) + (
+                (row or {}).get("escalation_capped", 0) or 0
+            )
+
+        be, ce = _miss(btt[tier]), _miss(ctt[tier])
+        breq = (btt[tier] or {}).get("requests", 0) or 0
+        creq = (ctt[tier] or {}).get("requests", 0) or 0
+        if creq and breq and ce / creq > be / breq + 0.25:
+            msgs.append(
+                f"warning: serving tier '{tier}' tolerance-miss rate "
+                f"jumped {be / breq:.2f} -> {ce / creq:.2f} (chi-ladder "
+                f"no longer meeting tolerances?)"
             )
 
     # serving SLO cross-check: a candidate whose serve bench drifted

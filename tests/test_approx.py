@@ -1,0 +1,497 @@
+"""Fidelity-tiered approximate serving: the `tnc_tpu/approx/` tier.
+
+Three layers under test, each against ground truth:
+
+- **grids** (`approx/program.py`): nearest-neighbour circuits flatten
+  into boundary-MPS grids whose exact (`chi` >= boundary rank)
+  contraction matches the dense statevector oracle — amplitudes,
+  Pauli expectations, marginal probabilities — with per-request
+  payloads rebinding leaf data in place (never rebuilding the grid);
+- **chi-ladder** (`approx/ladder.py`): the per-answer error estimate
+  bounds the TRUE error at every rung, on seeded PEPS sandwiches and
+  circuits; `chi` >= boundary rank ⇒ bitwise-exact value and err ≈ 0;
+- **routing** (`serve/service.py` FidelityRouter): tolerant requests
+  land on the approx tier, a tolerance the ladder cannot meet
+  escalates to the exact pipeline (counted, capped), and a mixed
+  exact/approx queue never cross-batches tiers.
+"""
+
+import numpy as np
+import pytest
+
+from tnc_tpu import obs
+from tnc_tpu.approx import (
+    ApproxProgram,
+    ChiLadder,
+    circuit_to_grid,
+    default_chis,
+    exact_chi_bound,
+    ladder_seconds,
+    rung_seconds,
+    sweep_cost,
+)
+from tnc_tpu.builders.circuit_builder import Circuit
+from tnc_tpu.builders.peps import peps
+from tnc_tpu.builders.random_circuit import brickwork_circuit
+from tnc_tpu.obs.calibrate import CalibratedCostModel
+from tnc_tpu.obs.core import MetricsRegistry
+from tnc_tpu.queries import statevector as sv
+from tnc_tpu.serve import ApproxAnswer, ContractionService
+from tnc_tpu.tensornetwork.approximate import (
+    attach_random_data,
+    boundary_mps_contract,
+    collapse_peps_sandwich,
+)
+from tnc_tpu.tensornetwork.tensordata import TensorData
+
+
+@pytest.fixture
+def enabled_obs():
+    reg = obs.configure(enabled=True, registry=MetricsRegistry())
+    try:
+        yield reg
+    finally:
+        obs.configure(enabled=False, registry=MetricsRegistry())
+
+
+def peps_program(length=4, depth=4, layers=1, seed=3):
+    rng = np.random.default_rng(seed)
+    tn = attach_random_data(peps(length, depth, 2, 2, layers), rng)
+    from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+    from tnc_tpu.tensornetwork.contraction import contract_tensor_network
+
+    res = Greedy(OptMethod.GREEDY).find_path(tn)
+    want = complex(
+        np.asarray(
+            contract_tensor_network(
+                tn, res.replace_path(), backend="numpy"
+            ).data.into_data()
+        ).reshape(-1)[0]
+    )
+    return ApproxProgram.from_peps_sandwich(tn, length, depth, layers), want
+
+
+# -- grids vs the dense oracle ---------------------------------------------
+
+
+def test_amplitude_grid_matches_oracle_and_rebinds_in_place():
+    rng = np.random.default_rng(3)
+    circuit = brickwork_circuit(6, 4, rng)
+    state = sv.statevector(circuit.copy())
+    prog = ApproxProgram.from_circuit(circuit)
+    chi = exact_chi_bound(prog.grid)
+    grid_ids = [id(t) for row in prog.grid for t in row]
+    for bits in ("000000", "101010", "110011", "011101"):
+        want = sv.amplitude(state, bits)
+        got, weight = prog.rebind_bits(bits).contract(chi)
+        assert abs(got - want) <= 1e-12 * max(1.0, abs(want)), bits
+        assert weight == 0.0
+    # rebinding swapped leaf DATA only: the grid objects are unchanged
+    assert [id(t) for row in prog.grid for t in row] == grid_ids
+
+
+def test_sandwich_grid_expectation_and_marginal_match_oracle():
+    rng = np.random.default_rng(5)
+    circuit = brickwork_circuit(6, 3, rng)
+    state = sv.statevector(circuit.copy())
+    prog = ApproxProgram.sandwich_from_circuit(circuit)
+    chi = exact_chi_bound(prog.grid)
+    for pauli in ("zzzzzz", "ixyzxi", "yyxxzz"):
+        want = sv.pauli_expectation(state, pauli)
+        got, _ = prog.rebind_pauli(pauli).contract(chi)
+        assert abs(got - want) <= 1e-12, pauli
+    for pattern in ("01****", "1*0*1*", "******", "010101"):
+        want = sv.marginal_probability(state, pattern)
+        got, _ = prog.rebind_projectors(pattern).contract(chi)
+        assert abs(got.real - want) <= 1e-12, pattern
+
+
+def test_sandwich_conj_layer_with_non_symmetric_gates():
+    """The conjugate layer mirrors wire ROLES, not just data: with a
+    non-symmetric gate (ry, sy) an orientation slip transposes the
+    mirror and silently corrupts every expectation/marginal — the
+    symmetric h/rz/cx brickwork alphabet cannot catch it."""
+    c = Circuit()
+    reg = c.allocate_register(3)
+    c.append_gate(TensorData.gate("ry", (0.7,)), [reg.qubit(0)])
+    c.append_gate(TensorData.gate("sy"), [reg.qubit(1)])
+    c.append_gate(TensorData.gate("cx"), [reg.qubit(0), reg.qubit(1)])
+    c.append_gate(TensorData.gate("ry", (1.3,)), [reg.qubit(2)])
+    state = sv.statevector(c.copy())
+    prog = ApproxProgram.sandwich_from_circuit(c)
+    chi = exact_chi_bound(prog.grid)
+    for pauli in ("zzz", "ziy", "xiz"):
+        want = sv.pauli_expectation(state, pauli)
+        got, _ = prog.rebind_pauli(pauli).contract(chi)
+        assert abs(got - want) <= 1e-12, (pauli, got, want)
+    for pattern in ("0**", "*1*", "10*"):
+        want = sv.marginal_probability(state, pattern)
+        got, _ = prog.rebind_projectors(pattern).contract(chi)
+        assert abs(got.real - want) <= 1e-12, (pattern, got, want)
+
+
+def test_reversed_two_qubit_gate_and_line_circuit():
+    """A CX with control on the HIGHER qubit index exercises the
+    axis-swap in the gate split."""
+    c = Circuit()
+    reg = c.allocate_register(3)
+    c.append_gate(TensorData.gate("h"), [reg.qubit(2)])
+    c.append_gate(TensorData.gate("cx"), [reg.qubit(2), reg.qubit(1)])
+    c.append_gate(TensorData.gate("cx"), [reg.qubit(1), reg.qubit(0)])
+    state = sv.statevector(c.copy())
+    prog = ApproxProgram.from_circuit(c)
+    for bits in ("000", "111", "011"):
+        want = sv.amplitude(state, bits)
+        got, _ = prog.rebind_bits(bits).contract(16)
+        assert abs(got - want) <= 1e-12, bits
+
+
+def test_non_nearest_neighbour_gate_rejected_at_build():
+    c = Circuit()
+    reg = c.allocate_register(3)
+    c.append_gate(TensorData.gate("cx"), [reg.qubit(0), reg.qubit(2)])
+    with pytest.raises(ValueError, match="non-adjacent"):
+        circuit_to_grid(c)
+
+
+def test_rebind_validation():
+    rng = np.random.default_rng(0)
+    prog = ApproxProgram.from_circuit(brickwork_circuit(4, 2, rng))
+    with pytest.raises(ValueError, match="fully determined"):
+        prog.rebind_bits("01*1")
+    with pytest.raises(ValueError, match="amplitude"):
+        prog.rebind_pauli("zzzz")
+    sand = ApproxProgram.sandwich_from_circuit(
+        brickwork_circuit(4, 2, np.random.default_rng(0))
+    )
+    with pytest.raises(ValueError, match="2x2"):
+        sand.rebind_operators([np.eye(3)] + [None] * 3)
+    with pytest.raises(ValueError, match="sandwich"):
+        sand.rebind_bits("0101")
+
+
+# -- chi-ladder error estimates vs ground truth ----------------------------
+
+
+@pytest.mark.parametrize("seed", [3, 7, 11, 19])
+def test_ladder_estimate_bounds_true_error_on_peps(seed):
+    prog, want = peps_program(seed=seed)
+    ladder = ChiLadder(chi_cap=256)
+    res = ladder.run(prog, rtol=1e-8, scale=abs(want))
+    assert res.converged
+    for rung in res.rungs:
+        true = abs(rung.value - want)
+        assert rung.err >= true, (rung.chi, rung.err, true)
+    # the ladder climbed: ascending chis, decreasing discarded weight
+    chis = [r.chi for r in res.rungs]
+    assert chis == sorted(chis)
+    assert res.rungs[-1].weight <= res.rungs[0].weight
+
+
+@pytest.mark.parametrize("seed", [1, 9])
+def test_ladder_estimate_bounds_true_error_on_circuit(seed):
+    rng = np.random.default_rng(seed)
+    circuit = brickwork_circuit(10, 8, rng)
+    state = sv.statevector(circuit.copy())
+    bits = "1010011010"
+    want = sv.amplitude(state, bits)
+    prog = ApproxProgram.from_circuit(circuit).rebind_bits(bits)
+    # force truncated rungs: the grid's exact bound is above this cap
+    assert exact_chi_bound(prog.grid) > 3
+    res = ChiLadder(chis=(2, 3, 4, 8, 16)).run(
+        prog, rtol=1e-12, scale=2.0 ** -5
+    )
+    assert len(res.rungs) >= 2
+    for rung in res.rungs:
+        true = abs(rung.value - want)
+        assert rung.err >= true, (rung.chi, rung.err, true)
+
+
+def test_ladder_exact_rung_bitwise_and_err_near_zero():
+    prog, want = peps_program(seed=7)
+    bound = exact_chi_bound(prog.grid)
+    ladder = ChiLadder(chi_cap=max(bound, 2))
+    res = ladder.run(prog, rtol=1e-8, scale=abs(want))
+    assert res.converged
+    top = res.rungs[-1]
+    assert top.chi >= bound
+    assert top.weight <= 1e-20  # nothing truncated at the top rung
+    # err ≈ 0 and still bounds the true error vs the exact contractor
+    assert top.err <= 1e-8 * max(abs(top.value), abs(want))
+    assert top.err >= abs(top.value - want)
+    # the ladder adds no numerics of its own: its top-rung value is
+    # BITWISE the direct boundary contraction at the same chi
+    direct = boundary_mps_contract(prog.grid, chi=top.chi)
+    assert direct == top.value
+
+
+def test_ladder_converged_answers_stop_climbing():
+    prog, want = peps_program(seed=3)
+    full = ChiLadder(chi_cap=256).rungs_for(prog)
+    res = ChiLadder(chi_cap=256).run(prog, rtol=0.5, scale=abs(want))
+    assert res.converged
+    assert res.sweeps < len(full)  # loose tolerance stopped early
+
+
+def test_ladder_rejects_bad_args():
+    with pytest.raises(ValueError):
+        ChiLadder(chis=(4, 2))  # not ascending
+    with pytest.raises(ValueError):
+        ChiLadder(chis=())
+    with pytest.raises(ValueError):
+        ChiLadder(safety=0.0)
+    prog, _ = peps_program(layers=0, seed=1)
+    with pytest.raises(ValueError):
+        ChiLadder().run(prog, rtol=0.0)
+
+
+# -- closed-form cost / pricing --------------------------------------------
+
+
+def test_sweep_cost_monotone_in_chi_and_prices_rungs():
+    prog, _ = peps_program(seed=3)
+    costs = [sweep_cost(prog, chi).flops for chi in (2, 8, 32)]
+    assert costs == sorted(costs)
+    model = CalibratedCostModel(
+        flops_per_s=1e9, dispatch_s=1e-5, bytes_per_s=1e10
+    )
+    secs = [rung_seconds(prog, chi, model) for chi in (2, 8, 32)]
+    assert all(s > 0 for s in secs)
+    assert secs == sorted(secs)
+    chis = (2, 8, 32)
+    assert ladder_seconds(prog, chis, model) == pytest.approx(sum(secs))
+
+
+def test_default_chis_end_on_exact_bound():
+    prog, _ = peps_program(seed=3)
+    bound = exact_chi_bound(prog.grid)
+    chis = default_chis(prog.grid, chi_cap=4 * bound)
+    assert chis[-1] == bound
+    assert list(chis) == sorted(set(chis))
+    capped = default_chis(prog.grid, chi_cap=max(bound // 2, 2))
+    assert capped[-1] == max(bound // 2, 2)
+
+
+def test_sweep_spans_carry_row_costs(enabled_obs):
+    prog, _ = peps_program(seed=3)
+    prog.contract(8)
+    recs = enabled_obs.span_records()
+    sweeps = [r for r in recs if r.name == "approx.sweep"]
+    rows = [r for r in recs if r.name == "approx.row"]
+    assert len(sweeps) == 1 and sweeps[0].args["chi"] == 8
+    assert len(rows) == len(prog.grid) - 2
+    assert all(r.args.get("flops", 0) > 0 for r in rows)
+    assert all(r.args.get("bytes", 0) > 0 for r in rows)
+
+
+# -- streaming jax path ----------------------------------------------------
+
+
+def test_jax_streaming_matches_numpy_and_reuses_row_cache():
+    from tnc_tpu.tensornetwork.approximate import _jax_row_fn
+
+    prog, _ = peps_program(seed=5)
+    v_np, w_np = prog.contract(8, backend="numpy")
+    before = _jax_row_fn.cache_info().currsize
+    v_jx, w_jx = prog.contract(8, backend="jax")
+    after = _jax_row_fn.cache_info().currsize
+    assert abs(v_np - v_jx) <= 1e-6 * max(1.0, abs(v_np))
+    assert w_jx == pytest.approx(w_np, rel=1e-6)
+    # second same-shape sweep compiles nothing new
+    prog.contract(8, backend="jax")
+    assert _jax_row_fn.cache_info().currsize == after
+    assert after > before  # the first jax sweep did populate it
+
+
+# -- grid-construction validation (satellite) ------------------------------
+
+
+def test_collapse_names_offending_site():
+    rng = np.random.default_rng(0)
+    tn = attach_random_data(peps(3, 3, 2, 2, 0), rng)
+    # poison ONE site's data with a wrong-shaped payload
+    leaves = list(tn.tensors)
+    victim = leaves[3 * 3 + 1 * 3 + 2]  # layer 1, row 1, col 2
+    victim.data = TensorData.matrix(np.ones((5, 7), dtype=np.complex128))
+    with pytest.raises(ValueError, match=r"\(row 1, col 2\)"):
+        collapse_peps_sandwich(tn, 3, 3, 0)
+
+
+def test_attach_random_data_names_mismatched_leaf():
+    tn = peps(3, 3, 2, 2, 0)
+    victim_index = 4
+    list(tn.tensors)[victim_index].data = TensorData.matrix(
+        np.ones(3, dtype=np.complex128)
+    )
+    with pytest.raises(ValueError, match=f"leaf {victim_index} "):
+        attach_random_data(tn, np.random.default_rng(0))
+
+
+# -- service routing -------------------------------------------------------
+
+
+def serving_case(n=8, depth=5, seed=9):
+    rng = np.random.default_rng(seed)
+    circuit = brickwork_circuit(n, depth, rng)
+    return circuit, sv.statevector(circuit.copy())
+
+
+def test_tolerant_request_lands_on_approx_tier():
+    circuit, state = serving_case()
+    with ContractionService.from_circuit(circuit, approx=True) as svc:
+        bits = "10100110"
+        ans = svc.amplitude(bits, rtol=1e-2)
+        assert isinstance(ans, ApproxAnswer)
+        assert not ans.escalated and ans.tolerance_met
+        assert ans.chi_used is not None and ans.sweeps >= 1
+        true = abs(ans.value - sv.amplitude(state, bits))
+        assert ans.err >= true
+        rows = svc.stats()["by_tier"]
+        assert rows["approx"]["counts"]["completed"] == 1
+        assert rows["approx"]["counts"]["escalated"] == 0
+        assert rows["exact"]["counts"]["completed"] == 0
+        assert rows["approx"]["dispatch"]["count"] == 1
+        assert rows["approx"]["router"]["escalations"] == 0
+
+
+def test_tolerant_expectation_and_marginal_route_and_bound_error():
+    circuit, state = serving_case(seed=13)
+    with ContractionService.from_circuit(circuit, approx=True) as svc:
+        ev = svc.expectation("zzzzzzzz", rtol=1e-2)
+        assert isinstance(ev, ApproxAnswer)
+        assert ev.err >= abs(ev.value - sv.pauli_expectation(state, "zzzzzzzz"))
+        # a Pauli SUM combines per-term ladders with summed error bars
+        terms = [(0.5, "zzzzzzzz"), (0.25, "ixixixix"), (0.25, "zzzzzzzz")]
+        want = 0.75 * sv.pauli_expectation(
+            state, "zzzzzzzz"
+        ) + 0.25 * sv.pauli_expectation(state, "ixixixix")
+        es = svc.expectation(terms, rtol=1e-2)
+        assert es.err >= abs(es.value - want)
+        mg = svc.marginal("10**01**", rtol=1e-2)
+        assert isinstance(mg.value, float)
+        assert mg.err >= abs(mg.value - sv.marginal_probability(state, "10**01**"))
+        assert svc.stats()["by_tier"]["approx"]["counts"]["completed"] == 3
+
+
+def test_escalation_serves_exact_answer_counted_and_spanned(enabled_obs):
+    circuit, state = serving_case(n=10, depth=8, seed=1)
+    with ContractionService.from_circuit(
+        circuit, approx=True, approx_options={"chis": (2, 3)}
+    ) as svc:
+        bits = "1010011010"
+        ans = svc.amplitude(bits, rtol=1e-10)
+        assert ans.escalated and ans.tolerance_met
+        assert ans.chi_used is None
+        want = sv.amplitude(state, bits)
+        assert abs(ans.value - want) <= 1e-12
+        assert ans.err >= abs(ans.value - want)
+        row = svc.stats()["by_tier"]["approx"]
+        assert row["counts"]["escalated"] == 1
+        assert row["router"]["escalations"] == 1
+    spans = [
+        r for r in enabled_obs.span_records() if r.name == "serve.escalate"
+    ]
+    assert len(spans) == 1 and spans[0].args["kind"] == "amplitude"
+
+
+def test_escalation_cap_serves_approx_answer_flagged():
+    circuit, state = serving_case(n=10, depth=8, seed=1)
+    with ContractionService.from_circuit(
+        circuit,
+        approx=True,
+        approx_options={"chis": (2, 3), "max_escalations": 0},
+    ) as svc:
+        ans = svc.amplitude("1010011010", rtol=1e-10)
+        assert not ans.escalated
+        assert not ans.tolerance_met  # honest: tolerance NOT met
+        assert np.isfinite(ans.err)
+        row = svc.stats()["by_tier"]["approx"]
+        assert row["counts"]["escalation_capped"] == 1
+        assert row["counts"]["escalated"] == 0
+        assert row["router"]["escalations_capped"] == 1
+
+
+def test_mixed_queue_never_cross_batches_tiers(enabled_obs):
+    circuit, state = serving_case()
+    with ContractionService.from_circuit(
+        circuit, approx=True, max_wait_ms=50.0, max_batch=64
+    ) as svc:
+        # interleave exact and tolerant submissions inside one window
+        futs = []
+        for i in range(10):
+            bits = format(i * 13 % 256, "08b")
+            futs.append(("exact", bits, svc.submit(bits)))
+            futs.append(("approx", bits, svc.submit(bits, rtol=5e-2)))
+        for kind, bits, fut in futs:
+            res = fut.result(timeout=600)
+            want = sv.amplitude(state, bits)
+            if kind == "exact":
+                assert abs(res - want) <= 1e-12
+            else:
+                assert res.err >= abs(res.value - want)
+    dispatches = [
+        r for r in enabled_obs.span_records() if r.name == "serve.dispatch"
+    ]
+    assert dispatches
+    kinds = {r.args["kind"] for r in dispatches}
+    assert {"amplitude", "approx"} <= kinds
+    # the partition-by-key invariant: no dispatch mixes tiers — every
+    # span carries exactly one kind, and total riders add up
+    riders = sum(int(r.args["batch"]) for r in dispatches)
+    assert riders == len(futs)
+
+
+def test_rtol_without_router_raises_and_validation():
+    circuit, _ = serving_case()
+    with ContractionService.from_circuit(circuit.copy()) as svc:
+        with pytest.raises(ValueError, match="approximate tier"):
+            svc.submit("10100110", rtol=1e-2)
+    with ContractionService.from_circuit(circuit, approx=True) as svc:
+        with pytest.raises(ValueError, match="rtol"):
+            svc.submit("10100110", rtol=-1.0)
+        with pytest.raises(ValueError, match="fully determined"):
+            svc.submit("1010*110", rtol=1e-2)
+        # stats survive a reset with the tier rows zeroed
+        svc.amplitude("10100110", rtol=1e-2)
+        svc.reset_stats()
+        row = svc.stats()["by_tier"]["approx"]
+        assert row["counts"]["completed"] == 0
+        assert row["dispatch"]["count"] == 0
+
+
+def test_reset_stats_also_resets_router_escalation_audit():
+    circuit, _ = serving_case(n=10, depth=8, seed=1)
+    with ContractionService.from_circuit(
+        circuit, approx=True, approx_options={"chis": (2, 3)}
+    ) as svc:
+        svc.amplitude("1010011010", rtol=1e-10)  # escalates
+        assert svc.fidelity_router.escalations == 1
+        svc.reset_stats()
+        row = svc.stats()["by_tier"]["approx"]
+        # the two escalation surfaces describe the SAME window
+        assert row["counts"]["escalated"] == 0
+        assert row["router"]["escalations"] == 0
+
+
+def test_router_quotes_ladder_seconds_like_exact_plans():
+    circuit, _ = serving_case()
+    model = CalibratedCostModel(
+        flops_per_s=1e9, dispatch_s=1e-5, bytes_per_s=1e10
+    )
+    with ContractionService.from_circuit(
+        circuit, approx=True, approx_options={"cost_model": model}
+    ) as svc:
+        router = svc.fidelity_router
+        quote = router.quote_seconds("amplitude")
+        assert quote is not None and quote > 0
+        # the quote is the sum of the rung prices the ladder would pay
+        prog = router.program("amplitude")
+        chis = router.ladder.rungs_for(prog)
+        assert quote == pytest.approx(
+            sum(rung_seconds(prog, chi, model) for chi in chis)
+        )
+        desc = svc.stats()["by_tier"]["approx"]["router"]
+        assert desc["quote_s"]["amplitude"] == pytest.approx(quote, abs=1e-6)
+        # executed rungs carry their predicted seconds
+        ans = svc.amplitude("10100110", rtol=1e-2)
+        assert isinstance(ans, ApproxAnswer)

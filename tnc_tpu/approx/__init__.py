@@ -1,0 +1,45 @@
+"""tnc_tpu.approx — the fidelity-tiered approximate serving tier.
+
+Most traffic does not need an exact sycamore-class contraction; it
+needs a cheap answer with an honest error bar. This package promotes
+the boundary-MPS contractor
+(:mod:`tnc_tpu.tensornetwork.approximate`) into that serving tier:
+
+- :class:`ApproxProgram` (``program.py``) — serving workloads mapped
+  onto the boundary contractor: PEPS sandwiches via
+  ``collapse_peps_sandwich``, nearest-neighbour circuit amplitudes and
+  expectation/marginal sandwiches flattened into qubit×depth grids,
+  all with rebindable leaf sites (per-request payloads swap leaf data
+  without rebuilding the grid — the ``serve/rebind`` contract).
+- :class:`ChiLadder` (``ladder.py``) — runs a request at ascending
+  ``chi`` rungs, derives a per-answer error estimate from discarded
+  SVD weight plus inter-rung deltas, and reports
+  ``(value, err, chi_used)``; converged answers stop climbing,
+  unconverged ones escalate.
+- ``cost.py`` — closed-form flop/byte pricing of every rung through
+  :class:`~tnc_tpu.obs.calibrate.CalibratedCostModel`, so admission
+  control quotes approximate-tier latency exactly like exact plans.
+
+The service front end (:class:`tnc_tpu.serve.service.FidelityRouter`)
+routes ``rtol=``-tolerant requests here and escalates misses to the
+exact pipeline. See ``docs/approximate.md``.
+"""
+
+from tnc_tpu.approx.cost import (  # noqa: F401
+    SweepCost,
+    default_chis,
+    exact_chi_bound,
+    ladder_seconds,
+    rung_seconds,
+    sweep_cost,
+)
+from tnc_tpu.approx.ladder import (  # noqa: F401
+    ChiLadder,
+    LadderResult,
+    Rung,
+)
+from tnc_tpu.approx.program import (  # noqa: F401
+    ApproxProgram,
+    circuit_to_grid,
+    sandwich_to_grid,
+)

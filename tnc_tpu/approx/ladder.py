@@ -1,0 +1,224 @@
+"""Chi-ladder execution: ascending-``chi`` sweeps with per-answer
+error estimates.
+
+A request runs at ascending ``chi`` rungs; each rung's sweep reports
+its accumulated relative discarded SVD weight
+(:func:`~tnc_tpu.tensornetwork.approximate.boundary_contract_with_weight`),
+and the ladder derives an **error estimate** per rung:
+
+- weight ≤ :data:`~tnc_tpu.tensornetwork.approximate.EXACT_WEIGHT`:
+  nothing was truncated — the sweep is the exact contraction up to
+  roundoff, ``err = fp_floor · max(|v|, scale)`` where ``fp_floor`` is
+  the executing backend's precision (:data:`EXACT_ERR_REL` for
+  complex128, :data:`COMPLEX64_ERR_REL` for a single-precision jax
+  sweep — a float32 sweep must never claim a float64 bar); every
+  finite estimate below is floored by the same term;
+- first truncated rung: ``err = inf`` — a single truncated sweep
+  carries no convergence evidence, so the estimate refuses to vouch
+  for it (the ladder always climbs at least one more rung);
+- later rungs: ``err = safety · (|v_k − v_{k−1}| +
+  max(|v_k|, scale) · √weight_k)`` — the observed inter-rung movement
+  plus the truncation-weight bound on the state error, inflated by
+  ``safety``. The weight term scales with ``max(|v|, scale)``: under
+  heavy truncation the approximate value itself can collapse toward
+  zero, and an error bar proportional to the collapsed value would
+  vouch for exactly the answers it should distrust.
+
+Convergence: ``err ≤ rtol · max(|v|, scale)`` — ``scale`` anchors the
+tolerance for answers whose magnitude is legitimately tiny (an
+amplitude's natural scale is ``2^(-n/2)``, a probability's is 1).
+Converged answers stop climbing; a ladder that exhausts its rungs
+without converging reports ``converged=False`` and the serving router
+escalates to the exact pipeline
+(:class:`tnc_tpu.serve.service.FidelityRouter`).
+
+>>> from tnc_tpu.approx.program import ApproxProgram
+>>> from tnc_tpu.builders.circuit_builder import Circuit
+>>> from tnc_tpu.tensornetwork.tensordata import TensorData
+>>> c = Circuit(); reg = c.allocate_register(2)
+>>> c.append_gate(TensorData.gate("h"), [reg.qubit(0)])
+>>> c.append_gate(TensorData.gate("cx"), [reg.qubit(0), reg.qubit(1)])
+>>> res = ChiLadder().run(ApproxProgram.from_circuit(c).rebind_bits("00"),
+...                       rtol=1e-6, scale=0.5)
+>>> res.converged, res.chi_used, round(abs(res.value), 6)
+(True, 2, 0.707107)
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Sequence
+
+from tnc_tpu import obs
+from tnc_tpu.tensornetwork.approximate import EXACT_WEIGHT
+
+__all__ = [
+    "ChiLadder", "LadderResult", "Rung",
+    "COMPLEX64_ERR_REL", "EXACT_ERR_REL",
+]
+
+#: relative error attributed to an untruncated (exact) complex128
+#: sweep — pure floating-point margin vs a differently-ordered exact
+#: contraction
+EXACT_ERR_REL = 1e-9
+
+#: the same margin when the sweep ran in single precision (the jax
+#: backend without ``jax_enable_x64``): unit roundoff ~1e-7 compounds
+#: over the row products, so every rung's bar is floored here —
+#: without it an untruncated complex64 sweep would claim a 1e-9 bar
+#: while carrying ~1e-7-scale error (caught against the dense oracle)
+COMPLEX64_ERR_REL = 1e-4
+
+
+def _fp_floor(backend: str) -> float:
+    """The sweep's floating-point error floor (relative) for the
+    backend that will run it."""
+    if backend == "jax":
+        import jax
+
+        if not jax.config.read("jax_enable_x64"):
+            return COMPLEX64_ERR_REL
+    return EXACT_ERR_REL
+
+
+@dataclass(frozen=True)
+class Rung:
+    """One executed rung: the sweep's value, its accumulated discarded
+    SVD weight, the derived error estimate, and (when a cost model
+    priced the ladder) the rung's predicted seconds."""
+
+    chi: int
+    value: complex
+    weight: float
+    err: float
+    predicted_s: float | None = None
+
+
+@dataclass(frozen=True)
+class LadderResult:
+    """The ladder's answer: ``value`` with error estimate ``err`` at
+    bond dimension ``chi_used``; ``converged`` says whether ``err`` met
+    the requested tolerance (the router escalates when it didn't);
+    ``rungs`` records the whole climb."""
+
+    value: complex
+    err: float
+    chi_used: int
+    converged: bool
+    rungs: tuple[Rung, ...]
+
+    @property
+    def sweeps(self) -> int:
+        return len(self.rungs)
+
+
+class ChiLadder:
+    """Run requests up a ``chi`` ladder until the error estimate meets
+    the requested tolerance.
+
+    ``chis`` pins the rungs explicitly; otherwise they double from
+    ``chi_start`` up to ``min(exact boundary rank, chi_cap)`` per grid
+    (:func:`tnc_tpu.approx.cost.default_chis`) — when the exact rank
+    fits under the cap the top rung is truncation-free, so every
+    tolerance converges; when it doesn't, tight tolerances can exhaust
+    the ladder and escalate. ``safety`` inflates the error estimate
+    (larger = more honest bars, more escalations).
+    """
+
+    def __init__(
+        self,
+        chis: Sequence[int] | None = None,
+        chi_start: int = 2,
+        chi_cap: int = 64,
+        safety: float = 4.0,
+    ) -> None:
+        if chis is not None:
+            chis = tuple(int(c) for c in chis)
+            if not chis or any(c < 1 for c in chis):
+                raise ValueError("chis must be a non-empty list of >= 1")
+            if list(chis) != sorted(chis):
+                raise ValueError("chis must ascend")
+        if chi_start < 1 or chi_cap < chi_start:
+            raise ValueError("need 1 <= chi_start <= chi_cap")
+        if safety <= 0.0:
+            raise ValueError("safety must be > 0")
+        self.chis = chis
+        self.chi_start = int(chi_start)
+        self.chi_cap = int(chi_cap)
+        self.safety = float(safety)
+
+    def rungs_for(self, program) -> tuple[int, ...]:
+        """The rung schedule for one program's grid."""
+        if self.chis is not None:
+            return self.chis
+        from tnc_tpu.approx.cost import default_chis
+
+        # pass the program, not its grid: the bound is derived from the
+        # memoized site_dims geometry
+        return default_chis(
+            program, chi_start=self.chi_start, chi_cap=self.chi_cap
+        )
+
+    def estimate(
+        self,
+        value: complex,
+        weight: float,
+        prev: complex | None,
+        scale: float = 0.0,
+        fp_floor: float = EXACT_ERR_REL,
+    ) -> float:
+        """The per-rung error estimate (module docstring semantics).
+        ``fp_floor`` is the executing backend's relative roundoff
+        floor — every finite estimate is floored by it, so a
+        single-precision sweep never claims a double-precision bar."""
+        floor = fp_floor * max(abs(value), scale)
+        if weight <= EXACT_WEIGHT:
+            return floor
+        if prev is None:
+            return math.inf
+        return floor + self.safety * (
+            abs(value - prev) + max(abs(value), scale) * math.sqrt(weight)
+        )
+
+    def run(
+        self,
+        program,
+        rtol: float,
+        scale: float = 0.0,
+        backend: str = "numpy",
+        cost_model=None,
+    ) -> LadderResult:
+        """Climb the ladder for the program's CURRENT binding.
+
+        ``rtol`` is relative to ``max(|value|, scale)``;
+        ``cost_model`` (a
+        :class:`~tnc_tpu.obs.calibrate.CalibratedCostModel`) prices
+        each executed rung in predicted seconds on its
+        :class:`Rung`."""
+        if rtol <= 0.0:
+            raise ValueError("rtol must be > 0")
+        chis = self.rungs_for(program)
+        fp_floor = _fp_floor(backend)
+        rungs: list[Rung] = []
+        prev: complex | None = None
+        value, err, chi = 0.0 + 0.0j, math.inf, chis[0]
+        with obs.span(
+            "approx.ladder", rtol=rtol, max_rungs=len(chis),
+            kind=program.kind,
+        ) as sp:
+            for chi in chis:
+                predicted = None
+                if cost_model is not None:
+                    from tnc_tpu.approx.cost import rung_seconds
+
+                    predicted = rung_seconds(program, chi, cost_model)
+                value, weight = program.contract(chi, backend=backend)
+                err = self.estimate(value, weight, prev, scale, fp_floor)
+                rungs.append(Rung(chi, value, weight, err, predicted))
+                if err <= rtol * max(abs(value), scale):
+                    sp.add(rungs=len(rungs))
+                    return LadderResult(value, err, chi, True, tuple(rungs))
+                prev = value
+            sp.add(rungs=len(rungs))
+        return LadderResult(value, err, chi, False, tuple(rungs))

@@ -20,6 +20,11 @@ The serving pipeline, front to back:
   hyper-optimizes hot structures between requests and atomically swaps
   in plans whose predicted cost wins; :class:`SharedCacheWatcher`
   adopts other replicas' published plans into a running service.
+- :class:`FidelityRouter` (``service.py``) — fidelity tiers:
+  ``submit*(..., rtol=)`` routes tolerant requests to the boundary-MPS
+  chi-ladder tier (:mod:`tnc_tpu.approx`) under its own batching key,
+  returns :class:`ApproxAnswer` ``(value, err, chi_used)``, and
+  escalates tolerance misses to the exact pipeline (counted, capped).
 - multi-host fan-out (``multihost.py``) — the root process shards
   micro-batched bras (bit-identical) or slice ranges across every
   process of a ``jax.distributed`` fleet via
@@ -53,8 +58,10 @@ from tnc_tpu.serve.replan import (  # noqa: F401
     SharedCacheWatcher,
 )
 from tnc_tpu.serve.service import (  # noqa: F401
+    ApproxAnswer,
     ContractionService,
     DeadlineExceededError,
+    FidelityRouter,
     QueueFullError,
     ServeError,
     ServiceClosedError,

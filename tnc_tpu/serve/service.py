@@ -45,7 +45,17 @@ Production posture:
   :meth:`~ContractionService.swap_bound` in a hyper-optimized
   :class:`BoundProgram` for the SAME structure — the dispatcher adopts
   it atomically between batches (every batch runs wholly under one
-  bound, so in-flight requests are never split across plans).
+  bound, so in-flight requests are never split across plans);
+- **fidelity tiers**: ``submit``/``submit_expectation``/
+  ``submit_marginal`` accept ``rtol=`` (default exact). A tolerant
+  request routes through the :class:`FidelityRouter` to the
+  **approximate tier** — a boundary-MPS chi-ladder
+  (:mod:`tnc_tpu.approx`) with its own batching key, so approx and
+  exact traffic share the queue but never share a batch — and comes
+  back as an :class:`ApproxAnswer` carrying ``(value, err,
+  chi_used)``. A ladder that cannot meet the tolerance **escalates**
+  to the exact pipeline (counted, spanned, capped); per-tier rows ride
+  ``stats()["by_tier"]`` and the ``serve.tier.*`` metrics.
 """
 
 from __future__ import annotations
@@ -63,6 +73,7 @@ import numpy as np
 
 from tnc_tpu import obs
 from tnc_tpu.obs.core import QuantileSummary
+from tnc_tpu.ops.backends import JaxBackend
 from tnc_tpu.resilience import retry as _retry
 from tnc_tpu.resilience.faultinject import fault_point
 from tnc_tpu.serve.rebind import BoundProgram, bind_circuit, pow2_bucket
@@ -73,6 +84,16 @@ logger = logging.getLogger(__name__)
 #: power-of-two rule (rebind pads batched dispatches to it, so all
 #: measurements inside a bucket ran the same compiled shape)
 batch_bucket = pow2_bucket
+
+#: the approximate tier's request kind (its batching keys are
+#: ``(APPROX_KIND, base kind)`` — approx traffic never co-batches with
+#: exact traffic OR across base kinds)
+APPROX_KIND = "approx"
+
+
+def tier_of(kind: str) -> str:
+    """The fidelity tier a request kind serves from."""
+    return "approx" if kind == APPROX_KIND else "exact"
 
 
 class ServeError(RuntimeError):
@@ -191,8 +212,17 @@ class ContractionService:
         self._by_type: dict[str, dict] = {}
         self._latencies_by_type: dict[str, QuantileSummary] = {}
         self._ensure_type("amplitude")
+        # per-fidelity-tier breakdowns ("exact" pre-seeded; "approx"
+        # appears when a FidelityRouter is attached): counts, latency
+        # summaries, and measured dispatch seconds — the bench's
+        # serving.by_tier surface
+        self._by_tier: dict[str, dict] = {}
+        self._latencies_by_tier: dict[str, QuantileSummary] = {}
+        self._tier_dispatch: dict[str, list] = {}
+        self._ensure_tier("exact")
         # registered query handlers (sampling / expectation / marginal)
         self._handlers: dict[str, object] = {}
+        self._router = None  # attached FidelityRouter, if any
         # an improved BoundProgram staged by the background replanner;
         # the dispatcher adopts it at the next batch boundary
         self._pending_bound: BoundProgram | None = None
@@ -222,6 +252,8 @@ class ContractionService:
         shared_cache_watch: bool = False,
         watch_options: dict | None = None,
         queries: bool = False,
+        approx: bool = False,
+        approx_options: dict | None = None,
         telemetry_port: int | None = None,
         **kwargs,
     ) -> "ContractionService":
@@ -236,6 +268,13 @@ class ContractionService:
         (:func:`tnc_tpu.queries.handlers.attach_query_handlers`),
         sharing ``plan_cache``/``target_size``; the circuit is copied
         before the amplitude finalizer consumes it.
+
+        ``approx=True`` additionally attaches a :class:`FidelityRouter`
+        for the same circuit (nearest-neighbour circuits only):
+        ``submit*`` calls gain a working ``rtol=`` and tolerant
+        requests serve from the boundary-MPS chi-ladder tier,
+        escalating to the exact pipeline on a tolerance miss.
+        ``approx_options`` are :meth:`enable_approx` kwargs.
 
         ``background_replan=True`` (requires ``plan_cache``) attaches a
         :class:`~tnc_tpu.serve.replan.BackgroundReplanner`: a cache miss
@@ -254,6 +293,7 @@ class ContractionService:
         if shared_cache_watch and plan_cache is None:
             raise ValueError("shared_cache_watch requires a plan_cache")
         query_circuit = circuit.copy() if queries else None
+        approx_circuit = circuit.copy() if approx else None
         bound = bind_circuit(circuit, mask, pathfinder, plan_cache, target_size)
         svc = cls(bound, backend=backend, **kwargs)
         svc.start()
@@ -265,6 +305,8 @@ class ContractionService:
                     plan_cache=plan_cache,
                     target_size=target_size,
                 )
+            if approx:
+                svc.enable_approx(approx_circuit, **(approx_options or {}))
             if background_replan:
                 from tnc_tpu.serve.replan import BackgroundReplanner
 
@@ -429,6 +471,24 @@ class ContractionService:
         )
         return self
 
+    def enable_approx(self, circuit, **options) -> "ContractionService":
+        """Attach a :class:`FidelityRouter` for ``circuit`` (copied,
+        not consumed; nearest-neighbour circuits only — the attach
+        fails fast otherwise). ``options`` are router kwargs (``chis``,
+        ``chi_cap``, ``safety``, ``max_escalations``, ``cost_model``).
+        Afterwards ``submit*(..., rtol=...)`` routes to the
+        approximate tier."""
+        router = FidelityRouter(self, circuit, **options)
+        self.register_query_handler(router)
+        self._router = router
+        self._ensure_tier("approx")
+        return self
+
+    @property
+    def fidelity_router(self):
+        """The attached :class:`FidelityRouter` (None = exact only)."""
+        return self._router
+
     # -- submission --------------------------------------------------------
 
     def _enqueue(
@@ -476,11 +536,22 @@ class ContractionService:
         return fut
 
     def submit(
-        self, bitstring: str | Iterable, timeout_s: float | None = None
+        self,
+        bitstring: str | Iterable,
+        timeout_s: float | None = None,
+        rtol: float | None = None,
     ) -> concurrent.futures.Future:
         """Enqueue one amplitude request; returns a ``Future`` resolving
         to the amplitude (complex scalar, or an ndarray over the
-        template's open legs). ``timeout_s`` arms a deadline."""
+        template's open legs). ``timeout_s`` arms a deadline.
+
+        ``rtol`` (default None = exact) routes the request to the
+        approximate tier: the future resolves to an
+        :class:`ApproxAnswer` whose error estimate meets
+        ``rtol · max(|value|, 2^(-n/2))`` — or, when the chi-ladder
+        cannot meet it, to the escalated exact answer."""
+        if rtol is not None:
+            return self._submit_approx("amplitude", bitstring, rtol, timeout_s)
         # validate at admission: a malformed request must fail alone,
         # immediately — not poison a whole batch at dispatch time. The
         # determined-position bits (not the raw object) are what gets
@@ -490,6 +561,23 @@ class ContractionService:
         return self._enqueue(
             "amplitude", ("amplitude",), bitstring, timeout_s
         )
+
+    def _submit_approx(
+        self, base: str, payload, rtol, timeout_s: float | None
+    ) -> concurrent.futures.Future:
+        """Route a tolerant request to the approximate tier (its own
+        batching key per base kind — approx work never co-batches with
+        exact work)."""
+        router = self._handlers.get(APPROX_KIND)
+        if router is None:
+            raise ValueError(
+                "rtol= routes to the approximate tier; attach it first "
+                "(from_circuit(approx=True) / enable_approx)"
+            )
+        payload, key = router.validate(
+            {"kind": base, "payload": payload, "rtol": rtol}
+        )
+        return self._enqueue(APPROX_KIND, tuple(key), payload, timeout_s)
 
     def submit_query(
         self, kind: str, payload, timeout_s: float | None = None
@@ -520,18 +608,27 @@ class ContractionService:
         )
 
     def submit_expectation(
-        self, terms, timeout_s: float | None = None
+        self, terms, timeout_s: float | None = None,
+        rtol: float | None = None,
     ) -> concurrent.futures.Future:
         """⟨ψ|P|ψ⟩ (a Pauli string) or a Pauli sum (iterable of
         ``(coeff, pauli)``); the future resolves to the complex
-        value. Terms batch through one sandwich structure."""
+        value. Terms batch through one sandwich structure. ``rtol``
+        routes to the approximate tier (an :class:`ApproxAnswer`)."""
+        if rtol is not None:
+            return self._submit_approx("expectation", terms, rtol, timeout_s)
         return self.submit_query("expectation", terms, timeout_s)
 
     def submit_marginal(
-        self, pattern, timeout_s: float | None = None
+        self, pattern, timeout_s: float | None = None,
+        rtol: float | None = None,
     ) -> concurrent.futures.Future:
         """Marginal probability of ``pattern``'s determined bits
-        (``'*'`` = marginalized); the future resolves to a float."""
+        (``'*'`` = marginalized); the future resolves to a float.
+        ``rtol`` routes to the approximate tier (an
+        :class:`ApproxAnswer`)."""
+        if rtol is not None:
+            return self._submit_approx("marginal", pattern, rtol, timeout_s)
         return self.submit_query("marginal", pattern, timeout_s)
 
     def sample(self, n_samples: int = 1, seed=None,
@@ -541,22 +638,31 @@ class ContractionService:
             timeout=None if timeout_s is None else float(timeout_s) + 60.0
         )
 
-    def expectation(self, terms, timeout_s: float | None = None) -> complex:
+    def expectation(
+        self, terms, timeout_s: float | None = None,
+        rtol: float | None = None,
+    ) -> complex:
         """Blocking :meth:`submit_expectation`."""
-        return self.submit_expectation(terms, timeout_s).result(
+        return self.submit_expectation(terms, timeout_s, rtol=rtol).result(
             timeout=None if timeout_s is None else float(timeout_s) + 60.0
         )
 
-    def marginal(self, pattern, timeout_s: float | None = None) -> float:
+    def marginal(
+        self, pattern, timeout_s: float | None = None,
+        rtol: float | None = None,
+    ) -> float:
         """Blocking :meth:`submit_marginal`."""
-        return self.submit_marginal(pattern, timeout_s).result(
+        return self.submit_marginal(pattern, timeout_s, rtol=rtol).result(
             timeout=None if timeout_s is None else float(timeout_s) + 60.0
         )
 
-    def amplitude(self, bitstring, timeout_s: float | None = None):
+    def amplitude(
+        self, bitstring, timeout_s: float | None = None,
+        rtol: float | None = None,
+    ):
         """Blocking single-amplitude query (deadline doubles as the
         caller-side wait bound)."""
-        fut = self.submit(bitstring, timeout_s)
+        fut = self.submit(bitstring, timeout_s, rtol=rtol)
         return fut.result(
             timeout=None if timeout_s is None else float(timeout_s) + 60.0
         )
@@ -756,6 +862,7 @@ class ContractionService:
             return
         done = time.monotonic()
         dispatch_s = done - t0
+        self._note_dispatch(kind, dispatch_s)
         self._slo_dispatch(kind, len(group), dispatch_s, bound)
         for req, result in zip(group, results):
             if self._complete(req, result=result):
@@ -795,6 +902,7 @@ class ContractionService:
                     self._trace_request(req, "failed", degraded=True)
                 continue
             done = time.monotonic()
+            self._note_dispatch(req.kind, done - t0)
             self._slo_dispatch(req.kind, 1, done - t0, bound)
             if self._complete(req, result=results[0]):
                 self._finish(
@@ -816,11 +924,15 @@ class ContractionService:
         obs.counter_add("serve.requests.completed")
         obs.counter_add("serve.query.completed", type=req.kind)
         latency = done - req.t_submit
+        tier = tier_of(req.kind)
         with self._lock:
             self._latencies.observe(latency)
             self._latencies_by_type[req.kind].observe(latency)
+            self._ensure_tier(tier)
+            self._latencies_by_tier[tier].observe(latency)
         obs.observe("serve.latency_s", latency)
         obs.observe("serve.query.latency_s", latency, type=req.kind)
+        obs.observe("serve.tier.latency_s", latency, tier=tier)
         timeline = None
         if self._slo is not None or obs.enabled():
             timeline = self._timeline(
@@ -951,6 +1063,9 @@ class ContractionService:
         "cancelled", "batches",
     )
 
+    # per-tier rows additionally audit the escalation ladder
+    _TIER_KEYS = _TYPE_KEYS + ("escalated", "escalation_capped")
+
     def _ensure_type(self, kind: str) -> dict:
         """Per-type accounting row (callers hold no lock; dict writes
         are guarded by ``_lock`` in the callers that mutate)."""
@@ -961,13 +1076,48 @@ class ContractionService:
             self._latencies_by_type[kind] = QuantileSummary()
         return row
 
+    def _ensure_tier(self, tier: str) -> dict:
+        row = self._by_tier.get(tier)
+        if row is None:
+            row = {k: 0 for k in self._TIER_KEYS}
+            self._by_tier[tier] = row
+            self._latencies_by_tier[tier] = QuantileSummary()
+            self._tier_dispatch[tier] = [0, 0.0]  # dispatches, seconds
+        return row
+
     def _count(self, key: str) -> None:
         with self._lock:
             self._counts[key] += 1
 
     def _count_type(self, kind: str, key: str) -> None:
+        tier = tier_of(kind)
         with self._lock:
             self._ensure_type(kind)[key] += 1
+            self._ensure_tier(tier)[key] += 1
+        obs.counter_add(f"serve.tier.{key}", tier=tier)
+
+    def _note_dispatch(self, kind: str, dispatch_s: float) -> None:
+        """Measured dispatch seconds, accumulated per tier — next to
+        the router's predicted rung seconds this is the bench's
+        predicted-vs-measured per-tier surface."""
+        tier = tier_of(kind)
+        with self._lock:
+            row = self._tier_dispatch[tier]
+            row[0] += 1
+            row[1] += dispatch_s
+        obs.observe("serve.tier.dispatch_s", dispatch_s, tier=tier)
+
+    def note_escalation(self, base: str, capped: bool = False) -> None:
+        """The router's escalation audit hook: counted per tier and as
+        ``serve.tier.escalated`` / ``serve.tier.escalation_capped``
+        (``capped`` = the escalation budget was exhausted and the
+        approx answer was served with ``tolerance_met=False``)."""
+        key = "escalation_capped" if capped else "escalated"
+        with self._lock:
+            self._ensure_tier("approx")[key] += 1
+        # tier= keeps the serve.tier.* namespace queryable by its
+        # established label; kind= adds the per-base-kind breakdown
+        obs.counter_add(f"serve.tier.{key}", tier="approx", kind=base)
 
     def reset_stats(self) -> None:
         """Zero the in-memory counts and samples — benchmarks call this
@@ -982,6 +1132,17 @@ class ContractionService:
                 for key in row:
                     row[key] = 0
                 self._latencies_by_type[kind] = QuantileSummary()
+            for tier, row in self._by_tier.items():
+                for key in row:
+                    row[key] = 0
+                self._latencies_by_tier[tier] = QuantileSummary()
+                self._tier_dispatch[tier] = [0, 0.0]
+        # the router's escalation audit (and its max_escalations
+        # budget) covers the same measurement window as the tier rows
+        # — resetting one without the other would leave the two
+        # escalation numbers in stats() contradicting each other
+        if self._router is not None:
+            self._router.reset()
 
     @staticmethod
     def _latency_block(summary: QuantileSummary) -> dict:
@@ -1000,9 +1161,11 @@ class ContractionService:
         """Snapshot for dashboards and ``bench.py --serve``: request
         counts, batch-size distribution, latency percentiles, the
         per-query-type breakdown (``by_type``: one row per kind with
-        request/batch counts and latency percentiles), and — with an
-        SLO engine attached — the ``slo`` block (burn rates, drift,
-        firing alerts)."""
+        request/batch counts and latency percentiles), the
+        per-fidelity-tier breakdown (``by_tier``: exact vs approx
+        counts — escalations included — latency percentiles, and
+        measured dispatch seconds), and — with an SLO engine attached
+        — the ``slo`` block (burn rates, drift, firing alerts)."""
         # percentile blocks are computed UNDER the lock: the summaries
         # are live objects the dispatcher observes into, and a block
         # must be internally consistent (count vs quantiles)
@@ -1019,6 +1182,26 @@ class ContractionService:
                 }
                 for kind, row in self._by_type.items()
             }
+            by_tier = {
+                tier: {
+                    "counts": dict(row),
+                    "latency_s": self._latency_block(
+                        self._latencies_by_tier[tier]
+                    ),
+                    "dispatch": {
+                        "count": self._tier_dispatch[tier][0],
+                        "total_s": round(self._tier_dispatch[tier][1], 6),
+                        "mean_s": round(
+                            self._tier_dispatch[tier][1]
+                            / max(self._tier_dispatch[tier][0], 1),
+                            6,
+                        ),
+                    },
+                }
+                for tier, row in self._by_tier.items()
+            }
+        if self._router is not None:
+            by_tier["approx"]["router"] = self._router.describe()
         out = {
             "counts": counts,
             "batch_size": {
@@ -1029,6 +1212,7 @@ class ContractionService:
             },
             "latency_s": latency,
             "by_type": by_type,
+            "by_tier": by_tier,
         }
         if self._slo is not None:
             out["slo"] = self._slo.stats()
@@ -1141,4 +1325,358 @@ class ContractionService:
                         )
                     )
             summary("serve.type_latency_seconds", {"type": kind}, block, total)
+        with self._lock:
+            tier_rows = {t: dict(r) for t, r in self._by_tier.items()}
+        for tier, row in tier_rows.items():
+            for key, value in row.items():
+                if key == "batches":
+                    fams.append(
+                        ("counter", "serve.tier_batches", {"tier": tier},
+                         value)
+                    )
+                else:
+                    fams.append(
+                        (
+                            "counter", "serve.tier_requests",
+                            {"tier": tier, "outcome": key}, value,
+                        )
+                    )
         return fams
+
+
+@dataclass(frozen=True)
+class ApproxAnswer:
+    """What an ``rtol=`` request resolves to: the value with an honest
+    per-answer error estimate.
+
+    ``err`` bounds ``|value − exact|`` (the chi-ladder's estimate, or
+    a pure roundoff margin for escalated/untruncated answers);
+    ``chi_used`` is the converged rung's bond dimension (None for an
+    escalated exact answer); ``tolerance_met`` is False only when the
+    escalation budget was exhausted and the best approximate answer was
+    served anyway; ``sweeps`` counts the ladder rungs executed."""
+
+    value: complex
+    err: float
+    chi_used: int | None
+    escalated: bool = False
+    tolerance_met: bool = True
+    sweeps: int = 0
+
+
+class FidelityRouter:
+    """Routes tolerant requests onto the boundary-MPS chi-ladder tier
+    and escalates tolerance misses to the exact pipeline.
+
+    Registered as the ``"approx"`` query handler: ``validate`` checks
+    the payload per base kind (amplitude / expectation / marginal) and
+    assigns the ``(approx, base)`` batching key — approx work shares
+    the queue but never a batch with exact work; ``dispatch`` runs the
+    :class:`~tnc_tpu.approx.ladder.ChiLadder` per request against the
+    structure-shared :class:`~tnc_tpu.approx.program.ApproxProgram`
+    grids (amplitude grid for amplitudes, ONE sandwich grid for
+    expectation and marginal — per-request payloads are leaf-data
+    rebinds).
+
+    A ladder that cannot meet the requested tolerance **escalates**:
+    the request is re-answered by the exact pipeline (the service's
+    bound program / registered query handlers), counted per tier and
+    as ``serve.tier.escalated``, under a ``serve.escalate`` span, and
+    capped at ``max_escalations`` — past the cap the approximate
+    answer is served with ``tolerance_met=False`` (the error estimate
+    stays honest either way).
+
+    ``cost_model`` (a :class:`~tnc_tpu.obs.calibrate.
+    CalibratedCostModel`) prices every ladder rung in predicted
+    seconds (:mod:`tnc_tpu.approx.cost`), so :meth:`describe` quotes
+    approximate-tier latency exactly like exact plans.
+    """
+
+    kind = APPROX_KIND
+    #: ladder work varies with rtol and payload, not batch size — the
+    #: SLO drift detector must not bucket it by batch shape
+    drift_stable = False
+
+    BASES = ("amplitude", "expectation", "marginal")
+
+    #: tolerance scale per base kind: the tolerance is relative to
+    #: ``max(|value|, scale)`` — an amplitude's natural magnitude is
+    #: ``2^(-n/2)``, expectation values and probabilities are O(1)
+    _UNIT_SCALE = 1.0
+
+    def __init__(
+        self,
+        service: "ContractionService",
+        circuit,
+        chis=None,
+        chi_start: int = 2,
+        chi_cap: int = 64,
+        safety: float = 4.0,
+        max_escalations: int = 256,
+        cost_model=None,
+    ) -> None:
+        from tnc_tpu.approx import ApproxProgram, ChiLadder
+
+        self._service = service
+        self._circuit = circuit.copy()
+        self.num_qubits = self._circuit.num_qubits()
+        self.ladder = ChiLadder(
+            chis=chis, chi_start=chi_start, chi_cap=chi_cap, safety=safety
+        )
+        self.cost_model = (
+            cost_model if cost_model is not None else service.cost_model
+        )
+        self.max_escalations = int(max_escalations)
+        self.escalations = 0
+        self.escalations_capped = 0
+        self._programs: dict[str, object] = {}
+        self._exact_programs: dict[str, object] = {}
+        # build the amplitude grid eagerly: a circuit the tier cannot
+        # flatten (non-nearest-neighbour) must fail at attach time, not
+        # on the first tolerant request
+        self._programs["amplitude"] = ApproxProgram.from_circuit(
+            self._circuit
+        )
+
+    # -- programs ----------------------------------------------------------
+
+    def program(self, base: str):
+        """The grid program serving ``base`` (expectation and marginal
+        share the sandwich grid)."""
+        from tnc_tpu.approx import ApproxProgram
+
+        key = "amplitude" if base == "amplitude" else "sandwich"
+        prog = self._programs.get(key)
+        if prog is None:
+            prog = ApproxProgram.sandwich_from_circuit(self._circuit)
+            self._programs[key] = prog
+        return prog
+
+    def _scale(self, base: str) -> float:
+        if base == "amplitude":
+            return 2.0 ** (-self.num_qubits / 2.0)
+        return self._UNIT_SCALE
+
+    # -- handler protocol --------------------------------------------------
+
+    def validate(self, payload) -> tuple[dict, tuple]:
+        from tnc_tpu.builders.circuit_builder import normalize_bitstring
+
+        payload = dict(payload)
+        base = payload.get("kind")
+        if base not in self.BASES:
+            raise ValueError(
+                f"approx tier serves {self.BASES}, not {base!r}"
+            )
+        rtol = float(payload.get("rtol", 0.0))
+        if not rtol > 0.0:
+            raise ValueError(f"rtol must be > 0, got {rtol}")
+        raw = payload.get("payload")
+        if base == "amplitude":
+            bits = normalize_bitstring(raw, self.num_qubits)
+            if "*" in bits:
+                raise ValueError(
+                    "approx amplitude requests must be fully determined "
+                    "(no '*' positions)"
+                )
+            validated = bits
+        elif base == "expectation":
+            from tnc_tpu.queries.expectation import normalize_terms
+
+            validated = normalize_terms(raw, self.num_qubits)
+        else:
+            validated = normalize_bitstring(raw, self.num_qubits)
+        return (
+            {"kind": base, "payload": validated, "rtol": rtol},
+            (self.kind, base),
+        )
+
+    def dispatch(self, payloads, backend) -> list:
+        name = "jax" if isinstance(backend, JaxBackend) else "numpy"
+        with obs.span(
+            "serve.handler", type=self.kind, batch=len(payloads)
+        ):
+            return [self._one(p, backend, name) for p in payloads]
+
+    # -- the ladder + escalation path --------------------------------------
+
+    def _one(self, payload: dict, backend, backend_name: str) -> ApproxAnswer:
+        base = payload["kind"]
+        raw = payload["payload"]
+        rtol = payload["rtol"]
+        if base == "expectation":
+            return self._one_expectation(raw, rtol, backend, backend_name)
+        prog = self.program(base)
+        if base == "amplitude":
+            prog.rebind_bits(raw)
+        else:
+            prog.rebind_projectors(raw)
+        res = self.ladder.run(
+            prog, rtol, scale=self._scale(base), backend=backend_name,
+            cost_model=self.cost_model,
+        )
+        value = res.value if base != "marginal" else res.value.real
+        if res.converged:
+            return ApproxAnswer(
+                value, res.err, res.chi_used, sweeps=res.sweeps
+            )
+        return self._escalate(
+            base, raw, value, res.err, res.chi_used, res.sweeps, backend,
+            rtol,
+        )
+
+    def _one_expectation(
+        self, terms, rtol: float, backend, backend_name: str
+    ) -> ApproxAnswer:
+        """A Pauli sum rides one sandwich grid: one ladder climb per
+        UNIQUE Pauli string, coefficient-weighted combination, summed
+        error bars."""
+        prog = self.program("expectation")
+        unique: dict[str, object] = {}
+        for _c, pauli in terms:
+            if pauli not in unique:
+                prog.rebind_pauli(pauli)
+                unique[pauli] = self.ladder.run(
+                    prog, rtol, scale=self._scale("expectation"),
+                    backend=backend_name, cost_model=self.cost_model,
+                )
+        value = complex(
+            sum(c * unique[p].value for c, p in terms)
+        )
+        err = float(sum(abs(c) * unique[p].err for c, p in terms))
+        chi_used = max(r.chi_used for r in unique.values())
+        sweeps = sum(r.sweeps for r in unique.values())
+        converged = all(r.converged for r in unique.values()) and (
+            err <= rtol * max(abs(value), self._UNIT_SCALE)
+        )
+        if converged:
+            return ApproxAnswer(value, err, chi_used, sweeps=sweeps)
+        return self._escalate(
+            "expectation", terms, value, err, chi_used, sweeps, backend,
+            rtol,
+        )
+
+    def _escalate(
+        self, base, raw, value, err, chi_used, sweeps, backend, rtol
+    ) -> ApproxAnswer:
+        if self.escalations >= self.max_escalations:
+            self.escalations_capped += 1
+            self._service.note_escalation(base, capped=True)
+            logger.warning(
+                "approx %s miss (err=%.3g > rtol=%.3g) but the "
+                "escalation budget (%d) is exhausted; serving the "
+                "approximate answer", base, err, rtol,
+                self.max_escalations,
+            )
+            return ApproxAnswer(
+                value, err, chi_used, tolerance_met=False, sweeps=sweeps
+            )
+        self.escalations += 1
+        self._service.note_escalation(base)
+        with obs.span("serve.escalate", kind=base, rtol=rtol):
+            exact = self._exact_value(base, raw, backend)
+        from tnc_tpu.approx.ladder import COMPLEX64_ERR_REL, EXACT_ERR_REL
+
+        # the escalated answer's bar is the EXACT pipeline's roundoff
+        # floor — which is single-precision-sized when the service
+        # backend dispatches in complex64
+        floor = EXACT_ERR_REL
+        if isinstance(backend, JaxBackend) and np.dtype(
+            getattr(backend, "dtype", np.complex128)
+        ) == np.complex64:
+            floor = COMPLEX64_ERR_REL
+        return ApproxAnswer(
+            exact,
+            floor * max(abs(exact), self._scale(base)),
+            None,
+            escalated=True,
+            sweeps=sweeps,
+        )
+
+    def _exact_value(self, base: str, raw, backend):
+        """The exact pipeline's answer for an escalated request —
+        through the service's registered query handler when present
+        (shared plan cache), else through a lazily-bound exact program
+        of the router's own circuit copy."""
+        if base == "amplitude":
+            return complex(
+                self._service.bound.amplitudes([raw], backend)[0]
+            )
+        handler = self._service._handlers.get(base)
+        if handler is not None:
+            return handler.dispatch([raw], backend)[0]
+        if base == "expectation":
+            prog = self._exact_programs.get("expectation")
+            if prog is None:
+                from tnc_tpu.queries.expectation import bind_expectation
+
+                prog = bind_expectation(self._circuit.copy())
+                self._exact_programs["expectation"] = prog
+            unique = sorted({p for _c, p in raw})
+            vals = dict(zip(unique, prog.values(unique, backend)))
+            return complex(sum(c * vals[p] for c, p in raw))
+        from tnc_tpu.queries.marginal import (
+            bind_marginal,
+            marginal_probabilities,
+            wildcard_mask,
+        )
+
+        mask = wildcard_mask(raw)
+        bound = self._exact_programs.get(("marginal", mask))
+        if bound is None:
+            bound = bind_marginal(self._circuit.copy(), mask)
+            self._exact_programs[("marginal", mask)] = bound
+        return float(
+            np.asarray(marginal_probabilities(bound, [raw], backend))[0]
+        )
+
+    def reset(self) -> None:
+        """Zero the escalation audit (and re-arm the budget) — called
+        by :meth:`ContractionService.reset_stats` so the router's
+        numbers always describe the same window as the tier rows."""
+        self.escalations = 0
+        self.escalations_capped = 0
+
+    # -- quoting -----------------------------------------------------------
+
+    def quote_seconds(self, base: str = "amplitude") -> float | None:
+        """Predicted seconds of a full ladder climb for ``base`` under
+        the calibrated cost model (None without one) — the admission-
+        control quote for the approximate tier, the exact analogue of
+        pricing a plan's steps through the same model."""
+        if self.cost_model is None:
+            return None
+        from tnc_tpu.approx.cost import ladder_seconds
+
+        prog = self.program(base)
+        return ladder_seconds(
+            prog, self.ladder.rungs_for(prog), self.cost_model
+        )
+
+    def describe(self) -> dict:
+        """Router posture for ``stats()["by_tier"]["approx"]``:
+        escalation budget audit + per-base-kind rung schedule and
+        latency quotes."""
+        out = {
+            "escalations": self.escalations,
+            "escalations_capped": self.escalations_capped,
+            "max_escalations": self.max_escalations,
+            "rungs": {},
+            "quote_s": {},
+        }
+        for base in ("amplitude", "sandwich"):
+            prog = self._programs.get(base)
+            if prog is None:
+                continue
+            out["rungs"][base] = list(self.ladder.rungs_for(prog))
+            quote = (
+                self.quote_seconds(
+                    "amplitude" if base == "amplitude" else "marginal"
+                )
+                if self.cost_model is not None
+                else None
+            )
+            out["quote_s"][base] = (
+                round(quote, 6) if quote is not None else None
+            )
+        return out
