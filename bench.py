@@ -470,7 +470,10 @@ def bench_sycamore_amplitude():
         or _tuned_default(
             "complex_mult",
             "auto",
-            ("naive", "gauss", "fused", "strassen", "chain", "auto"),
+            (
+                "naive", "gauss", "fused", "fused_transpose", "strassen",
+                "chain", "auto",
+            ),
         )
     )
     if complex_mult != "auto":
@@ -634,7 +637,9 @@ def bench_sycamore_amplitude():
             + ", ".join(
                 f"{name}: {b['steps']} steps "
                 f"{b['effective_flops'] / max(b['flops'], 1e-30):.2f}x credit "
-                f"({'/'.join(sorted(b['modes']))})"
+                f"{b['pred_bytes_planned'] / max(b['pred_bytes_naive'], 1e-30):.2f}x bytes "
+                f"({'/'.join(sorted(b['modes']))}; "
+                f"prec {'/'.join(sorted(b['precision']))})"
                 for name, b in sorted(kplan["buckets"].items())
             )
         )
@@ -1244,6 +1249,23 @@ def _maybe_trace(backend, sp, arrays, probe, extra):
         log(f"[bench] profiler trace unavailable: {type(e).__name__}: {e}")
 
 
+def _attach_kernel_plan(extra: dict, program, backend) -> None:
+    """Static kernel-plan block for single-program configs: per-bucket
+    modes, dot-precision mix, credited flops, and predicted HBM bytes
+    under naive vs planned modes — the surface
+    ``scripts/perf_gate.py``'s planned≤naive bytes invariant checks on
+    EVERY record, including the CPU smoke in check.sh. Best-effort:
+    reporting must never fail a run."""
+    try:
+        from tnc_tpu.ops.split_complex import kernel_plan_summary
+
+        extra["kernel_plan"] = kernel_plan_summary(
+            program, backend.kernel_policy(program)
+        )
+    except Exception as e:  # noqa: BLE001 — reporting only
+        log(f"[bench] kernel plan unavailable: {type(e).__name__}: {e}")
+
+
 def bench_ghz3():
     """Config #1: 3-qubit GHZ statevector from QASM (README example)."""
     from tnc_tpu.contractionpath.paths import Greedy, OptMethod
@@ -1275,6 +1297,7 @@ def bench_ghz3():
         calibration_run=lambda: cpu.execute(program, arrays),
     )
     extra = {"timing": "pipelined-steady-state", "pipeline_calls": calls}
+    _attach_kernel_plan(extra, program, backend)
     return ("ghz3_statevector_wallclock", tpu_s,
             cpu_s / tpu_s if tpu_s else 0.0, extra)
 
@@ -1313,6 +1336,7 @@ def bench_random20():
         calibration_run=lambda: cpu.execute(program, arrays),
     )
     extra = {"timing": "pipelined-steady-state", "pipeline_calls": calls}
+    _attach_kernel_plan(extra, program, backend)
     return ("random20_d12_statevector_wallclock", tpu_s,
             cpu_s / tpu_s if tpu_s else 0.0, extra)
 
@@ -1363,6 +1387,7 @@ def bench_qaoa30():
         calibration_run=lambda: cpu.execute(program, arrays),
     )
     extra = {"timing": "pipelined-steady-state", "pipeline_calls": calls}
+    _attach_kernel_plan(extra, program, backend)
     return (f"qaoa{qubits}_expectation_wallclock", tpu_s,
             cpu_s / tpu_s if tpu_s else 0.0, extra)
 
@@ -2220,7 +2245,9 @@ def _kernel_buckets_from_spans(obs) -> dict:
                 "seconds": 0.0,
                 "flops": 0.0,
                 "effective_flops": 0.0,
+                "bytes": 0.0,
                 "modes": {},
+                "precision": {},
             },
         )
         b["spans"] += 1
@@ -2228,16 +2255,25 @@ def _kernel_buckets_from_spans(obs) -> dict:
         flops = float(r.args.get("flops", 0.0))
         b["flops"] += flops
         b["effective_flops"] += float(r.args.get("flops_effective", flops))
+        b["bytes"] += float(r.args.get("bytes_in", 0.0)) + float(
+            r.args.get("bytes_out", 0.0)
+        )
         mode = str(r.args.get("mode", "default"))
         b["modes"][mode] = b["modes"].get(mode, 0) + 1
+        # the dot-precision rung the step ran under — annotated so a
+        # bucket's MFU row says whether bf16x3 was in play
+        rung = str(r.args.get("precision", "default"))
+        b["precision"][rung] = b["precision"].get(rung, 0) + 1
     for b in buckets.values():
         secs = b["seconds"]
         b["seconds"] = float(f"{secs:.4e}")
         b["flops"] = float(f"{b['flops']:.4e}")
         b["effective_flops"] = float(f"{b['effective_flops']:.4e}")
+        b["bytes"] = float(f"{b['bytes']:.4e}")
         if secs > 0.0:
             achieved = b["effective_flops"] / secs
             b["achieved_flops_per_s"] = float(f"{achieved:.4e}")
+            b["achieved_bytes_per_s"] = float(f"{b['bytes'] / secs:.4e}")
             if peak:
                 b["mfu"] = round(achieved / peak, 4)
     return {"source": source, "buckets": buckets}

@@ -98,6 +98,12 @@ python scripts/distributed_smoke.py
 echo "== fused-chain smoke (multi-step Pallas kernel, interpret mode: dispatch spans drop) =="
 TNC_TPU_PLATFORM=cpu python scripts/chain_smoke.py
 
+echo "== fused-transpose kernel smoke (predicted HBM bytes drop, zero fallbacks, bit parity) =="
+TNC_TPU_PLATFORM=cpu python scripts/kernel_smoke.py
+
+echo "== precision parity smoke (emulated bf16x3 vs float64 split oracle, per-bucket rtol rungs) =="
+TNC_TPU_PLATFORM=cpu python scripts/precision_parity_smoke.py
+
 echo "== examples =="
 # TNC_TPU_PLATFORM pins JAX to CPU via jax.config (env vars alone can be
 # overridden by interpreter startup hooks that pre-wire an accelerator);

@@ -20,6 +20,9 @@
 #       its own prewarmed oracle; skipped if the prewarm hasn't cached
 #       at least 2 oracle slices
 #   1e. (only if 1d passes parity) full-measured 2^30 capture
+#   1f. fused-transpose rung A/B — the bandwidth kernel
+#       (TNC_TPU_COMPLEX_MULT=fused_transpose) on a 256-slice subset
+#       with parity; 1g full capture + promotion on pass
 #       Every promotion merges into .cache/best_config.json, so each
 #       later stage measures the BEST-SO-FAR combination — promoted
 #       configs compose, and the final record is always a measured
@@ -250,6 +253,30 @@ if [ "$p30" -ge 2 ]; then
   fi
 else
   echo "2^30 oracle not prewarmed ($p30 slices); skipping the target ladder"
+fi
+
+require_tunnel "1f"
+echo "== 1f. fused-transpose rung: bandwidth A/B (256-slice subset, WITH parity) =="
+# the Pallas fused transpose-matmul deletes the materialized macro
+# transpose's HBM pass (kernel_smoke pins 0.62x predicted bytes on the
+# reference transpose-dominated step); this A/B measures whether the
+# deleted pass shows up as wall-clock on this libtpu. Forced mode —
+# ineligible steps fall back counted (kernel_counters in the record).
+TNC_TPU_COMPLEX_MULT=fused_transpose BENCH_MAX_SLICES=256 BENCH_REPS=1 \
+  BENCH_TRACE=0 BENCH_NO_RETRY=1 timeout 1800 python bench.py \
+  > "$out/bench_fused_t.json" 2> "$out/bench_fused_t.log"
+echo "rc=$? $(cat "$out/bench_fused_t.json" 2>/dev/null | tail -1)"
+ft_verdict=$(record_verdict "$out/bench_fused_t.json")
+if [ "$ft_verdict" = "ok" ]; then
+  echo "== 1g. full-measured fused-transpose capture (promotion candidate) =="
+  TNC_TPU_COMPLEX_MULT=fused_transpose BENCH_NO_RETRY=1 \
+    timeout 3600 python bench.py \
+    > "$out/bench_fused_t_full.json" 2> "$out/bench_fused_t_full.log"
+  echo "rc=$? $(cat "$out/bench_fused_t_full.json" 2>/dev/null | tail -1)"
+  promote "$out/bench_fused_t_full.json" '{"complex_mult": "fused_transpose"}' \
+    && echo "fused_transpose promoted"
+else
+  echo "fused_transpose NOT promoted (verdict: $ft_verdict); ladder stays auto"
 fi
 
 require_tunnel "2"

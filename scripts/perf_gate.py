@@ -22,6 +22,16 @@ failures, unless ``--strict``):
   credited MFU (or achieved FLOP/s) per bucket, so a regression in ONE
   kernel rung (a chain that stopped fusing, a Strassen step that fell
   back) is localized even when the headline wall-clock hides it;
+  measured device MFU is additionally held to the per-bucket target
+  table (``BUCKET_MFU_TARGETS`` — the v5e capture's floor, warn-only
+  unless ``--strict``);
+- the kernel plan's predicted HBM bytes (``kernel_plan.buckets``) —
+  a HARD failure (exit 1) when a bucket containing transpose-carrying
+  steps predicts MORE bytes under the planned modes than under the
+  naive prep+dot path: the fused-transpose rung can only delete the
+  materialized transpose pass, so ``planned > naive`` means the bytes
+  accounting (or the rung's eligibility) regressed. Candidate-only
+  (static, CPU-computable), so every check.sh run enforces it;
 - the distributed fan-in block (``distributed.fanin_wall_s`` /
   ``distributed.dispatch_overlap_ratio``) — a reduce phase that got
   slower, or a level schedule that collapsed back toward a serial
@@ -54,6 +64,20 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+
+#: Per-bucket MFU floors for *measured device* runs (effective-flop
+#: credited — see docs/running_on_tpu.md "Per-bucket MFU"). Anchored on
+#: the r04 v5e capture: 0.22 headline at naive, the stem bucket is pure
+#: big GEMM so it must carry at least the headline, medium within 1.5×
+#: of it; ``small`` is dispatch-bound by definition — judged by
+#: dispatch count (chain fusion), never by MFU, hence no target.
+#: Warn-only unless --strict: CPU records carry no ``mfu`` field and
+#: skip the table entirely.
+BUCKET_MFU_TARGETS: dict[str, float | None] = {
+    "stem": 0.22,
+    "medium": 0.15,
+    "small": None,
+}
 
 
 def load_record(path: str) -> dict:
@@ -283,6 +307,55 @@ def compare(
                         f"dropped {bv / cv:.2f}x ({bv:.3g} -> {cv:.3g})"
                     )
                 break  # one metric per bucket: mfu preferred
+
+    # per-bucket MFU target table: a measured device bucket below its
+    # documented floor is flagged even when baseline and candidate
+    # regressed together (the ratio check above can't see that)
+    for bucket, target in sorted(BUCKET_MFU_TARGETS.items()):
+        if target is None:
+            continue
+        mfu = (ckb.get(bucket) or {}).get("mfu")
+        if mfu and float(mfu) < target:
+            msgs.append(
+                f"warning: kernel bucket '{bucket}' MFU {float(mfu):.3f} "
+                f"below the {target:.2f} target "
+                f"(precision mix: {(ckb.get(bucket) or {}).get('precision')})"
+            )
+
+    # predicted-HBM-bytes invariant (HARD check, candidate-only): on a
+    # bucket with transpose-carrying steps the planned modes must never
+    # predict MORE traffic than the naive prep+dot path — the fused
+    # transpose rung deletes a pass, it cannot add one; planned > naive
+    # means the bytes accounting or the rung's gating regressed
+    ckp = (cand.get("kernel_plan") or {}).get("buckets") or {}
+    bkp = (base.get("kernel_plan") or {}).get("buckets") or {}
+    for bucket in sorted(ckp):
+        row = ckp[bucket] or {}
+        planned = row.get("pred_bytes_planned")
+        naive = row.get("pred_bytes_naive")
+        if not (planned and naive):
+            continue
+        if (row.get("transpose_steps") or 0) > 0 and float(planned) > float(
+            naive
+        ) * (1.0 + 1e-6):
+            verdict = 1
+            msgs.append(
+                f"REGRESSION: kernel bucket '{bucket}' predicts "
+                f"{float(planned):.4g} planned HBM bytes > "
+                f"{float(naive):.4g} naive on {row['transpose_steps']} "
+                "transpose-carrying steps (fused-transpose crediting "
+                "must only ever remove traffic)"
+            )
+        brow = bkp.get(bucket) or {}
+        bpps = brow.get("pred_bytes_per_step_planned")
+        cpps = row.get("pred_bytes_per_step_planned")
+        if bpps and cpps and float(cpps) > float(bpps) * 1.5:
+            msgs.append(
+                f"warning: kernel bucket '{bucket}' planned "
+                f"bytes-per-step grew {float(cpps) / float(bpps):.2f}x "
+                f"({float(bpps):.4g} -> {float(cpps):.4g}) — fused "
+                "transpose rung stopped engaging?"
+            )
     return verdict, msgs
 
 

@@ -222,12 +222,15 @@ def run_steps_timed(
     if split_complex:
         from tnc_tpu.ops.split_complex import apply_step_split
 
-        def kernel(a, b, st, mode=None):
-            return apply_step_split(xp, a, b, st, precision, mode=mode)
+        def kernel(a, b, st, mode=None, precision_mode=None):
+            return apply_step_split(
+                xp, a, b, st, precision, mode=mode,
+                precision_mode=precision_mode,
+            )
 
     else:
 
-        def kernel(a, b, st, mode=None):
+        def kernel(a, b, st, mode=None, precision_mode=None):
             return apply_step(xp, a, b, st)
 
     steps = program.steps
@@ -240,38 +243,59 @@ def run_steps_timed(
 
             group = steps[i:end]
             # HBM traffic of ONE fused dispatch: the head's two
-            # operands plus each link's non-carried operand in, the
+            # operands plus each link's non-carried operand in (PLUS
+            # their prep passes — non-carried operands with a macro
+            # transpose are materialized by prep_kl before entering
+            # the kernel, the same read+write step_prep_elems prices
+            # on single steps; only the CARRIED operand is
+            # transpose-free by chain_groups' admission rule), the
             # final result out — carried intermediates live in VMEM
             # and never touch HBM, so summing per-step elems would
             # overstate the chain's bytes and bias the calibration fit
             import math as _math
 
-            elems_in = float(
-                _math.prod(group[0].a_view) + _math.prod(group[0].b_view)
-            )
-            run_slot = group[0].lhs
+            def _op_elems(view, perm, ops):
+                prep = 2.0 if (perm is not None or ops) else 0.0
+                return (1.0 + prep) * float(_math.prod(view))
+
+            head = group[0]
+            elems_in = _op_elems(
+                head.a_view, head.a_perm, head.a_ops
+            ) + _op_elems(head.b_view, head.b_perm, head.b_ops)
+            run_slot = head.lhs
             for st in group[1:]:
-                view = st.b_view if st.lhs == run_slot else st.a_view
-                elems_in += float(_math.prod(view))
+                if st.lhs == run_slot:
+                    elems_in += _op_elems(st.b_view, st.b_perm, st.b_ops)
+                else:
+                    elems_in += _op_elems(st.a_view, st.a_perm, st.a_ops)
                 run_slot = st.lhs
+            chain_rung = policy.precision_mode(i) if policy else ""
             with obs.span(
                 f"step[{i}..{end - 1}] chain x{len(group)}",
                 executor=executor,
                 flops=sum(step_flops(st) for st in group),
                 bytes_in=elems_in * dtype_bytes,
                 bytes_out=step_elems(group[-1])[1] * dtype_bytes,
-                bucket="small",
+                # the calibrated chain ceiling can pull medium-bucket
+                # steps into a chain — report the heaviest member's
+                # bucket so the MFU rows stay honest
+                bucket=step_bucket(max(group, key=step_flops)),
                 mode="chain",
+                precision=chain_rung or "default",
                 flops_effective=sum(step_flops(st) for st in group),
                 steps=len(group),
             ):
-                out = run_chain_split(xp, group, buffers, precision)
+                out = run_chain_split(
+                    xp, group, buffers, precision,
+                    precision_mode=chain_rung,
+                )
                 if sync is not None:
                     sync(out)
             i = end
             continue
         step = steps[i]
         mode = policy.modes[i] if policy is not None else None
+        precision_mode = policy.precision_mode(i) if policy is not None else None
         # tag + credit the arithmetic that actually runs: without a
         # policy the split path executes the env default (gauss, 0.75x
         # credit), never 'naive'; the complex (non-split) path is the
@@ -279,7 +303,27 @@ def run_steps_timed(
         resolved = (
             resolved_step_mode(step, mode) if split_complex else "naive"
         )
-        elems_in, elems_out = step_elems(step)
+        if resolved == "fused_transpose":
+            # the static gate can't see the live buffers: share the
+            # kernel route's runtime dtype/batch predicate so spans
+            # never credit a transpose pass that was actually paid
+            # (kernel_error is the one remaining blind spot —
+            # abnormal and counted)
+            from tnc_tpu.ops.split_complex import (
+                fused_transpose_runtime_ineligible_reason,
+            )
+
+            if (
+                fused_transpose_runtime_ineligible_reason(
+                    buffers[step.lhs], buffers[step.rhs], step
+                )
+                is not None
+            ):
+                resolved = "naive"
+        # predicted traffic credits the prep pass the resolved kernel
+        # actually pays: fused_transpose streams the macro transpose
+        # inside the kernel, every other mode materializes it
+        elems_in, elems_out = step_elems(step, mode=resolved)
         with obs.span(
             step_label(i, step),
             executor=executor,
@@ -288,9 +332,13 @@ def run_steps_timed(
             bytes_out=elems_out * dtype_bytes,
             bucket=step_bucket(step),
             mode=resolved,
+            precision=(precision_mode or "default"),
             flops_effective=effective_step_flops(step, resolved),
         ):
-            out = kernel(buffers[step.lhs], buffers[step.rhs], step, mode)
+            out = kernel(
+                buffers[step.lhs], buffers[step.rhs], step, mode,
+                precision_mode,
+            )
             if sync is not None:
                 sync(out)
         buffers[step.lhs] = out
@@ -340,7 +388,7 @@ def jit_program(
     program are different executables."""
     import jax
 
-    from tnc_tpu.ops.split_complex import complex_mult_key
+    from tnc_tpu.ops.split_complex import complex_mult_key, dot_precision_key
 
     if not split_complex:
         precision = None  # only the split path consumes it: one cache key
@@ -352,6 +400,10 @@ def jit_program(
         donate,
         lanemix_env(),
         complex_mult_key() if split_complex else None,
+        # TNC_TPU_DOT_PRECISION is read at trace time (the per-step
+        # precision resolve), so forced and auto traces must not share
+        # an executable — complex_mult_key-style
+        dot_precision_key() if split_complex else None,
         batched,
         policy.signature() if policy is not None else None,
     )
@@ -654,9 +706,13 @@ class JaxBackend(Backend):
         arrive."""
         if not self.split_complex:
             return None
-        from tnc_tpu.ops.split_complex import complex_mult_key, plan_kernels
+        from tnc_tpu.ops.split_complex import (
+            complex_mult_key,
+            dot_precision_key,
+            plan_kernels,
+        )
 
-        key = (program.signature(), complex_mult_key())
+        key = (program.signature(), complex_mult_key(), dot_precision_key())
         policy = self._policy_cache.get(key)
         if policy is None:
             cost_model = None
@@ -766,13 +822,17 @@ class JaxBackend(Backend):
                     hoist=hoist,
                     slice_range=tuple(slice_range),
                 )
-            from tnc_tpu.ops.split_complex import complex_mult_key
+            from tnc_tpu.ops.split_complex import (
+                complex_mult_key,
+                dot_precision_key,
+            )
 
             key = (
                 "sliced_range", sp.signature(), str(self.dtype),
                 self.split_complex, tuple(slice_range), hoist,
                 lanemix_env(),
                 complex_mult_key() if self.split_complex else None,
+                dot_precision_key() if self.split_complex else None,
             )
             fn = self._cache.get(key)
             if fn is None:
@@ -816,7 +876,7 @@ class JaxBackend(Backend):
                 hoist=hoist,
             )
 
-        from tnc_tpu.ops.split_complex import complex_mult_key
+        from tnc_tpu.ops.split_complex import complex_mult_key, dot_precision_key
 
         key = (
             "sliced",
@@ -828,6 +888,7 @@ class JaxBackend(Backend):
             hoist,
             lanemix_env(),
             complex_mult_key() if self.split_complex else None,
+            dot_precision_key() if self.split_complex else None,
         )
         fn = self._cache.get(key)
         if fn is None:

@@ -136,7 +136,10 @@ def _run_chunk_split(
         end = chain_end.get(i)
         if end is not None:
             group = steps[i:end]
-            run_chain_split(xp, group, state, precision)
+            run_chain_split(
+                xp, group, state, precision,
+                precision_mode=policy.precision_mode(i),
+            )
             for st in group:
                 if state.get(st.rhs) is None:  # consumed by the chain
                     state.pop(st.rhs, None)
@@ -146,6 +149,9 @@ def _run_chunk_split(
         state[step.lhs] = apply_step_split(
             xp, state[step.lhs], state[step.rhs], step, precision,
             mode=policy.modes[i] if policy is not None else None,
+            precision_mode=(
+                policy.precision_mode(i) if policy is not None else None
+            ),
         )
         del state[step.rhs]
         i += 1
@@ -172,7 +178,7 @@ def _prelude_fn(hp, split_complex: bool, precision):
     import jax.numpy as jnp
 
     from tnc_tpu.ops.backends import lanemix_env
-    from tnc_tpu.ops.split_complex import complex_mult_key
+    from tnc_tpu.ops.split_complex import complex_mult_key, dot_precision_key
 
     key = (
         hp.signature(),
@@ -180,6 +186,7 @@ def _prelude_fn(hp, split_complex: bool, precision):
         precision,
         lanemix_env(),
         complex_mult_key() if split_complex else None,
+        dot_precision_key() if split_complex else None,
     )
     with _PLAN_CACHE_LOCK:
         fn = _PRELUDE_CACHE.get(key)
@@ -226,7 +233,7 @@ def _compiled_plan(
     import jax.numpy as jnp
 
     from tnc_tpu.ops.backends import lanemix_env
-    from tnc_tpu.ops.split_complex import complex_mult_key
+    from tnc_tpu.ops.split_complex import complex_mult_key, dot_precision_key
 
     key = (
         sp.signature(),
@@ -236,6 +243,7 @@ def _compiled_plan(
         precision,
         lanemix_env(),
         complex_mult_key() if split_complex else None,
+        dot_precision_key() if split_complex else None,
     )
     with _PLAN_CACHE_LOCK:
         hit = _PLAN_CACHE.get(key)

@@ -257,7 +257,14 @@ def run_prelude_steps(
     ``TNC_TPU_COMPLEX_MULT`` forcing override pins the mode — which is
     why the executors key their compiled-fn caches on
     :func:`tnc_tpu.ops.split_complex.complex_mult_key`, not the env
-    default."""
+    default. The dot-precision rung behaves the same way: a
+    ``TNC_TPU_DOT_PRECISION`` forcing override reaches every prelude
+    dot through ``apply_step_split``'s per-step resolve (the caches
+    key on :func:`tnc_tpu.ops.split_complex.dot_precision_key`); the
+    model-driven per-step promotion deliberately does NOT — like
+    :func:`~tnc_tpu.ops.split_complex.auto_step_mode`, an env-keyed
+    trace must never bake in a decision that flaps as calibration
+    evolves."""
     if split_complex:
         from tnc_tpu.ops.split_complex import apply_step_split, auto_step_mode
 

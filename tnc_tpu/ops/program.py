@@ -69,12 +69,51 @@ def step_flops(st) -> float:
     return float(k) * float(m) * float(n)
 
 
-def step_elems(st) -> tuple[float, float]:
-    """(elements read, elements written) by one step — the operands'
-    stored views in, the stored result out. Multiplied by the dtype
-    width this is the step's predicted HBM traffic, the bytes side of
-    the roofline next to :func:`step_flops`."""
+def step_prep_elems(st) -> float:
+    """Elements the step's operand *prep* moves through HBM on top of
+    the dot itself: a materialized macro transpose (or staged op plan)
+    reads the whole operand and writes the permuted copy — ``2 ×
+    view`` elements per permuted operand. Zero for identity preps
+    (reshape-only — layout-free on TPU). This is the pass the
+    ``fused_transpose`` kernel rung deletes
+    (:mod:`tnc_tpu.ops.pallas_complex`), and the traffic the original
+    ``steps_bytes`` under-predicted on transpose-dominated steps (the
+    r04 roofline misprediction).
+
+    >>> from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
+    >>> from tnc_tpu.contractionpath.contraction_path import ContractionPath
+    >>> tn = CompositeTensor([LeafTensor.from_const([0, 1], 4),
+    ...                       LeafTensor.from_const([1, 2], 4)])
+    >>> program = build_program(tn, ContractionPath.simple([(0, 1)]))
+    >>> step_prep_elems(program.steps[0])   # identity preps: no pass
+    0.0
+    """
+    extra = 0.0
+    for view, perm, ops in (
+        (st.a_view, st.a_perm, st.a_ops),
+        (st.b_view, st.b_perm, st.b_ops),
+    ):
+        if perm is not None or ops:
+            extra += 2.0 * float(math.prod(view))
+    return extra
+
+
+def step_elems(st, mode: str | None = None) -> tuple[float, float]:
+    """(elements read+moved, elements written) by one step — the
+    operands' stored views in plus the prep pass
+    (:func:`step_prep_elems`: a materialized macro transpose reads and
+    writes the operand again before the dot sees it), the stored
+    result out. Multiplied by the dtype width this is the step's
+    predicted HBM traffic, the bytes side of the roofline next to
+    :func:`step_flops`.
+
+    ``mode`` is the kernel-ladder mode that will run the step:
+    ``fused_transpose`` streams the permutation inside the kernel's
+    index maps, so its prediction drops the prep pass — the saved
+    traffic the spans and the roofline must credit."""
     elems_in = float(math.prod(st.a_view)) + float(math.prod(st.b_view))
+    if mode != "fused_transpose":
+        elems_in += step_prep_elems(st)
     return elems_in, float(math.prod(st.out_store))
 
 
@@ -113,12 +152,15 @@ def steps_flops(steps) -> float:
 
 
 def steps_bytes(steps, dtype_bytes: float = 16.0) -> float:
-    """Predicted HBM traffic of a step sequence: per step, operands read
+    """Predicted HBM traffic of a step sequence: per step, operands
+    read + the prep pass (a materialized macro transpose moves the
+    operand through HBM again — read + write; :func:`step_prep_elems`)
     + result written, times the element width (complex128 = 16 by
     default; the executors pass their actual width). The bytes
     counterpart of :func:`steps_flops` on the obs spans, so the
     calibration fit (:mod:`tnc_tpu.obs.calibrate`) sees both roofline
-    axes.
+    axes — including the transpose traffic it used to be blind to on
+    transpose-dominated steps.
 
     >>> from tnc_tpu.tensornetwork.tensor import CompositeTensor, LeafTensor
     >>> from tnc_tpu.contractionpath.contraction_path import ContractionPath
@@ -149,13 +191,16 @@ def chain_groups(
     value), the carried operand's prep is a pure row-major regroup
     (no macro transpose, no staged ops — the value must flow through
     VMEM as a reshape), and the whole run stays small: every step
-    strictly under the ``max_flops`` floor in the fused kernel's
+    strictly under the ``max_flops`` ceiling in the fused kernel's
     ``2*k*m*n`` units (default ``MIN_FLOPS`` — exactly the
-    dispatch-dominated steps the single-step kernel rejects AND the
+    dispatch-dominated steps the single-step kernel rejects and the
     ``small`` shape bucket of :func:`tnc_tpu.ops.split_complex.
-    step_bucket`, so every chained step provably reports in that
-    bucket) with all operands + intermediates summing under
-    ``max_elems`` float32 elements ((real, imag) pairs count double).
+    step_bucket`; :func:`tnc_tpu.ops.split_complex.plan_kernel_steps`
+    raises the ceiling with the calibrated ``dispatch_overhead_s``, so
+    chained steps can also come from the ``medium`` bucket when the
+    fitted model says they're still dispatch-bound) with all operands
+    + intermediates summing under ``max_elems`` float32 elements
+    ((real, imag) pairs count double).
 
     Returns ``(start, end)`` index spans, each covering ≥ 2 steps;
     steps outside every span dispatch individually.
@@ -179,7 +224,12 @@ def chain_groups(
         max_elems = float(CHAIN_MAX_ELEMS)
 
     def step_cost_elems(st) -> float:
-        elems_in, elems_out = step_elems(st)
+        # VMEM *residency* of the step's operands and result — NOT
+        # step_elems, whose total includes the HBM prep-pass traffic
+        # (step_prep_elems): counting that here would shrink chain
+        # admission for transpose-feeding steps for no footprint reason
+        elems_in = float(math.prod(st.a_view)) + float(math.prod(st.b_view))
+        elems_out = float(math.prod(st.out_store))
         return 2.0 * (elems_in + elems_out)  # (real, imag) pairs
 
     def small(st) -> bool:

@@ -34,7 +34,9 @@ logger = logging.getLogger(__name__)
 #: kernel modes a single step can execute under. ``chain`` is not a
 #: per-step mode — chained steps run ``naive`` arithmetic inside one
 #: fused multi-step dispatch (see :class:`KernelPolicy`).
-KERNEL_MODES = ("naive", "gauss", "fused", "strassen", "chain", "auto")
+KERNEL_MODES = (
+    "naive", "gauss", "fused", "fused_transpose", "strassen", "chain", "auto",
+)
 
 #: real-multiply credit of each kernel mode relative to the naive
 #: 4-dot complex lowering (the unit every flop count in the stack
@@ -45,9 +47,30 @@ KERNEL_MODES = ("naive", "gauss", "fused", "strassen", "chain", "auto")
 EFFECTIVE_FLOP_FACTOR = {
     "naive": 1.0,
     "fused": 1.0,  # naive arithmetic, fewer HBM passes
+    "fused_transpose": 1.0,  # naive arithmetic, no transpose HBM pass
     "gauss": 0.75,
     "strassen": 21.0 / 32.0,  # gauss × one Strassen level
 }
+
+#: dot-precision rungs a step can run under on the bf16 MXU (f32 dots
+#: are emulated in bf16 passes): ``highest`` = the 6-pass bf16x6
+#: recomposition (closest to true f32 — the backend's ``float32``
+#: default), ``high`` = the 3-pass bf16x3 (≈2× dot throughput at
+#: ≈2^-21 per-product relative error; the rung
+#: ``scripts/hw_campaign2.sh`` step 1b A/Bs and
+#: ``scripts/precision_parity_smoke.py`` pins numerically).
+DOT_PRECISION_MODES = ("highest", "high")
+
+#: documented per-dot relative-error rung of bf16x3 (``high``): the
+#: 3-term recomposition drops the mid·mid and lo cross products, so
+#: its error floor is ~2^-18 relative to the result magnitude —
+#: measured per bucket k-length at ≤5.3e-6 by
+#: ``scripts/precision_parity_smoke.py`` (the CI half of
+#: ``hw_campaign2.sh`` step 1b). :func:`plan_precision_modes` only
+#: promotes when the run's parity budget clears this rung with 2×
+#: headroom; the hardware campaign's slice-subset parity oracle stays
+#: the final gate.
+HIGH_PRECISION_STEP_REL = 2.0 ** -18
 
 
 def complex_mult_env() -> str:
@@ -106,6 +129,35 @@ def complex_mult_key() -> str:
     return os.environ.get("TNC_TPU_COMPLEX_MULT", "auto")
 
 
+def dot_precision_forced() -> str | None:
+    """The ``TNC_TPU_DOT_PRECISION`` forcing override (``high`` /
+    ``highest``), or ``None`` when unset — the dot-precision analogue
+    of :func:`complex_mult_forced`, the A/B knob for hardware
+    campaigns. ``auto`` explicitly requests the per-step ladder
+    (:func:`plan_precision_modes`), so it is NOT a forced mode. Read at
+    *trace* time — every compiled-fn cache keys on
+    :func:`dot_precision_key`."""
+    mode = os.environ.get("TNC_TPU_DOT_PRECISION")
+    if mode in (None, "", "auto"):
+        return None
+    if mode not in DOT_PRECISION_MODES:
+        # an A/B knob must fail loudly: a typo ('hi') silently running
+        # the highest rung would record mislabeled campaign data
+        raise ValueError(
+            f"TNC_TPU_DOT_PRECISION={mode!r}: expected one of "
+            f"{DOT_PRECISION_MODES} or 'auto'"
+        )
+    return mode
+
+
+def dot_precision_key() -> str:
+    """Trace-time *cache-key* form of ``TNC_TPU_DOT_PRECISION``: the
+    forced rung, or ``auto`` when unset — like
+    :func:`complex_mult_key`, forced and auto traces must never share
+    a compiled executable."""
+    return os.environ.get("TNC_TPU_DOT_PRECISION", "auto")
+
+
 def auto_step_mode(step) -> str | None:
     """Per-step promotion for executors outside a full
     :class:`KernelPolicy` plan (the hoisted prelude, whose stem GEMMs
@@ -114,7 +166,7 @@ def auto_step_mode(step) -> str | None:
     the env default.
 
     Eligibility-gated only — unlike the full ladder this does NOT
-    consult ``_strassen_pays``: the prelude executes inside traced
+    consult ``_strassen_saving_s``: the prelude executes inside traced
     functions whose caches key on the env, not on a fitted cost model,
     so a model-dependent decision here would silently serve stale
     traces as calibration evolves. On a device where Strassen loses,
@@ -137,6 +189,13 @@ def resolved_step_mode(step, mode: str | None = None) -> str:
         mode = complex_mult_env()
     if mode == "strassen":
         return "strassen" if _strassen_step_eligible(step) else "gauss"
+    if mode == "fused_transpose":
+        # the kernel's per-step gate falls back to the naive dots
+        return (
+            "fused_transpose"
+            if fused_transpose_ineligible_reason(step) is None
+            else "naive"
+        )
     if mode in ("naive", "fused"):
         return mode
     return "gauss"
@@ -181,6 +240,21 @@ def _resolve_precision(precision):
     return lax.Precision.HIGHEST
 
 
+def _resolve_step_precision(precision, precision_mode):
+    """The ``lax.Precision`` one step's dots actually run at: the
+    per-step :class:`KernelPolicy` rung when set (``high`` /
+    ``highest``), else the ``TNC_TPU_DOT_PRECISION`` forcing override,
+    else the backend-level ``precision`` knob — device path only (the
+    host oracle's f64 matmuls take no precision)."""
+    if not precision_mode:
+        precision_mode = dot_precision_forced()
+    if not precision_mode:
+        return _resolve_precision(precision)
+    return _resolve_precision(
+        "high" if precision_mode == "high" else "float32"
+    )
+
+
 def gauss_matmul(xp, ar, ai, br, bi):
     """Complex matmul on split 2-D parts with 3 real matmuls (host path;
     device precision is handled by `_resolve_precision` + dot_general)."""
@@ -219,13 +293,32 @@ def _strassen_step(xp, ar, ai, br, bi, step, precision):
     return re.reshape(step.out_store), im.reshape(step.out_store)
 
 
-def apply_step_split(xp, apair, bpair, step, precision=None, mode=None):
+def apply_step_split(
+    xp, apair, bpair, step, precision=None, mode=None, precision_mode=None
+):
     """Split-complex analogue of ``backends.apply_step``: one pairwise
     contraction of (real, imag) pairs. The single source of truth
     shared by every split-mode executor. ``mode`` overrides the global
     env mode for this step — the :class:`KernelPolicy` hook; ``None``
-    falls back to :func:`complex_mult_env` (``gauss``)."""
+    falls back to :func:`complex_mult_env` (``gauss``).
+    ``precision_mode`` is the policy's per-step dot-precision rung
+    (``high``/``highest``; empty defers to the
+    ``TNC_TPU_DOT_PRECISION`` override, then the backend
+    ``precision``)."""
     from tnc_tpu.ops.backends import _prep_operand
+
+    if mode == "fused_transpose" and xp is not np:
+        # the fused transpose-dot consumes the RAW stored views — it
+        # must run BEFORE _prep_operand materializes the macro
+        # transpose (that pass is exactly what it deletes); on
+        # fallback the standard prep+naive path below takes over
+        out = _try_fused_transpose_step(
+            apair, bpair, step,
+            _resolve_step_precision(precision, precision_mode),
+        )
+        if out is not None:
+            return out
+        mode = "naive"
 
     ar = _prep_operand(
         xp, apair[0], step.a_view, step.a_perm, step.a_dot, step.a_ops
@@ -258,7 +351,8 @@ def apply_step_split(xp, apair, bpair, step, precision=None, mode=None):
             ar, ai, br, bi = br.T, bi.T, ar, ai
         else:
             ar, ai = ar.T, ai.T
-        if mode in ("naive", "fused"):  # fused is naive arithmetic on host
+        if mode in ("naive", "fused", "fused_transpose"):
+            # the fused kernels run naive arithmetic on host oracles
             re = ar @ br - ai @ bi
             im = ar @ bi + ai @ br
         else:
@@ -268,7 +362,7 @@ def apply_step_split(xp, apair, bpair, step, precision=None, mode=None):
     import jax.numpy as jnp
     from jax import lax
 
-    prec = _resolve_precision(precision)
+    prec = _resolve_step_precision(precision, precision_mode)
     if mode == "strassen":
         return _strassen_step(jnp, ar, ai, br, bi, step, prec)
     ca = (0,) if step.a_cfirst else (len(step.a_dot) - 1,)
@@ -378,6 +472,132 @@ def _try_fused_step(ar, ai, br, bi, step, precision):
     return re.reshape(step.out_store), im.reshape(step.out_store)
 
 
+# -- fused transpose-matmul step glue -----------------------------------
+
+
+_FUSED_TRANSPOSE_WARNED: set[str] = set()
+
+
+def _note_fused_transpose_fallback(reason: str, k: int, m: int, n: int, detail=""):
+    """Count a per-step fused-transpose fallback with its reason (the
+    ``ops.fused_transpose_fallback`` counter bench's
+    ``kernel_counters`` block picks up) and warn — once per reason per
+    process, mirroring :func:`_note_fused_fallback`."""
+    from tnc_tpu import obs
+
+    obs.counter_add("ops.fused_transpose_fallback", reason=reason)
+    msg = (
+        "fused transpose-dot kernel fell back to prep+naive dots for "
+        f"step (K={k}, M={m}, N={n}): {reason}"
+        f"{': ' + detail if detail else ''}"
+    )
+    if reason in _FUSED_TRANSPOSE_WARNED:
+        logger.debug(msg)
+    else:
+        _FUSED_TRANSPOSE_WARNED.add(reason)
+        logger.warning(msg)
+
+
+def _fused_transpose_layouts(step):
+    """``(first, second)`` :class:`~tnc_tpu.ops.pallas_complex.
+    OperandLayout` pair for one step with ``swap`` folded out (the
+    first operand supplies the output rows), or ``None`` per side when
+    the operand's layout cannot be described (staged-prep operands are
+    rejected by the caller — their reshape/lanemix plans are baked for
+    the flat buffer)."""
+    from tnc_tpu.ops.pallas_complex import operand_layout
+
+    a = operand_layout(step.a_view, step.a_perm, step.a_dot, step.a_cfirst)
+    b = operand_layout(step.b_view, step.b_perm, step.b_dot, step.b_cfirst)
+    return (b, a) if step.swap else (a, b)
+
+
+def fused_transpose_ineligible_reason(step) -> str | None:
+    """Why the fused transpose-dot cannot take one step — ``None``
+    when it can (the static half of the gate; dtype and batch checks
+    need live buffers and happen in :func:`_try_fused_transpose_step`).
+    ``staged_prep`` rejects operands carrying a staged op plan: their
+    minor-dim-safe reshape/lanemix sequence is the materialization the
+    kernel would otherwise have to replicate per tile."""
+    from tnc_tpu.ops.pallas_complex import transpose_dot_ineligible_reason
+    from tnc_tpu.ops.program import step_dims
+
+    if step.a_ops is not None or step.b_ops is not None:
+        return "staged_prep"
+    m, k, n = step_dims(step)
+    first, second = _fused_transpose_layouts(step)
+    return transpose_dot_ineligible_reason(first, second, k, m, n)
+
+
+def fused_transpose_step_eligible(step) -> bool:
+    """Can :func:`_try_fused_transpose_step` take this step?"""
+    return fused_transpose_ineligible_reason(step) is None
+
+
+def fused_transpose_runtime_ineligible_reason(apair, bpair, step) -> str | None:
+    """The *runtime* half of the fused-transpose gate — conditions the
+    static :func:`fused_transpose_ineligible_reason` cannot see because
+    they need live buffers: non-f32 parts (``dtype``) and buffers
+    carrying an extra leading batch axis (``batch`` — serving rebind
+    threading cannot stream through the static block geometry). The
+    ONE predicate shared by the kernel route
+    (:func:`_try_fused_transpose_step`) and the span accounting
+    (``backends.run_steps_timed``), so what the spans credit and what
+    the kernel actually does can never diverge (``kernel_error`` stays
+    the documented blind spot)."""
+    ar, br = apair[0], bpair[0]
+    if str(ar.dtype) != "float32" or str(br.dtype) != "float32":
+        return "dtype"
+    if ar.size != int(np.prod(step.a_view, dtype=np.int64)) or br.size != int(
+        np.prod(step.b_view, dtype=np.int64)
+    ):
+        return "batch"
+    return None
+
+
+def _try_fused_transpose_step(apair, bpair, step, precision):
+    """Route one step through the fused transpose-dot Pallas kernel
+    (:func:`tnc_tpu.ops.pallas_complex.fused_transpose_dot_kl`) when
+    its layout allows; ``None`` means 'run the standard prep + naive
+    dots'. Takes the RAW stored (real, imag) pairs — the whole point
+    is that the macro transpose is applied in the kernel's index maps,
+    not materialized through HBM. Every fallback is counted
+    (``ops.fused_transpose_fallback{reason=...}``). Same trace-time
+    failure surface as :func:`_try_fused_step`."""
+    from tnc_tpu.ops.program import step_dims
+
+    m, k, n = step_dims(step)
+    reason = fused_transpose_ineligible_reason(
+        step
+    ) or fused_transpose_runtime_ineligible_reason(apair, bpair, step)
+    if reason is not None:
+        _note_fused_transpose_fallback(reason, k, m, n)
+        return None
+    ar, ai = apair
+    br, bi = bpair
+    from tnc_tpu.ops.pallas_complex import fused_transpose_dot_kl
+
+    first_lay, second_lay = _fused_transpose_layouts(step)
+    a2 = (ar.reshape(step.a_view), ai.reshape(step.a_view))
+    b2 = (br.reshape(step.b_view), bi.reshape(step.b_view))
+    first, second = (b2, a2) if step.swap else (a2, b2)
+    import jax
+
+    interpret = jax.default_backend() != "tpu"
+    try:
+        re, im = fused_transpose_dot_kl(
+            first[0], first[1], second[0], second[1],
+            first_lay, second_lay,
+            interpret=interpret, precision=precision,
+        )
+    except Exception as e:  # trace-time only; see _try_fused_step
+        _note_fused_transpose_fallback(
+            "kernel_error", k, m, n, f"{type(e).__name__}: {e}"
+        )
+        return None
+    return re.reshape(step.out_store), im.reshape(step.out_store)
+
+
 # -- kernel promotion ladder --------------------------------------------
 
 
@@ -386,21 +606,31 @@ class KernelPolicy:
     """Per-step kernel choice for one compiled program.
 
     ``modes[i]`` is the lowering of step ``i`` (``naive`` / ``gauss`` /
-    ``fused`` / ``strassen``); ``chains`` are ``(start, end)`` step
-    spans that execute as ONE fused multi-step Pallas dispatch
+    ``fused`` / ``fused_transpose`` / ``strassen``); ``chains`` are
+    ``(start, end)`` step spans that execute as ONE fused multi-step
+    Pallas dispatch
     (:func:`tnc_tpu.ops.pallas_complex.fused_chain_kl`). Chained steps
     carry mode ``naive`` — the chain kernel's arithmetic — so the host
     oracle and the per-step device fallback compute the identical
-    sequence. A policy is part of the jit cache key
-    (:func:`tnc_tpu.ops.backends.jit_program`): two policies over the
-    same program are different executables.
+    sequence. ``precision_modes[i]`` is step ``i``'s dot-precision
+    rung (``highest`` / ``high`` = bf16x3; empty string defers to the
+    ``TNC_TPU_DOT_PRECISION`` override, then the backend precision);
+    the empty tuple means no step carries a rung. A policy is part of
+    the jit cache key (:func:`tnc_tpu.ops.backends.jit_program`): two
+    policies over the same program — including two that differ ONLY in
+    precision rungs — are different executables.
     """
 
     modes: tuple[str, ...]
     chains: tuple[tuple[int, int], ...] = ()
+    precision_modes: tuple[str, ...] = ()
 
     def signature(self) -> tuple:
-        return (self.modes, self.chains)
+        return (self.modes, self.chains, self.precision_modes)
+
+    def precision_mode(self, i: int) -> str:
+        """Step ``i``'s dot-precision rung ('' = defer)."""
+        return self.precision_modes[i] if self.precision_modes else ""
 
     def chained_steps(self) -> set[int]:
         return {i for s, e in self.chains for i in range(s, e)}
@@ -433,12 +663,15 @@ def _chain_pays(cost_model, steps) -> bool:
     return saved_flops > extra_flops
 
 
-def _strassen_pays(cost_model, m: int, k: int, n: int) -> bool:
-    """First-order win check for one Strassen level over gauss: the
-    saved multiplies (0.75 → 21/32 of naive) must beat the 15 extra
-    quadrant-sized elementwise passes per real GEMM (bandwidth)."""
+def _strassen_saving_s(cost_model, m: int, k: int, n: int) -> float:
+    """Predicted seconds one Strassen level saves over gauss on an
+    eligible step (negative = loses): the saved multiplies (0.75 →
+    21/32 of naive) against the 15 extra quadrant-sized elementwise
+    passes per real GEMM (bandwidth). With no fitted model the margin
+    is ``+inf`` — eligibility alone decides, the pre-calibration
+    behavior."""
     if cost_model is None:
-        return True
+        return float("inf")
     from tnc_tpu.ops.strassen import GAUSS_STRASSEN_FLOP_FACTOR
 
     naive_real_flops = 8.0 * m * k * n
@@ -446,12 +679,108 @@ def _strassen_pays(cost_model, m: int, k: int, n: int) -> bool:
         0.75 - GAUSS_STRASSEN_FLOP_FACTOR
     ) * naive_real_flops / cost_model.flops_per_s
     if not cost_model.bytes_per_s:
-        return saved_s > 0.0
+        return saved_s
     # ~15 add/sub passes over (m/2, k/2)+(k/2, n/2) quadrants, 3 Gauss
     # products, f32 in + out
     quad_bytes = 4.0 * ((m * k + k * n) / 4.0) * 2.0
     extra_s = 3.0 * 15.0 * quad_bytes / cost_model.bytes_per_s
-    return saved_s > extra_s
+    return saved_s - extra_s
+
+
+def _fused_transpose_saving_s(cost_model, step) -> float:
+    """Predicted seconds the fused transpose-dot saves over the
+    default prep+gauss path on one eligible step (negative = loses):
+    the deleted materialized-transpose HBM pass (read + write of every
+    permuted operand's (real, imag) pair — :func:`tnc_tpu.ops.program.
+    step_prep_elems`) against the naive-vs-gauss flop difference (the
+    kernel runs 4 dots where gauss runs 3). Unlike Strassen, a missing
+    model means NO promotion (``-inf``): the rung's entire case is
+    bandwidth, so without a fitted bandwidth term there is no evidence
+    it pays — the ``TNC_TPU_COMPLEX_MULT=fused_transpose`` override is
+    the A/B path."""
+    if cost_model is None or not cost_model.bytes_per_s:
+        return float("-inf")
+    from tnc_tpu.ops.program import step_flops, step_prep_elems
+
+    prep = step_prep_elems(step)
+    if prep <= 0.0:
+        return float("-inf")  # no transpose pass to save
+    # f32 split pairs: 8 bytes per complex element, the device width
+    saved_s = prep * 8.0 / cost_model.bytes_per_s
+    # naive 8 vs gauss 6 real-multiply units per k*m*n (same convention
+    # as _chain_pays); the fitted flops_per_s is per k*m*n unit
+    extra_s = 2.0 * step_flops(step) / cost_model.flops_per_s
+    return saved_s - extra_s
+
+
+def chain_flop_ceiling(cost_model) -> float:
+    """Chain-candidate step-size ceiling in the fused kernel's
+    ``2*k*m*n`` units, priced in calibrated seconds: a step is worth
+    chaining while its compute time is within ~one dispatch overhead
+    (:meth:`~tnc_tpu.obs.calibrate.CalibratedCostModel.
+    dispatch_equivalent_flops`), so the ceiling rises above the static
+    ``MIN_FLOPS`` small-step bucket exactly when the fitted overhead
+    says bigger steps are still dispatch-bound — PR 6's chain fusion
+    extended upward. Never *below* ``MIN_FLOPS``: the static bound is
+    the no-model floor."""
+    from tnc_tpu.ops.pallas_complex import MIN_FLOPS
+
+    if cost_model is None:
+        return float(MIN_FLOPS)
+    return max(float(MIN_FLOPS), 2.0 * cost_model.dispatch_equivalent_flops())
+
+
+def plan_precision_modes(
+    steps,
+    cost_model=None,
+    force: str | None = None,
+    parity_budget: float = 1e-5,
+) -> tuple[str, ...]:
+    """Per-step dot-precision rungs for :func:`plan_kernel_steps`.
+
+    ``force`` (default: the ``TNC_TPU_DOT_PRECISION`` override via
+    :func:`dot_precision_forced`) pins every step for A/B runs.
+    Unforced, the ladder promotes a step to ``high`` (bf16x3, ≈2× dot
+    throughput) only when ALL of:
+
+    - a fitted cost model with a bandwidth term exists and predicts the
+      step *compute*-dominated (flop time > byte time) — elsewhere the
+      dots aren't the bottleneck and the rung buys nothing;
+    - the step is in the ``stem`` bucket — the big square-ish GEMMs
+      whose products dominate the amplitude, where
+      ``scripts/precision_parity_smoke.py`` pins the bf16x3 rung's
+      measured relative error;
+    - the ``parity_budget`` (the run's amplitude-parity target, 1e-5
+      by default) clears the documented bf16x3 rung
+      (:data:`HIGH_PRECISION_STEP_REL`, ~3.8e-6) with 2× headroom —
+      a tight-budget run never trades parity for speed.
+
+    Returns ``()`` (no rungs) when nothing promotes, so unpromoted
+    policies keep their pre-ladder signatures.
+    """
+    steps = tuple(steps)
+    if force is None:
+        force = dot_precision_forced()
+    if force is not None:
+        return (force,) * len(steps)
+    if cost_model is None or not cost_model.bytes_per_s:
+        return ()
+    if parity_budget < 2.0 * HIGH_PRECISION_STEP_REL:
+        return ()
+    from tnc_tpu.ops.program import step_elems, step_flops
+
+    out = []
+    for st in steps:
+        promote = False
+        if step_bucket(st) == "stem":
+            flop_s = step_flops(st) / cost_model.flops_per_s
+            elems_in, elems_out = step_elems(st)
+            byte_s = (elems_in + elems_out) * 8.0 / cost_model.bytes_per_s
+            promote = flop_s > byte_s
+        out.append("high" if promote else "")
+    if not any(out):
+        return ()
+    return tuple(out)
 
 
 def plan_kernels(
@@ -467,19 +796,32 @@ def plan_kernels(
 
     ``force`` (default: the ``TNC_TPU_COMPLEX_MULT`` override via
     :func:`complex_mult_forced`) pins the decision for A/B runs:
-    ``naive``/``gauss``/``fused`` uniformly; ``strassen`` promotes
-    every step over the crossover (others run gauss); ``chain`` fuses
-    every groupable run (others run gauss). Unforced, the ladder is
+    ``naive``/``gauss``/``fused``/``fused_transpose`` uniformly
+    (the fused rungs fall back per step at trace time, counted);
+    ``strassen`` promotes every step over the crossover (others run
+    gauss); ``chain`` fuses every groupable run (others run gauss).
+    The per-step dot-precision rung is planned alongside
+    (:func:`plan_precision_modes` — ``TNC_TPU_DOT_PRECISION`` forces
+    it independently of the mode override). Unforced, the ladder is
     cost-model-driven (``cost_model``: a
     :class:`tnc_tpu.obs.calibrate.CalibratedCostModel` or None):
 
-    - runs of small consecutive steps whose fusion saves more dispatch
-      overhead than the naive-vs-gauss flop difference costs → one
-      fused **chain** dispatch;
+    - runs of consecutive steps under the calibrated chain ceiling
+      (:func:`chain_flop_ceiling` — ``MIN_FLOPS`` statically, rising
+      with the fitted ``dispatch_overhead_s``) whose fusion saves more
+      dispatch overhead than the naive-vs-gauss flop difference costs
+      → one fused **chain** dispatch;
+    - transpose-carrying steps the fused transpose-dot can stream
+      where the deleted HBM transpose pass beats the extra naive dot
+      (:func:`_fused_transpose_saving_s` — needs a fitted bandwidth
+      term) → **fused_transpose**;
     - steps whose matricized shape clears the Strassen crossover
       (square-ish, ≥2^11 per dim) where the multiply saving beats the
-      extra passes → **strassen**;
-    - everything else → **gauss**, the tuned default.
+      extra passes → **strassen** (when both rungs pay, the larger
+      predicted saving wins);
+    - everything else → **gauss**, the tuned default;
+    - stem-bucket compute-dominated steps additionally promote their
+      dots to the bf16x3 ``high`` rung under the parity budget.
     """
     return plan_kernel_steps(
         program.steps, cost_model, force, chain_max_flops
@@ -491,6 +833,8 @@ def plan_kernel_steps(
     cost_model=None,
     force: str | None = None,
     chain_max_flops: float | None = None,
+    precision_force: str | None = None,
+    parity_budget: float = 1e-5,
 ) -> KernelPolicy:
     """:func:`plan_kernels` over a bare step sequence — chain spans and
     modes are indexed relative to ``steps[0]``."""
@@ -501,15 +845,28 @@ def plan_kernel_steps(
     n = len(steps)
     if force is None:
         force = complex_mult_forced()
-    if force in ("naive", "gauss", "fused"):
-        return KernelPolicy((force,) * n)
+    pmodes = plan_precision_modes(
+        steps, cost_model, precision_force, parity_budget
+    )
+    if force in ("naive", "gauss", "fused", "fused_transpose"):
+        return KernelPolicy((force,) * n, (), pmodes)
     if force == "strassen":
         modes = tuple(
             "strassen" if _strassen_step_eligible(st) else "gauss"
             for st in steps
         )
-        return KernelPolicy(modes)
+        if pmodes and dot_precision_forced() is None and precision_force is None:
+            # see the auto branch below: no auto bf16x3 on strassen
+            pmodes = tuple(
+                "" if modes[i] == "strassen" else p
+                for i, p in enumerate(pmodes)
+            )
+            if not any(pmodes):
+                pmodes = ()
+        return KernelPolicy(modes, (), pmodes)
 
+    if chain_max_flops is None and force != "chain":
+        chain_max_flops = chain_flop_ceiling(cost_model)
     chains = chain_groups(steps, max_flops=chain_max_flops)
     if force != "chain":  # auto: keep only the chains the model likes
         chains = tuple(
@@ -525,11 +882,35 @@ def plan_kernel_steps(
             modes.append("gauss")
             continue
         m, k, nn = step_dims(st)
-        if strassen_eligible(m, k, nn) and _strassen_pays(cost_model, m, k, nn):
+        strassen_gain = (
+            _strassen_saving_s(cost_model, m, k, nn)
+            if strassen_eligible(m, k, nn)
+            else float("-inf")
+        )
+        transpose_gain = (
+            _fused_transpose_saving_s(cost_model, st)
+            if fused_transpose_step_eligible(st)
+            else float("-inf")
+        )
+        if strassen_gain <= 0.0 and transpose_gain <= 0.0:
+            modes.append("gauss")
+        elif strassen_gain >= transpose_gain:
             modes.append("strassen")
         else:
-            modes.append("gauss")
-    return KernelPolicy(tuple(modes), chains)
+            modes.append("fused_transpose")
+    if pmodes and dot_precision_forced() is None and precision_force is None:
+        # never STACK the auto bf16x3 rung on a Strassen step: the
+        # budget check models the plain-dot rung only, and Strassen's
+        # extra add/sub passes amplify the error past both documented
+        # rungs. A forced TNC_TPU_DOT_PRECISION is the explicit A/B —
+        # it stays global (its parity oracle is the gate).
+        pmodes = tuple(
+            "" if modes[i] == "strassen" else p
+            for i, p in enumerate(pmodes)
+        )
+        if not any(pmodes):
+            pmodes = ()
+    return KernelPolicy(tuple(modes), chains, pmodes)
 
 
 def step_bucket(step) -> str:
@@ -561,30 +942,65 @@ def effective_step_flops(step, mode: str) -> float:
 
 
 def kernel_plan_summary(
-    program: ContractionProgram, policy: KernelPolicy | None = None
+    program: ContractionProgram,
+    policy: KernelPolicy | None = None,
+    dtype_bytes: float = 8.0,
 ) -> dict:
     """JSON-able per-bucket summary of a program under a policy: step
-    counts, naive vs effective (mode-credited) flops, the mode mix,
-    and the dispatch count (chains collapse to one). The static side
-    of ``bench.py``'s per-bucket MFU report."""
+    counts, naive vs effective (mode-credited) flops, the mode and
+    dot-precision mixes, predicted HBM bytes under the naive prep+dot
+    path vs under the planned modes (the fused transpose rung's
+    deleted pass shows up as ``pred_bytes_planned <
+    pred_bytes_naive`` on transpose-carrying buckets — the invariant
+    ``scripts/perf_gate.py`` enforces), and the dispatch count
+    (chains collapse to one). ``dtype_bytes`` defaults to the device
+    path's f32 split-pair width (8 B per complex element). The static
+    side of ``bench.py``'s per-bucket MFU report."""
     if policy is None:
         policy = plan_kernels(program)
-    from tnc_tpu.ops.program import step_flops
+    from tnc_tpu.ops.program import step_elems, step_flops, step_prep_elems
 
     buckets: dict[str, dict] = {}
     for i, st in enumerate(program.steps):
         b = buckets.setdefault(
             step_bucket(st),
-            {"steps": 0, "flops": 0.0, "effective_flops": 0.0, "modes": {}},
+            {
+                "steps": 0,
+                "flops": 0.0,
+                "effective_flops": 0.0,
+                "modes": {},
+                "precision": {},
+                "transpose_steps": 0,
+                "pred_bytes_naive": 0.0,
+                "pred_bytes_planned": 0.0,
+            },
         )
         mode = policy.modes[i]
+        resolved = resolved_step_mode(st, mode)
         b["steps"] += 1
         b["flops"] += step_flops(st)
-        b["effective_flops"] += effective_step_flops(st, mode)
+        b["effective_flops"] += effective_step_flops(st, resolved)
         b["modes"][mode] = b["modes"].get(mode, 0) + 1
+        rung = policy.precision_mode(i) or "default"
+        b["precision"][rung] = b["precision"].get(rung, 0) + 1
+        if step_prep_elems(st) > 0.0:
+            b["transpose_steps"] += 1
+        naive_in, naive_out = step_elems(st)
+        plan_in, plan_out = step_elems(st, mode=resolved)
+        b["pred_bytes_naive"] += (naive_in + naive_out) * dtype_bytes
+        b["pred_bytes_planned"] += (plan_in + plan_out) * dtype_bytes
     for b in buckets.values():
         b["flops"] = float(f"{b['flops']:.4e}")
         b["effective_flops"] = float(f"{b['effective_flops']:.4e}")
+        b["pred_bytes_naive"] = float(f"{b['pred_bytes_naive']:.4e}")
+        b["pred_bytes_planned"] = float(f"{b['pred_bytes_planned']:.4e}")
+        if b["steps"]:
+            b["pred_bytes_per_step_naive"] = float(
+                f"{b['pred_bytes_naive'] / b['steps']:.4e}"
+            )
+            b["pred_bytes_per_step_planned"] = float(
+                f"{b['pred_bytes_planned'] / b['steps']:.4e}"
+            )
     return {
         "buckets": buckets,
         "dispatches": policy.dispatch_count(),
@@ -593,7 +1009,7 @@ def kernel_plan_summary(
     }
 
 
-def _run_chain_split(steps, buffers, precision):
+def _run_chain_split(steps, buffers, precision, precision_mode=""):
     """Execute a grouped run of steps as ONE fused Pallas dispatch.
 
     Non-carried operands are prepped to contract-dim-leading 2-D
@@ -608,7 +1024,7 @@ def _run_chain_split(steps, buffers, precision):
     from tnc_tpu.ops.backends import _prep_operand
     from tnc_tpu.ops.pallas_complex import ChainLink, fused_chain_kl
 
-    prec = _resolve_precision(precision)
+    prec = _resolve_step_precision(precision, precision_mode)
     interpret = jax.default_backend() != "tpu"
 
     def prep_kl(pair, view, perm, dot_shape, ops, cfirst):
@@ -665,18 +1081,20 @@ def _run_chain_split(steps, buffers, precision):
     return re.reshape(out_store), im.reshape(out_store)
 
 
-def run_chain_split(xp, steps, buffers, precision=None):
+def run_chain_split(xp, steps, buffers, precision=None, precision_mode=""):
     """Execute one chain group with full buffer bookkeeping — the
     fused dispatch on device, the sequential naive loop on the host
     oracle (bit-identical arithmetic) or when the kernel can't trace
-    (counted as ``ops.fused_chain_fallback``). Mutates ``buffers`` the
-    same way the sequential loop would."""
+    (counted as ``ops.fused_chain_fallback``). ``precision_mode`` is
+    the chain's dot-precision rung (one rung per chain — the policy's
+    head-step entry). Mutates ``buffers`` the same way the sequential
+    loop would."""
     from tnc_tpu import obs
 
     out = None
     if xp is not np:
         try:
-            out = _run_chain_split(steps, buffers, precision)
+            out = _run_chain_split(steps, buffers, precision, precision_mode)
         except Exception as e:  # trace-time only — same contract as fused
             obs.counter_add("ops.fused_chain_fallback")
             logger.warning(
@@ -688,7 +1106,7 @@ def run_chain_split(xp, steps, buffers, precision=None):
         for st in steps:
             buffers[st.lhs] = apply_step_split(
                 xp, buffers[st.lhs], buffers[st.rhs], st, precision,
-                mode="naive",
+                mode="naive", precision_mode=precision_mode,
             )
             buffers[st.rhs] = None
         return buffers[steps[-1].lhs]
@@ -718,13 +1136,19 @@ def run_steps_split(
     while i < len(steps):
         end = chain_end.get(i)
         if end is not None:
-            run_chain_split(xp, steps[i:end], buffers, precision)
+            run_chain_split(
+                xp, steps[i:end], buffers, precision,
+                precision_mode=policy.precision_mode(i),
+            )
             i = end
             continue
         step = steps[i]
         buffers[step.lhs] = apply_step_split(
             xp, buffers[step.lhs], buffers[step.rhs], step, precision,
             mode=policy.modes[i] if policy is not None else None,
+            precision_mode=(
+                policy.precision_mode(i) if policy is not None else None
+            ),
         )
         buffers[step.rhs] = None
         i += 1

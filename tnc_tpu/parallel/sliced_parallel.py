@@ -244,7 +244,7 @@ _SPMD_FN_CACHE_MAX = 64
 
 def _spmd_fn_cached(sp, mesh, axis, dtype, split_complex, precision, unroll,
                     max_slices, hoist=False):
-    from tnc_tpu.ops.split_complex import complex_mult_key
+    from tnc_tpu.ops.split_complex import complex_mult_key, dot_precision_key
 
     n_devices = mesh.shape[axis]
     chunk = _effective_chunk(sp.slicing.num_slices, n_devices, max_slices)
@@ -252,9 +252,11 @@ def _spmd_fn_cached(sp, mesh, axis, dtype, split_complex, precision, unroll,
         sp.signature(), tuple(mesh.devices.flat), axis, str(dtype),
         split_complex, precision, unroll, chunk, hoist,
         # the split trace bakes in the kernel policy/env mode — a stale
-        # fn under a flipped TNC_TPU_COMPLEX_MULT would silently run
-        # the wrong kernels
+        # fn under a flipped TNC_TPU_COMPLEX_MULT (or a flipped
+        # TNC_TPU_DOT_PRECISION rung) would silently run the wrong
+        # kernels
         complex_mult_key() if split_complex else None,
+        dot_precision_key() if split_complex else None,
     )
     fn = _SPMD_FN_CACHE.get(key)
     obs.counter_add("spmd_fn_cache.hit" if fn is not None else
