@@ -1864,6 +1864,137 @@ def _parse_serve_mix(spec: str) -> dict:
     return weights
 
 
+def _serve_reuse_sweep(spec: str, backend, backend_name: str, ref_model) -> dict:
+    """``BENCH_SERVE_SWEEP=angles:N`` — the parameter-sweep serving
+    workload: one brickwork ansatz, N angle settings sharing the first
+    ``BENCH_SERVE_SWEEP_PREFIX`` rounds' angles (default depth-1), so
+    every setting's contraction tree contains the same-valued prefix
+    subtrees. Two legs bind and evaluate one amplitude per setting
+    through a fresh plan cache each: reuse OFF (cold, the control) and
+    reuse ON (a shared :class:`IntermediateStore` contracts the prefix
+    once store-wide). The block records measured wall/qps for both
+    legs plus the pinned-reference-model speedup (total predicted
+    seconds cold vs prefix-once + residual-per-setting — reproducible
+    without hardware timing), the store's hit rate / bytes held /
+    prefix-flops saved, a queue-level dedup mini-pass (duplicate
+    riders through a real service window), and the off-vs-on numeric
+    agreement. Cross-checked by scripts/perf_gate.py like the per-type
+    rows."""
+    import tempfile
+
+    from tnc_tpu import obs
+    from tnc_tpu.builders.random_circuit import brickwork_sweep
+    from tnc_tpu.ops.program import steps_bytes, steps_flops
+    from tnc_tpu.serve import (
+        ContractionService,
+        IntermediateStore,
+        PlanCache,
+        bind_circuit,
+    )
+
+    mode, _, arg = spec.partition(":")
+    if mode != "angles":
+        raise ValueError(
+            f"unknown BENCH_SERVE_SWEEP mode {spec!r} (expected 'angles:N')"
+        )
+    settings = max(int(arg or "16"), 2)
+    n = _env_int("BENCH_SERVE_QUBITS", 10)
+    depth = _env_int("BENCH_SERVE_DEPTH", 6)
+    prefix_depth = _env_int("BENCH_SERVE_SWEEP_PREFIX", max(depth - 1, 1))
+    seed = _env_int("BENCH_SEED", 42)
+
+    def sweep_circuits():
+        # regenerated per leg from a pinned stream (offset so the main
+        # serve bench's draws don't shift the sweep): both legs bind
+        # value-identical circuits
+        rng = np.random.default_rng(seed + 1)
+        return brickwork_sweep(n, depth, prefix_depth, settings, rng)
+
+    bits = "".join(np.random.default_rng(seed + 2).choice(["0", "1"], n))
+
+    def run_leg(store):
+        results = []
+        model_s = 0.0
+        with tempfile.TemporaryDirectory() as tmp:
+            cache = PlanCache(tmp)
+            t0 = time.monotonic()
+            for circ in sweep_circuits():
+                bound = bind_circuit(
+                    circ, plan_cache=cache, reuse_store=store
+                )
+                results.append(
+                    complex(bound.amplitudes_det([bits], backend)[0])
+                )
+                # reuse ON: bound.program is the residual, so this sums
+                # exactly the per-request work the reuse path repays
+                steps = bound.program.steps
+                model_s += ref_model.op_seconds(
+                    steps_flops(steps), steps_bytes(steps),
+                    dispatches=max(len(steps), 1),
+                )
+        wall = time.monotonic() - t0
+        return results, wall, model_s
+
+    with obs.span("bench.serve.reuse", settings=settings, leg="off"):
+        off_results, off_wall, off_model_s = run_leg(None)
+    store = IntermediateStore(cost_model=ref_model)
+    with obs.span("bench.serve.reuse", settings=settings, leg="on"):
+        on_results, on_wall, on_model_s = run_leg(store)
+    st = store.stats()
+    # what the ON leg actually paid, in pinned-model seconds: the cold
+    # prefix materializations (counted once store-wide) + each
+    # setting's residual (already summed by run_leg). Materialization
+    # bytes aren't tracked — flops + dispatches dominate these shapes.
+    on_model_s += ref_model.op_seconds(
+        st["flops_computed"], dispatches=max(st["steps_computed"], 1.0)
+    )
+    diffs = [abs(a - b) for a, b in zip(off_results, on_results)]
+
+    # queue-level dedup mini-pass: duplicate amplitude riders through a
+    # real micro-batching window must collapse to unique dispatch rows
+    dedup_collapses = 0
+    rng = np.random.default_rng(seed + 3)
+    uniq = ["".join(rng.choice(["0", "1"], n)) for _ in range(4)]
+    with ContractionService.from_circuit(
+        sweep_circuits()[0], backend=backend, max_batch=32,
+        max_wait_ms=50.0,
+    ) as svc:
+        svc.amplitude(uniq[0])  # warm the window so the burst co-batches
+        futs = [svc.submit(uniq[i % len(uniq)]) for i in range(32)]
+        for f in futs:
+            f.result(timeout=600)
+        dedup_collapses = int(svc.stats()["counts"]["deduped"])
+
+    hits, misses = st["hit"], st["miss"]
+    return {
+        "mode": mode,
+        "backend": backend_name,
+        "settings": settings,
+        "qubits": n,
+        "depth": depth,
+        "prefix_depth": prefix_depth,
+        "wall_s_off": round(off_wall, 4),
+        "wall_s_on": round(on_wall, 4),
+        "qps_off": round(settings / off_wall, 1) if off_wall > 0 else 0.0,
+        "qps_on": round(settings / on_wall, 1) if on_wall > 0 else 0.0,
+        "speedup": (
+            round(off_wall / on_wall, 3) if on_wall > 0 else None
+        ),
+        "model_speedup": (
+            round(off_model_s / on_model_s, 3) if on_model_s > 0 else None
+        ),
+        "hit_rate": round(hits / max(hits + misses, 1), 4),
+        "hits": hits,
+        "misses": misses,
+        "bytes_held": st["bytes_held"],
+        "entries": st["entries"],
+        "prefix_flops_saved": st["prefix_flops_saved"],
+        "dedup_collapses": dedup_collapses,
+        "max_abs_diff": float(max(diffs)) if diffs else 0.0,
+        "bitwise_equal": bool(diffs) and max(diffs) == 0.0,
+    }
+
+
 def _serve_bench() -> dict:
     """``--serve``: throughput/latency of the in-process query service
     (docs/serving.md). A random circuit is bound once (plan+compile
@@ -2113,6 +2244,20 @@ def _serve_bench() -> dict:
         "reference_model": ref_constants,
         "slo": slo_block,
     }
+    sweep_spec = os.environ.get("BENCH_SERVE_SWEEP")
+    if sweep_spec:
+        block["reuse"] = _serve_reuse_sweep(
+            sweep_spec, backend, backend_name, ref_model
+        )
+        r = block["reuse"]
+        log(
+            f"[bench]   reuse sweep: {r['settings']} settings, "
+            f"{r['qps_off']} -> {r['qps_on']} q/s "
+            f"(model speedup {r['model_speedup']}x, "
+            f"hit rate {r['hit_rate']}, "
+            f"dedup collapses {r['dedup_collapses']}, "
+            f"max |diff| {r['max_abs_diff']:.3g})"
+        )
     log(
         f"[bench] serving: {block['qps']} q/s over {n_queries} queries "
         f"(mix {mix}, mean batch {stats['batch_size']['mean']:.1f}, "

@@ -86,6 +86,9 @@ TNC_TPU_PLATFORM=cpu python scripts/serve_smoke.py
 echo "== query-engine smoke (sampling/expectation/marginal vs statevector oracle, mixed queue) =="
 TNC_TPU_PLATFORM=cpu python scripts/query_smoke.py
 
+echo "== reuse smoke (64-setting sweep: one find_path, prefix contracted once, dedup, bit-exact) =="
+TNC_TPU_PLATFORM=cpu python scripts/reuse_smoke.py
+
 echo "== SLO smoke (live /metrics==stats, >=95% trace attribution, injected slowdown flips burn+drift) =="
 TNC_TPU_PLATFORM=cpu python scripts/slo_smoke.py
 

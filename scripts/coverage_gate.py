@@ -56,6 +56,7 @@ REQUIRED_MODULES = (
     os.path.join("tnc_tpu", "contractionpath", "sliced_cost.py"),
     os.path.join("tnc_tpu", "serve", "replan.py"),
     os.path.join("tnc_tpu", "serve", "multihost.py"),
+    os.path.join("tnc_tpu", "serve", "reuse.py"),
 )
 
 executed: set[tuple[str, int]] = set()

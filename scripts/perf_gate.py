@@ -290,6 +290,41 @@ def compare(
             f"bench run: {', '.join(str(a) for a in slo_alerts)}"
         )
 
+    # serving reuse cross-check (the BENCH_SERVE_SWEEP block): the
+    # pinned-reference-model speedup is the reuse feature's headline —
+    # below 2x the prefix store is no longer paying for itself; a hit
+    # rate that fell or a numeric disagreement vs the cold leg is a
+    # correctness smell even when qps absorbed it
+    bru = (base.get("serving") or {}).get("reuse") or {}
+    cru = (cand.get("serving") or {}).get("reuse") or {}
+    cms = cru.get("model_speedup")
+    if cms is not None and float(cms) < 2.0:
+        msgs.append(
+            f"warning: serving reuse model speedup {float(cms):.2f}x "
+            f"below the 2x floor (prefix store not paying for itself)"
+        )
+    bhr, chr_ = bru.get("hit_rate"), cru.get("hit_rate")
+    if bhr is not None and chr_ is not None and (
+        float(chr_) < float(bhr) - 0.2
+    ):
+        msgs.append(
+            f"warning: serving reuse hit rate dropped "
+            f"{float(bhr):.2f} -> {float(chr_):.2f} (digests churning?)"
+        )
+    bsp, csp = bru.get("model_speedup"), cru.get("model_speedup")
+    if bsp and csp and float(csp) < float(bsp) / 1.5:
+        msgs.append(
+            f"warning: serving reuse model speedup regressed "
+            f"{float(bsp):.2f}x -> {float(csp):.2f}x"
+        )
+    cdiff = cru.get("max_abs_diff")
+    if cdiff is not None and float(cdiff) > 1e-4:
+        msgs.append(
+            f"warning: serving reuse off-vs-on answers diverged "
+            f"(max |diff| {float(cdiff):.3g}) — reuse must be "
+            f"numerically transparent"
+        )
+
     # kernel-ladder per-bucket cross-check: effective-flop-credited MFU
     # when both records carry it, achieved FLOP/s otherwise — a bucket
     # whose kernel rung regressed (chain unfused, strassen fallen back)

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Cross-request reuse smoke for scripts/check.sh: a 64-setting
+parameter sweep (one brickwork ansatz, shared prefix angles) served
+through one shared PlanCache + IntermediateStore on CPU must
+
+- run the pathfinder exactly ONCE (64 structurally identical settings
+  → one ``plan.find_path`` span, every later bind a plan-cache hit);
+- contract the shared prefix exactly ONCE store-wide: every
+  ``serve.reuse.materialize`` span carries a distinct node digest (a
+  repeated digest means a subtree was recontracted), and settings
+  2..64 each hit the store (≥63 hits);
+- collapse duplicate queue riders (micro-batch dedup) while fanning
+  the per-request results back;
+- stay numerically TRANSPARENT: every reuse-served amplitude is
+  bit-identical to the cold bind of the same plan.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+import tnc_tpu.obs as obs  # noqa: E402
+from tnc_tpu.builders.random_circuit import brickwork_sweep  # noqa: E402
+from tnc_tpu.obs.core import MetricsRegistry  # noqa: E402
+from tnc_tpu.serve import (  # noqa: E402
+    ContractionService,
+    IntermediateStore,
+    PlanCache,
+    bind_circuit,
+)
+
+N_QUBITS = 6
+DEPTH = 4
+PREFIX_DEPTH = 3
+# 64 in CI; the ROADMAP acceptance run is REUSE_SMOKE_SETTINGS=1000
+SETTINGS = int(os.environ.get("REUSE_SMOKE_SETTINGS", "64"))
+
+
+def sweep():
+    """Deterministic: each call regenerates value-identical circuits,
+    so the warm and cold legs bind separate copies."""
+    return brickwork_sweep(
+        N_QUBITS, DEPTH, PREFIX_DEPTH, SETTINGS, np.random.default_rng(13)
+    )
+
+
+def find_path_spans() -> int:
+    return sum(
+        1
+        for r in obs.get_registry().span_records()
+        if r.name == "plan.find_path"
+    )
+
+
+def materialize_digests() -> list[str]:
+    return [
+        str(r.args["node"])
+        for r in obs.get_registry().span_records()
+        if r.name == "serve.reuse.materialize"
+    ]
+
+
+def main() -> int:
+    obs.configure(enabled=True, registry=MetricsRegistry())
+    rng = np.random.default_rng(29)
+    bits = ["".join(rng.choice(["0", "1"], N_QUBITS)) for _ in range(2)]
+
+    with tempfile.TemporaryDirectory() as tmp:
+        cache = PlanCache(os.path.join(tmp, "plans"))
+        store = IntermediateStore(
+            directory=os.path.join(tmp, "spill"), max_bytes=1 << 26
+        )
+
+        # --- warm leg: the 64-setting sweep through the shared store
+        warm = []
+        for circ in sweep():
+            bound = bind_circuit(circ, plan_cache=cache, reuse_store=store)
+            warm.append(np.asarray(bound.amplitudes_det(bits)))
+        assert find_path_spans() == 1, (
+            f"{SETTINGS}-setting sweep ran the pathfinder "
+            f"{find_path_spans()} times (want exactly 1)"
+        )
+        digests = materialize_digests()
+        assert len(digests) == len(set(digests)), (
+            "a subtree was contracted more than once: duplicate "
+            "serve.reuse.materialize node digests"
+        )
+        st = store.stats()
+        assert st["hit"] >= SETTINGS - 1, (
+            f"expected every setting after the first to hit the shared "
+            f"prefix: {st}"
+        )
+        assert st["prefix_flops_saved"] > 0, st
+        print(
+            f"[reuse_smoke] {SETTINGS}-setting sweep: 1 find_path span, "
+            f"{len(digests)} unique subtrees contracted once, "
+            f"{st['hit']} store hits, "
+            f"{st['prefix_flops_saved']:.0f} prefix flops saved"
+        )
+
+        # --- cold leg: same plans (cache hit), no reuse store — the
+        # bitwise oracle. Still zero new pathfinding.
+        for circ, got in zip(sweep(), warm):
+            bound = bind_circuit(circ, plan_cache=cache, reuse_store=None)
+            want = np.asarray(bound.amplitudes_det(bits))
+            assert np.array_equal(got, want), (
+                f"reuse-served amplitudes diverged from the cold bind: "
+                f"{got} != {want}"
+            )
+        assert find_path_spans() == 1, "cold leg re-ran the pathfinder"
+        print(
+            f"[reuse_smoke] all {SETTINGS}x{len(bits)} amplitudes "
+            f"bit-identical to the cold bind"
+        )
+
+        # --- queue-level dedup: 64 riders over 8 unique bitstrings
+        # through one micro-batch window collapse to unique dispatch
+        # rows, every request still answered exactly
+        uniq = ["".join(rng.choice(["0", "1"], N_QUBITS)) for _ in range(8)]
+        first = sweep()[0]
+        with ContractionService.from_circuit(
+            first, plan_cache=cache, reuse_store=store,
+            max_batch=64, max_wait_ms=200.0,
+        ) as svc:
+            oracle = {b: svc.amplitude(b, timeout_s=60) for b in uniq}
+            futs = [svc.submit(uniq[i % len(uniq)]) for i in range(64)]
+            results = [f.result(timeout=120) for f in futs]
+            for i, amp in enumerate(results):
+                assert amp == oracle[uniq[i % len(uniq)]], (
+                    f"dedup fan-out broke request {i}"
+                )
+            deduped = svc.stats()["counts"]["deduped"]
+        assert deduped >= 1, "duplicate riders were never collapsed"
+        assert find_path_spans() == 1, "service bind re-ran the pathfinder"
+        print(
+            f"[reuse_smoke] dedup: {deduped} duplicate riders collapsed, "
+            f"all 64 answers exact"
+        )
+
+    print("[reuse_smoke] OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

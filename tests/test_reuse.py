@@ -1,0 +1,428 @@
+"""tnc_tpu.serve.reuse: cross-request numeric reuse.
+
+Pins the subsystem's contracts:
+
+- :class:`IntermediateStore` mechanics: byte-budgeted LRU eviction in
+  the memory tier, write-through disk spill that survives a memory
+  clear (the restart / second-replica shape), corrupt and stale spill
+  entries recovered by recontraction (poison pill deleted, counted),
+  concurrent multi-writer safety on one shared directory, and the
+  cost-model admission policy;
+- prefix reuse is numerically TRANSPARENT: a sweep circuit bound with
+  a reuse store returns amplitudes **bit-identical** to the cold bind
+  on numpy, jax threaded complex64, jax complex128 and sliced
+  structures; the split-complex path agrees to float32 tolerance only
+  (XLA fuses the one-program cold bind and the node-program + residual
+  warm bind differently — documented in docs/serving.md);
+- a warm store serves a repeat sweep with zero new contractions;
+- queue-level dedup collapses duplicate amplitude/expectation riders
+  (results fanned back per request) and never touches sample riders;
+- the ``stats()`` / Prometheus metrics surface.
+"""
+
+import shutil
+import threading
+
+import numpy as np
+import pytest
+
+import tnc_tpu.obs as obs
+from tnc_tpu.builders.random_circuit import brickwork_sweep
+from tnc_tpu.obs.calibrate import CalibratedCostModel
+from tnc_tpu.obs.core import MetricsRegistry
+from tnc_tpu.obs.http import parse_prometheus, render_prometheus
+from tnc_tpu.ops.backends import JaxBackend, NumpyBackend
+from tnc_tpu.serve import (
+    ContractionService,
+    IntermediateStore,
+    PlanCache,
+    bind_circuit,
+)
+
+
+@pytest.fixture
+def enabled_obs():
+    reg = obs.configure(enabled=True, registry=MetricsRegistry())
+    try:
+        yield reg
+    finally:
+        obs.configure(enabled=False, registry=MetricsRegistry())
+
+
+def random_bits(n, b, seed):
+    rng = np.random.default_rng(seed)
+    return ["".join(rng.choice(["0", "1"], n)) for _ in range(b)]
+
+
+def sweep_circuits(qubits=6, depth=4, prefix=3, settings=2, seed=7):
+    """Deterministic: same arguments → value-identical circuits, so a
+    'cold' and a 'warm' leg can bind separate copies."""
+    return brickwork_sweep(
+        qubits, depth, prefix, settings, np.random.default_rng(seed)
+    )
+
+
+# ---------------------------------------------------------------------------
+# store mechanics
+
+
+class TestIntermediateStore:
+    def test_byte_budget_lru_eviction(self):
+        # room for exactly 4 entries of 100 complex128
+        store = IntermediateStore(max_bytes=4 * 100 * 16)
+        arrs = {
+            f"k{i}": np.full(100, i, dtype=np.complex128) for i in range(6)
+        }
+        for k, a in arrs.items():
+            store.put(k, a)
+        st = store.stats()
+        assert st["evicted"] == 2
+        assert st["entries"] == 4
+        assert st["bytes_held"] == 4 * 100 * 16
+        # oldest two fell off, newest four resident
+        assert store.get("k0") is None and store.get("k1") is None
+        for k in ("k2", "k3", "k4", "k5"):
+            assert np.array_equal(store.get(k), arrs[k])
+
+    def test_get_refreshes_lru_order(self):
+        store = IntermediateStore(max_bytes=3 * 100 * 16)
+        arrs = {
+            f"k{i}": np.full(100, i, dtype=np.complex128) for i in range(3)
+        }
+        for k, a in arrs.items():
+            store.put(k, a)
+        assert store.get("k0") is not None  # k0 now most-recent
+        store.put("k3", np.full(100, 3, dtype=np.complex128))
+        assert store.get("k1") is None  # k1 was the LRU victim
+        assert store.get("k0") is not None
+
+    def test_spill_survives_memory_clear(self, tmp_path):
+        store = IntermediateStore(directory=tmp_path, max_bytes=1 << 20)
+        a = np.arange(64, dtype=np.complex128).reshape(8, 8)
+        store.put("node-a", a)
+        store.clear_memory()
+        assert len(store) == 0
+        got = store.get("node-a")
+        assert np.array_equal(got, a)
+        # the disk hit promoted the value back to the memory tier
+        assert len(store) == 1
+
+    def test_corrupt_spill_is_deleted_and_recontracted(self, tmp_path):
+        store = IntermediateStore(directory=tmp_path, max_bytes=1 << 20)
+        a = np.arange(16, dtype=np.complex128)
+        store.put("node-a", a)
+        store.clear_memory()
+        path = store._spill_path("node-a")
+        path.write_bytes(b"this is not an npz archive")
+        assert store.get("node-a") is None  # miss, not a crash
+        assert not path.exists()  # poison pill removed
+        st = store.stats()
+        assert st["corrupt"] == 1 and st["miss"] == 1
+
+    def test_stale_spill_under_wrong_key_rejected(self, tmp_path):
+        # a valid archive parked under the WRONG key (botched rename,
+        # colliding replica): the embedded key/digest check must refuse
+        # to serve it as node-b's value
+        store = IntermediateStore(directory=tmp_path, max_bytes=1 << 20)
+        store.put("node-a", np.arange(16, dtype=np.complex128))
+        shutil.copy(store._spill_path("node-a"), store._spill_path("node-b"))
+        store.clear_memory()
+        assert store.get("node-b") is None
+        assert not store._spill_path("node-b").exists()
+        assert store.stats()["corrupt"] == 1
+        # the correctly-keyed entry is untouched
+        assert store.get("node-a") is not None
+
+    def test_truncated_spill_rejected(self, tmp_path):
+        store = IntermediateStore(directory=tmp_path, max_bytes=1 << 20)
+        store.put("node-a", np.arange(256, dtype=np.complex128))
+        store.clear_memory()
+        path = store._spill_path("node-a")
+        path.write_bytes(path.read_bytes()[:100])
+        assert store.get("node-a") is None
+        assert store.stats()["corrupt"] == 1
+
+    def test_concurrent_writers_one_directory(self, tmp_path):
+        # four stores (≈ four service replicas) hammer one spill
+        # directory; every successful read must be the true value
+        arrs = {
+            f"k{i}": np.full(32, i * 1.5, dtype=np.complex128)
+            for i in range(8)
+        }
+        stores = [
+            IntermediateStore(directory=tmp_path, max_bytes=1 << 20)
+            for _ in range(4)
+        ]
+        errors = []
+
+        def worker(store, seed):
+            rng = np.random.default_rng(seed)
+            try:
+                for _ in range(60):
+                    k = f"k{rng.integers(8)}"
+                    if rng.random() < 0.5:
+                        store.put(k, arrs[k])
+                    else:
+                        got = store.get(k)
+                        if got is not None and not np.array_equal(
+                            got, arrs[k]
+                        ):
+                            errors.append(f"wrong value for {k}")
+            except Exception as exc:  # noqa: BLE001 — surface in main
+                errors.append(f"{type(exc).__name__}: {exc}")
+
+        threads = [
+            threading.Thread(target=worker, args=(s, i))
+            for i, s in enumerate(stores)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errors == []
+        # the shared directory ends fully readable by a fresh store
+        fresh = IntermediateStore(directory=tmp_path, max_bytes=1 << 20)
+        for k, a in arrs.items():
+            got = fresh.get(k)
+            if got is not None:
+                assert np.array_equal(got, a)
+
+    def test_disk_budget_evicts_lru_spills(self, tmp_path):
+        one = np.zeros(512, dtype=np.complex128)
+        probe = IntermediateStore(
+            directory=tmp_path / "probe", max_bytes=1 << 20
+        )
+        probe.put("p", one)
+        size = probe._spill_path("p").stat().st_size
+        store = IntermediateStore(
+            directory=tmp_path / "real", max_bytes=1 << 20,
+            max_disk_bytes=int(2.5 * size),
+        )
+        for i in range(6):
+            store.put(f"k{i}", one)
+        spills = list((tmp_path / "real").glob("*.npz"))
+        assert 0 < len(spills) <= 2
+        assert store.stats()["evicted"] >= 4
+
+    def test_admission_cost_model(self):
+        model = CalibratedCostModel(
+            flops_per_s=1e9, dispatch_s=1e-6, bytes_per_s=1e10
+        )
+        store = IntermediateStore(cost_model=model, store_margin=2.0)
+        # expensive subtree, small output: recontraction dwarfs reload
+        assert store.admit(
+            flops=1e9, nbytes=1e6, n_steps=10, out_nbytes=1024
+        )
+        # trivial subtree, huge output: cheaper to recontract than to
+        # stream the stored value back
+        assert not store.admit(
+            flops=100.0, nbytes=64.0, n_steps=1, out_nbytes=1 << 24
+        )
+
+    def test_admission_flop_floor_without_model(self):
+        store = IntermediateStore(min_flops=1000.0)
+        assert not store.admit(flops=10.0, nbytes=0.0)
+        assert store.admit(flops=1e6, nbytes=0.0)
+
+
+# ---------------------------------------------------------------------------
+# numeric transparency: prefix-reused == cold, per backend
+
+
+def _sweep_amps(store, backend, qubits=6, depth=4, target_size=None):
+    """Bind every sweep setting (optionally through ``store``) and
+    return the stacked amplitude batches."""
+    bits = random_bits(qubits, 3, seed=11)
+    out = []
+    for circ in sweep_circuits(qubits=qubits, depth=depth):
+        bound = bind_circuit(
+            circ, target_size=target_size, reuse_store=store
+        )
+        out.append(np.asarray(bound.amplitudes_det(bits, backend)))
+    return np.stack(out)
+
+
+class TestPrefixReuseNumerics:
+    @pytest.mark.parametrize(
+        "make_backend",
+        [
+            pytest.param(lambda: NumpyBackend(), id="numpy"),
+            pytest.param(
+                lambda: JaxBackend(dtype="complex64", donate=False),
+                id="jax-c64",
+            ),
+            pytest.param(
+                lambda: JaxBackend(dtype="complex128", donate=False),
+                id="jax-c128",
+            ),
+        ],
+    )
+    def test_warm_bitwise_equals_cold(self, make_backend):
+        backend = make_backend()
+        cold = _sweep_amps(None, backend)
+        store = IntermediateStore(max_bytes=1 << 26)
+        warm = _sweep_amps(store, backend)
+        # bit-equality, not allclose: the residual executes the exact
+        # PairSteps of the cold program, on the exact prefix buffers
+        assert np.array_equal(cold, warm)
+        st = store.stats()
+        assert st["store"] > 0 and st["miss"] > 0
+        # the second setting's shared prefix came from the store
+        assert st["hit"] > 0 and st["prefix_flops_saved"] > 0
+        # a warm repeat of the whole sweep contracts nothing new
+        miss_before = st["miss"]
+        warm2 = _sweep_amps(store, backend)
+        assert np.array_equal(cold, warm2)
+        assert store.stats()["miss"] == miss_before
+
+    def test_split_complex_allclose_only(self):
+        # split-complex is the documented exception: XLA fuses the
+        # single cold program and the node-program + residual pipeline
+        # differently, so float32 rounding differs across the jit
+        # boundary — same distance from the f64 oracle, not bit-equal
+        backend = JaxBackend(
+            dtype="complex64", split_complex=True, donate=False
+        )
+        cold = _sweep_amps(None, backend)
+        warm = _sweep_amps(IntermediateStore(max_bytes=1 << 26), backend)
+        np.testing.assert_allclose(cold, warm, rtol=1e-5, atol=1e-6)
+        oracle = _sweep_amps(None, NumpyBackend())
+        np.testing.assert_allclose(cold, oracle, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(warm, oracle, rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.parametrize(
+        "make_backend",
+        [
+            pytest.param(lambda: NumpyBackend(), id="numpy"),
+            pytest.param(
+                lambda: JaxBackend(dtype="complex64", donate=False),
+                id="jax-c64",
+            ),
+        ],
+    )
+    def test_sliced_warm_bitwise_equals_cold(self, make_backend):
+        # target_size=2**5 slices the 8-qubit depth-5 brickwork into 16
+        # slices; the volatile set then includes the sliced leaves and
+        # the prefix split works on the sliced program
+        backend = make_backend()
+        cold = _sweep_amps(None, backend, qubits=8, depth=5,
+                           target_size=2**5)
+        store = IntermediateStore(max_bytes=1 << 26)
+        warm = _sweep_amps(store, backend, qubits=8, depth=5,
+                           target_size=2**5)
+        assert np.array_equal(cold, warm)
+        assert store.stats()["hit"] > 0
+
+    def test_store_shared_across_backends_is_isolated(self):
+        # one store serving a numpy and a jax c64 binding: environment
+        # keys keep the tiers separate — a float32 value must never be
+        # served to the complex128 path
+        store = IntermediateStore(max_bytes=1 << 26)
+        np_cold = _sweep_amps(None, NumpyBackend())
+        np_warm = _sweep_amps(store, NumpyBackend())
+        jx = JaxBackend(dtype="complex64", donate=False)
+        jx_cold = _sweep_amps(None, jx)
+        jx_warm = _sweep_amps(store, jx)
+        assert np.array_equal(np_cold, np_warm)
+        assert np.array_equal(jx_cold, jx_warm)
+
+
+# ---------------------------------------------------------------------------
+# queue-level dedup
+
+
+class TestQueueDedup:
+    def test_duplicate_amplitude_riders_collapse(self):
+        circuit = sweep_circuits(qubits=5, depth=3)[0]
+        with ContractionService.from_circuit(
+            circuit, max_batch=16, max_wait_ms=100.0
+        ) as svc:
+            bits = random_bits(5, 4, seed=1)
+            oracle = {b: svc.amplitude(b) for b in bits}
+            futs = [svc.submit(bits[i % 4]) for i in range(16)]
+            results = [f.result(timeout=120) for f in futs]
+            for i, r in enumerate(results):
+                # fan-out restores per-request results exactly
+                assert r == oracle[bits[i % 4]]
+            assert svc.stats()["counts"]["deduped"] >= 1
+
+    def test_expectation_riders_collapse_sample_riders_do_not(self):
+        circuit = sweep_circuits(qubits=5, depth=3)[0]
+        with ContractionService.from_circuit(
+            circuit, queries=True, max_batch=16, max_wait_ms=100.0
+        ) as svc:
+            # warm each kind so the burst co-batches
+            svc.expectation("zzzzz")
+            svc.sample(1, seed=0)
+
+            futs = [
+                svc.submit_query("expectation", "xixiz") for _ in range(6)
+            ]
+            vals = [f.result(timeout=120) for f in futs]
+            assert len(set(vals)) == 1
+            deduped = svc.stats()["counts"]["deduped"]
+            assert deduped >= 1
+
+            # identical sample payloads must NOT collapse: seed=None
+            # requests draw independently
+            futs = [
+                svc.submit_query(
+                    "sample", {"n_samples": 2, "seed": None}
+                )
+                for _ in range(6)
+            ]
+            for f in futs:
+                f.result(timeout=120)
+            assert svc.stats()["counts"]["deduped"] == deduped
+
+
+# ---------------------------------------------------------------------------
+# stats + metrics surface
+
+
+class TestReuseMetrics:
+    def test_stats_and_prometheus_surface(self, enabled_obs, tmp_path):
+        store = IntermediateStore(max_bytes=1 << 26)
+        cache = PlanCache(tmp_path)
+        circuit = sweep_circuits(qubits=5, depth=3)[0]
+        with ContractionService.from_circuit(
+            circuit, plan_cache=cache, reuse_store=store,
+            max_batch=8, max_wait_ms=20.0,
+        ) as svc:
+            bits = random_bits(5, 2, seed=2)
+            svc.amplitude(bits[0])
+            futs = [svc.submit(bits[i % 2]) for i in range(8)]
+            for f in futs:
+                f.result(timeout=120)
+            stats = svc.stats()
+            assert stats["counts"]["deduped"] >= 1
+            assert "reuse" in stats and "plan_cache" in stats
+            ru = stats["reuse"]
+            assert ru["store"] > 0
+            assert ru["bytes_held"] > 0 and ru["entries"] > 0
+            pc = stats["plan_cache"]["counts"]
+            assert pc["miss"] >= 1 and pc["store"] >= 1
+
+            text = render_prometheus(
+                obs.get_registry(), svc._prometheus_families()
+            )
+            parsed = parse_prometheus(text)
+            assert parsed["tnc_tpu_serve_dedup_collapsed_total"] >= 1
+            assert (
+                parsed['tnc_tpu_serve_reuse_total{event="store"}'] > 0
+            )
+            assert parsed["tnc_tpu_serve_reuse_bytes_held"] > 0
+            assert parsed["tnc_tpu_serve_reuse_entries"] > 0
+            assert (
+                parsed['tnc_tpu_serve_plan_cache_total{event="miss"}'] >= 1
+            )
+
+    def test_store_counters_reach_obs_registry(self, enabled_obs):
+        store = IntermediateStore(max_bytes=1 << 20)
+        store.put("k", np.zeros(8, dtype=np.complex128))
+        assert store.get("k") is not None
+        assert store.get("absent") is None
+        names = set(obs.counters_by_prefix("serve.reuse."))
+        assert any(n.startswith("serve.reuse.store") for n in names)
+        assert any(n.startswith("serve.reuse.hit") for n in names)
+        assert any(n.startswith("serve.reuse.miss") for n in names)

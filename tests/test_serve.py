@@ -524,7 +524,10 @@ class TestService:
         backend = SlowBackend(delay_s=0.05)
         svc = self._service(backend=backend, max_batch=8, max_wait_ms=100.0)
         try:
-            futs = [svc.submit("00000") for _ in range(6)]
+            # distinct bits: identical riders would collapse via queue
+            # dedup and never grow the dispatched batch
+            bits = random_bits(5, 6, 23)
+            futs = [svc.submit(b) for b in bits]
             [f.result(timeout=30) for f in futs]
         finally:
             svc.stop()

@@ -77,21 +77,62 @@ def brickwork_circuit(
     ``scripts/serve_smoke.py`` (one recipe, so the smoke validates the
     same structure the perf record measures). Deterministic in ``rng``:
     same generator state → identical structure AND gate values."""
+    angles = [
+        [float(rng.uniform(0, 3)) for _ in range(qubits)]
+        for _ in range(depth)
+    ]
+    return brickwork_from_angles(qubits, angles)
+
+
+def brickwork_from_angles(
+    qubits: int, round_angles: list[list[float]]
+) -> Circuit:
+    """The brickwork recipe with explicit per-round Rz angles —
+    :func:`brickwork_circuit`'s builder, exposed so sweep workloads can
+    pin a shared angle prefix across settings."""
     circuit = Circuit()
     qr = circuit.allocate_register(qubits)
     for q in range(qubits):
         circuit.append_gate(TensorData.gate("h"), [qr.qubit(q)])
-    for d in range(depth):
+    for d, angles in enumerate(round_angles):
         for q in range(qubits):
             circuit.append_gate(
-                TensorData.gate("rz", (float(rng.uniform(0, 3)),)),
-                [qr.qubit(q)],
+                TensorData.gate("rz", (angles[q],)), [qr.qubit(q)]
             )
         for q in range(d % 2, qubits - 1, 2):
             circuit.append_gate(
                 TensorData.gate("cx"), [qr.qubit(q), qr.qubit(q + 1)]
             )
     return circuit
+
+
+def brickwork_sweep(
+    qubits: int,
+    depth: int,
+    prefix_depth: int,
+    settings: int,
+    rng: np.random.Generator,
+) -> list[Circuit]:
+    """``settings`` brickwork angle settings of one ansatz sharing the
+    first ``prefix_depth`` rounds' angles — the parameter-sweep serving
+    workload (``BENCH_SERVE_SWEEP=angles:N``,
+    ``scripts/reuse_smoke.py``): every circuit's contraction tree
+    contains the same-valued prefix subtrees, so a cross-request
+    :class:`~tnc_tpu.serve.reuse.IntermediateStore` contracts them once
+    store-wide. Deterministic in ``rng``."""
+    prefix_depth = max(0, min(int(prefix_depth), int(depth)))
+    prefix = [
+        [float(rng.uniform(0, 3)) for _ in range(qubits)]
+        for _ in range(prefix_depth)
+    ]
+    out = []
+    for _ in range(max(int(settings), 1)):
+        suffix = [
+            [float(rng.uniform(0, 3)) for _ in range(qubits)]
+            for _ in range(depth - prefix_depth)
+        ]
+        out.append(brickwork_from_angles(qubits, prefix + suffix))
+    return out
 
 
 def random_circuit(

@@ -65,6 +65,10 @@ class SampleQueryHandler:
     # batch size — measured seconds per batch-size bucket are not
     # comparable, so the SLO drift detector must not track this kind
     drift_stable = False
+    # stochastic: two requests with equal payloads but distinct seeds
+    # (or seed=None) must draw independently — the dispatcher's
+    # queue-level dedup never collapses sample riders
+    dedup_payloads = False
 
     def __init__(self, sampler: ChainSampler) -> None:
         self.sampler = sampler
@@ -105,6 +109,10 @@ class ExpectationQueryHandler:
     # batch (plus a compile per new unique-count bucket) — not
     # drift-comparable per batch-size bucket
     drift_stable = False
+    # deterministic in the payload (normalized term tuples are
+    # hashable): identical riders in one window collapse to a single
+    # dispatch entry
+    dedup_payloads = True
 
     def __init__(
         self,
@@ -160,6 +168,9 @@ class MarginalQueryHandler:
     # one structure per mask, work linear in batch rows: batch-size
     # buckets see comparable seconds — drift tracking is meaningful
     drift_stable = True
+    # deterministic in the (string) pattern: safe to collapse
+    # identical riders queue-level
+    dedup_payloads = True
 
     def __init__(
         self,

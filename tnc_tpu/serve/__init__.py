@@ -15,6 +15,12 @@ The serving pipeline, front to back:
 - :class:`PlanCache` (``plancache.py``) — persistent, LRU-bounded
   ``{path, slicing, hoist split, executor config}`` store keyed by a
   stable structure digest; repeat circuits skip the planner entirely.
+- :class:`IntermediateStore` / :func:`compute_split` (``reuse.py``) —
+  cross-request numeric reuse: value-aware subtree digests split every
+  bound plan into a content-addressed cached prefix (contracted once
+  store-wide, LRU memory + atomic npz host tiers, cost-model
+  admission) plus a per-request residual; the service dispatcher
+  additionally collapses duplicate queue riders into one dispatch.
 - :class:`BackgroundReplanner` (``replan.py``) — anytime improvement:
   cache misses serve from a fast greedy plan, a low-priority worker
   hyper-optimizes hot structures between requests and atomically swaps
@@ -42,9 +48,15 @@ from tnc_tpu.serve.rebind import (  # noqa: F401
     BoundProgram,
     bind_circuit,
     bind_template,
+    plan_signature,
     plan_structure,
     stacked_bras,
     thread_batch,
+)
+from tnc_tpu.serve.reuse import (  # noqa: F401
+    IntermediateStore,
+    ReuseBinding,
+    compute_split,
 )
 from tnc_tpu.serve.multihost import (  # noqa: F401
     ClusterDispatcher,

@@ -112,6 +112,29 @@ class PlanCache:
         # replanner reads to pick which entries deserve hyper-time
         self._hits: dict[str, int] = {}
         self._hits_lock = threading.Lock()
+        # process-local event counters mirroring the obs families —
+        # stats() and the service's /metrics surface read these, so
+        # cache efficacy is observable with obs tracing off
+        self._counts = {
+            k: 0
+            for k in (
+                "hit", "miss", "store", "evicted", "corrupt",
+                "invalidated", "store_failed",
+            )
+        }
+
+    def _count(self, key: str) -> None:
+        with self._hits_lock:
+            self._counts[key] = self._counts.get(key, 0) + 1
+        obs.counter_add(f"serve.plan_cache.{key}")
+
+    def stats(self) -> dict:
+        """Process-local cache efficacy: event counts (hit / miss /
+        store / evicted / corrupt / invalidated / store_failed) plus
+        the current on-disk entry count."""
+        with self._hits_lock:
+            counts = dict(self._counts)
+        return {"counts": counts, "entries": len(self)}
 
     def _path(self, key: str) -> Path:
         return self.directory / f"{key}.json"
@@ -201,21 +224,21 @@ class PlanCache:
             ):
                 raise ValueError(f"unusable plan entry: {plan!r:.80}")
         except FileNotFoundError:
-            obs.counter_add("serve.plan_cache.miss")
+            self._count("miss")
             return None
         except Exception as exc:  # noqa: BLE001 — any corruption → replan
             logger.warning(
                 "plan cache entry %s unreadable (%s: %s); dropping it",
                 target, type(exc).__name__, exc,
             )
-            obs.counter_add("serve.plan_cache.corrupt")
-            obs.counter_add("serve.plan_cache.miss")
+            self._count("corrupt")
+            self._count("miss")
             try:
                 target.unlink(missing_ok=True)
             except OSError:
                 pass
             return None
-        obs.counter_add("serve.plan_cache.hit")
+        self._count("hit")
         with self._hits_lock:
             self._hits[key] = self._hits.get(key, 0) + 1
         try:  # LRU touch: mtime records last use
@@ -287,13 +310,13 @@ class PlanCache:
                 "plan cache store of %s failed (%s: %s); serving from "
                 "the in-memory plan", target, type(exc).__name__, exc,
             )
-            obs.counter_add("serve.plan_cache.store_failed")
+            self._count("store_failed")
             try:  # don't strand the partial temp file
                 tmp.unlink(missing_ok=True)
             except OSError:
                 pass
             return
-        obs.counter_add("serve.plan_cache.store")
+        self._count("store")
         self._evict()
 
     def invalidate(self, key: str) -> None:
@@ -303,7 +326,7 @@ class PlanCache:
             pass
         with self._hits_lock:
             self._hits.pop(key, None)
-        obs.counter_add("serve.plan_cache.invalidated")
+        self._count("invalidated")
 
     def _entries(self) -> list[Path]:
         return [
@@ -332,7 +355,7 @@ class PlanCache:
         for victim in entries[: len(entries) - self.max_entries]:
             try:
                 victim.unlink(missing_ok=True)
-                obs.counter_add("serve.plan_cache.evicted")
+                self._count("evicted")
                 logger.info("plan cache evicted %s (LRU)", victim.name)
             except OSError:
                 continue

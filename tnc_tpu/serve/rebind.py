@@ -139,6 +139,11 @@ class BoundProgram:
     # HBM-constrained structures carry a sliced plan: each request runs
     # the slice loop (stacked dispatch; the batch leg stops here)
     sliced: Any = None  # SlicedProgram | None
+    # cross-request reuse (bind_template(..., reuse_store=)): `program`
+    # is then the per-request RESIDUAL and the cached-subtree inputs are
+    # materialized per backend environment from the content-addressed
+    # store (see tnc_tpu.serve.reuse)
+    reuse: Any = None  # ReuseBinding | None
     # device-resident bitstring-invariant leaves, keyed by
     # (dtype, device): staged once, reused by every threaded-jax
     # dispatch — only the (B, n_det, 2) bras transfer per batch
@@ -148,9 +153,20 @@ class BoundProgram:
     def result_shape(self) -> tuple[int, ...]:
         return tuple(self.program.result_shape)
 
-    def _batch_buffers(self, batch_bits: Sequence[str]) -> list[np.ndarray]:
+    def _serving_arrays(self, backend) -> list[np.ndarray]:
+        """The request-invariant input arrays for ``backend``: the bound
+        leaf data, or — under cross-request reuse — the residual's
+        inputs with cached subtrees materialized (store-first) for this
+        backend's numeric environment."""
+        if self.reuse is None:
+            return self.arrays
+        return self.reuse.arrays_for(backend)
+
+    def _batch_buffers(
+        self, batch_bits: Sequence[str], arrays: Sequence[np.ndarray]
+    ) -> list[np.ndarray]:
         bras = stacked_bras(batch_bits)  # (B, n_det, 2)
-        buffers = list(self.arrays)
+        buffers = list(arrays)
         for i, slot in enumerate(self.bra_slots):
             buffers[slot] = np.ascontiguousarray(bras[:, i])
         return buffers
@@ -196,6 +212,7 @@ class BoundProgram:
             )
         if not batch_bits:
             return np.zeros((0,) + self.result_shape, dtype=np.complex128)
+        arrays = self._serving_arrays(backend)
         if not self.bra_slots:
             # fully-open template: every request is the same statevector
             if self.sliced is not None:
@@ -205,14 +222,14 @@ class BoundProgram:
                 # (the root adds one partial per host)
                 kw = {} if slice_range is None else {"slice_range": slice_range}
                 out = np.asarray(
-                    backend.execute_sliced(self.sliced, list(self.arrays), **kw)
+                    backend.execute_sliced(self.sliced, list(arrays), **kw)
                 )
             else:
                 out = np.asarray(
-                    backend.execute(self.program, list(self.arrays))
+                    backend.execute(self.program, list(arrays))
                 )
             return np.broadcast_to(out, (len(batch_bits),) + out.shape).copy()
-        buffers = self._batch_buffers(batch_bits)
+        buffers = self._batch_buffers(batch_bits, arrays)
         b = len(batch_bits)
 
         if self.sliced is not None:
@@ -268,7 +285,7 @@ class BoundProgram:
                         s: buf
                         for s, buf in enumerate(
                             place_buffers(
-                                self.arrays, backend.dtype, False,
+                                arrays, backend.dtype, False,
                                 backend.device,
                             )
                         )
@@ -298,6 +315,19 @@ class BoundProgram:
             lambda per: backend.execute(self.program, per),
             buffers, self.bra_slots, b, self.result_shape,
         )
+
+
+def plan_signature(bound: BoundProgram) -> str:
+    """The *plan* identity of a bound structure: the pre-split program's
+    signature digest. Under cross-request reuse ``bound.program`` is the
+    residual — whose signature depends on the store split, not just the
+    plan — so replanner/watcher identity checks go through here.
+
+    >>> # cold bindings: identical to program.signature_digest()
+    """
+    if bound.reuse is not None:
+        return bound.reuse.cold_signature
+    return bound.program.signature_digest()
 
 
 def plan_structure(
@@ -352,6 +382,7 @@ def bind_template(
     pathfinder=None,
     plan_cache=None,
     target_size: float | None = None,
+    reuse_store=None,
 ) -> BoundProgram:
     """Plan (or load a cached plan for) ``template`` and compile it into
     a :class:`BoundProgram`.
@@ -366,6 +397,13 @@ def bind_template(
     planned path exceeds it, the structure is sliced
     (``slice_and_reconfigure``) and the slicing + hoist split persist
     in the plan record; serving then runs the slice loop per request.
+
+    ``reuse_store``: an :class:`~tnc_tpu.serve.reuse.IntermediateStore`
+    — the bound program is split into content-addressed cached
+    subtrees plus a per-request residual; value-identical subtrees
+    (shared circuit prefixes across an angle sweep) are contracted
+    once store-wide and reloaded by every later binding. Results stay
+    bit-identical to the cold path.
     """
     from tnc_tpu.contractionpath.contraction_path import ContractionPath
 
@@ -432,9 +470,24 @@ def bind_template(
             valid = False
         if not valid:
             plan_cache.invalidate(key)
-            return bind_template(template, pathfinder, plan_cache, target_size)
+            return bind_template(
+                template, pathfinder, plan_cache, target_size, reuse_store
+            )
 
     arrays = [leaf.data.into_data() for leaf in leaves]
+    reuse = None
+    if reuse_store is not None and bra_slots:
+        from tnc_tpu.serve.reuse import ReuseBinding, compute_split
+
+        split = compute_split(program, arrays, bra_slots, sliced=sliced)
+        if split is not None:
+            reuse = ReuseBinding(
+                split, reuse_store, arrays, program.signature_digest()
+            )
+            program = split.residual
+            sliced = split.residual_sliced
+            bra_slots = split.bra_slots
+            arrays = split.placeholder_arrays(reuse.base_arrays)
     flags, threadable = thread_batch(program, bra_slots)
     return BoundProgram(
         template=template,
@@ -446,6 +499,7 @@ def bind_template(
         plan=plan,
         sliced=sliced,
         target_size=target_size,
+        reuse=reuse,
     )
 
 
@@ -455,10 +509,11 @@ def bind_circuit(
     pathfinder=None,
     plan_cache=None,
     target_size: float | None = None,
+    reuse_store=None,
 ) -> BoundProgram:
     """``into_amplitude_template`` + :func:`bind_template` in one call
     (consumes ``circuit``, finalizer semantics)."""
     return bind_template(
         circuit.into_amplitude_template(mask), pathfinder, plan_cache,
-        target_size,
+        target_size, reuse_store,
     )

@@ -68,7 +68,11 @@ from tnc_tpu.contractionpath.contraction_cost import (
 )
 from tnc_tpu.contractionpath.contraction_path import ContractionPath
 from tnc_tpu.ops.program import flat_leaf_tensors
-from tnc_tpu.serve.rebind import bind_template, plan_structure
+from tnc_tpu.serve.rebind import (
+    bind_template,
+    plan_signature,
+    plan_structure,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -174,12 +178,10 @@ class SharedCacheWatcher:
         # of being silently dropped until some future publish
         bound = self.service.bound
         new_bound = bind_template(
-            bound.template, None, self.plan_cache, bound.target_size
+            bound.template, None, self.plan_cache, bound.target_size,
+            bound.reuse.store if bound.reuse is not None else None,
         )
-        if (
-            new_bound.program.signature_digest()
-            == bound.program.signature_digest()
-        ):
+        if plan_signature(new_bound) == plan_signature(bound):
             # same plan re-published (or our own write): nothing to adopt
             self._seen = fp
             self.stats["skips"] += 1
@@ -442,9 +444,10 @@ class BackgroundReplanner:
         # rebuild the in-memory BoundProgram through the normal
         # cache-hit path (zero pathfinding) and stage the swap
         new_bound = bind_template(
-            bound.template, None, self.plan_cache, bound.target_size
+            bound.template, None, self.plan_cache, bound.target_size,
+            bound.reuse.store if bound.reuse is not None else None,
         )
-        if new_bound.program.signature_digest() != program.signature_digest():
+        if plan_signature(new_bound) != program.signature_digest():
             # the store was best-effort and evidently did not stick
             # (disk full, cache dir gone): the rebuild fell back to a
             # fresh default plan, which is NOT the improvement we

@@ -197,7 +197,12 @@ class ContractionService:
             "submitted": 0, "completed": 0, "failed": 0,
             "expired": 0, "rejected": 0, "cancelled": 0,
             "batches": 0, "degraded_batches": 0, "plan_swaps": 0,
+            "deduped": 0,
         }
+        # observability-only references, set by from_circuit (or by the
+        # owner directly): surfaced in stats() and /metrics
+        self._plan_cache = None
+        self.reuse_store = None
         self._batch_sizes: deque[int] = deque(maxlen=_STATS_CAP)
         # bounded streaming percentiles (p50/p90/p99 without retained
         # samples) — the SAME objects back stats() and /metrics, so the
@@ -247,6 +252,7 @@ class ContractionService:
         plan_cache=None,
         backend=None,
         target_size=None,
+        reuse_store=None,
         background_replan: bool = False,
         replan_options: dict | None = None,
         shared_cache_watch: bool = False,
@@ -294,8 +300,12 @@ class ContractionService:
             raise ValueError("shared_cache_watch requires a plan_cache")
         query_circuit = circuit.copy() if queries else None
         approx_circuit = circuit.copy() if approx else None
-        bound = bind_circuit(circuit, mask, pathfinder, plan_cache, target_size)
+        bound = bind_circuit(
+            circuit, mask, pathfinder, plan_cache, target_size, reuse_store
+        )
         svc = cls(bound, backend=backend, **kwargs)
+        svc._plan_cache = plan_cache
+        svc.reuse_store = reuse_store
         svc.start()
         try:
             if queries:
@@ -836,6 +846,35 @@ class ContractionService:
         obs.observe("serve.batch_size", len(group))
         obs.observe("serve.query.batch_size", len(group), type=kind)
         payloads = [req.bits for req in group]
+        # queue-level dedup: identical riders inside one batch window
+        # collapse to a single dispatch entry, the result fanned out
+        # (copied) to every future. Deterministic kinds only —
+        # amplitudes always, query handlers that opt in via
+        # `dedup_payloads` (sampling is stochastic and never collapses)
+        fan = None
+        handler = self._handlers.get(kind)
+        if len(group) > 1 and (
+            kind == "amplitude" or getattr(handler, "dedup_payloads", False)
+        ):
+            try:
+                index_of: dict = {}
+                fan = [index_of.setdefault(p, len(index_of)) for p in payloads]
+            except TypeError:  # unhashable payload shape: no dedup
+                fan = None
+            else:
+                if len(index_of) == len(payloads):
+                    fan = None
+                else:
+                    unique: list = [None] * len(index_of)
+                    for p, j in index_of.items():
+                        unique[j] = p
+                    collapsed = len(payloads) - len(unique)
+                    payloads = unique
+                    with self._lock:
+                        self._counts["deduped"] += collapsed
+                    obs.counter_add(
+                        "serve.reuse.dedup", float(collapsed), kind=kind
+                    )
         riders = ",".join(f"r{req.rid}" for req in group)
         t0 = time.monotonic()
         try:
@@ -846,11 +885,19 @@ class ContractionService:
                 "serve.dispatch",
                 batch=len(group), kind=kind, riders=riders,
                 generation=generation,
+                collapsed=len(group) - len(payloads),
             ):
                 results = self.retry_policy.run(
                     lambda: self._dispatch_group(kind, payloads, bound),
                     label="serve.dispatch",
                 )
+            if fan is not None:
+                # copies per rider: co-riders of one collapsed payload
+                # must never alias one mutable result object
+                results = [
+                    np.array(r) if isinstance(r, np.ndarray) else r
+                    for r in (results[j] for j in fan)
+                ]
         except Exception as exc:  # noqa: BLE001 — degrade to singletons
             logger.warning(
                 "%s batch of %d failed (%s: %s); degrading to singleton "
@@ -1214,9 +1261,23 @@ class ContractionService:
             "by_type": by_type,
             "by_tier": by_tier,
         }
+        store = self._effective_reuse_store()
+        if store is not None:
+            out["reuse"] = store.stats()
+        if self._plan_cache is not None:
+            out["plan_cache"] = self._plan_cache.stats()
         if self._slo is not None:
             out["slo"] = self._slo.stats()
         return out
+
+    def _effective_reuse_store(self):
+        """The intermediate-tensor store serving this service's bound
+        program (attached via from_circuit, or carried by a bound built
+        directly with ``bind_template(..., reuse_store=)``)."""
+        if self.reuse_store is not None:
+            return self.reuse_store
+        reuse = getattr(self.bound, "reuse", None)
+        return reuse.store if reuse is not None else None
 
     # -- live telemetry endpoint -------------------------------------------
 
@@ -1299,6 +1360,36 @@ class ContractionService:
              counts["degraded_batches"])
         )
         fams.append(("counter", "serve.plan_swaps", {}, counts["plan_swaps"]))
+        fams.append(
+            ("counter", "serve.dedup_collapsed", {}, counts["deduped"])
+        )
+        # cross-request reuse + plan-cache efficacy: the same counters
+        # stats() reports, as labeled families (hit/miss/evict/... as
+        # {event=} so rates are one PromQL expression away)
+        store = self._effective_reuse_store()
+        if store is not None:
+            reuse_stats = store.stats()
+            for key in store.COUNT_KEYS:
+                fams.append(
+                    ("counter", "serve.reuse", {"event": key},
+                     reuse_stats[key])
+                )
+            fams.append(
+                ("gauge", "serve.reuse.bytes_held", {},
+                 reuse_stats["bytes_held"])
+            )
+            fams.append(
+                ("gauge", "serve.reuse.entries", {}, reuse_stats["entries"])
+            )
+            fams.append(
+                ("counter", "serve.reuse.prefix_flops_saved", {},
+                 reuse_stats["prefix_flops_saved"])
+            )
+        if self._plan_cache is not None:
+            for key, value in self._plan_cache.stats()["counts"].items():
+                fams.append(
+                    ("counter", "serve.plan_cache", {"event": key}, value)
+                )
 
         def summary(name: str, labels: dict, block: dict, total: float):
             for q, qlabel in (("p50", "0.5"), ("p90", "0.9"), ("p99", "0.99")):
