@@ -2281,7 +2281,68 @@ def _serve_bench() -> dict:
         f"[bench]   slo: drift_max_ratio {slo_block['drift_max_ratio']}, "
         f"alerts {slo_block['alerts'] or 'none'}"
     )
+    fleet_block = _serve_fleet_block()
+    if fleet_block is not None:
+        block["fleet"] = fleet_block
+        log(
+            f"[bench]   fleet: {fleet_block['replicas_live']} live / "
+            f"{fleet_block['replicas_stale']} stale replicas, "
+            f"max heartbeat gap {fleet_block['max_heartbeat_gap_s']} s, "
+            f"dispatch attribution {fleet_block['attribution_share']}"
+        )
     return block
+
+
+def _serve_fleet_block() -> dict | None:
+    """``serving.fleet`` block for cluster runs: replica roster health
+    (from the ``BENCH_SERVE_FLEET_DIR`` / ``TNC_TPU_FLEET_DIR``
+    heartbeat registry) and the share of ``serve.dispatch`` wall
+    attributed to rider ids in this process's trace. None on
+    single-process runs with no registry configured — the block only
+    means something when a fleet was involved."""
+    from tnc_tpu import obs
+
+    fleet_dir = os.environ.get("BENCH_SERVE_FLEET_DIR") or os.environ.get(
+        "TNC_TPU_FLEET_DIR"
+    )
+    try:
+        import jax
+
+        n_proc = jax.process_count()
+    except Exception:
+        n_proc = 1
+    if fleet_dir is None and n_proc <= 1:
+        return None
+    out: dict = {
+        "processes": n_proc,
+        "replicas_live": None,
+        "replicas_stale": None,
+        "stale_transitions": 0,
+        "max_heartbeat_gap_s": None,
+        "attribution_share": None,
+        "dispatch_wall_ms": None,
+    }
+    if fleet_dir is not None:
+        try:
+            from tnc_tpu.obs.fleet import FleetRegistry
+
+            roster = FleetRegistry(fleet_dir).roster()
+            out["replicas_live"] = roster["live"]
+            out["replicas_stale"] = roster["stale"]
+            out["stale_transitions"] = roster["transitions"]["went_stale"]
+            ages = [r["age_s"] for r in roster["replicas"]]
+            if ages:
+                out["max_heartbeat_gap_s"] = round(max(ages), 3)
+        except Exception as e:  # registry unreadable ≠ bench failure
+            out["registry_error"] = f"{type(e).__name__}: {e}"
+    if obs.enabled():
+        from tnc_tpu.obs.export import chrome_trace_events, serve_trace_rollup
+
+        rollup = serve_trace_rollup(chrome_trace_events(obs.get_registry()))
+        if rollup["dispatch_wall_ms"] > 0:
+            out["attribution_share"] = rollup["attributed_share"]
+            out["dispatch_wall_ms"] = round(rollup["dispatch_wall_ms"], 3)
+    return out
 
 
 def _emit(record: dict) -> None:

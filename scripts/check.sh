@@ -95,6 +95,9 @@ TNC_TPU_PLATFORM=cpu python scripts/slo_smoke.py
 echo "== approx-tier smoke (chi-ladder error bars vs oracle, forced escalation, tier pricing) =="
 TNC_TPU_PLATFORM=cpu python scripts/approx_smoke.py
 
+echo "== fleet-obs smoke (/fleet counter sums bit-equal, cross-process trace merge >=95% attributed, registry join->stale->reap, SIGKILL flight dump) =="
+TNC_TPU_PLATFORM=cpu python scripts/fleet_obs_smoke.py
+
 echo "== distributed smoke (2-process scatter -> overlapped fan-in -> gather, oracle bit-compare) =="
 python scripts/distributed_smoke.py
 

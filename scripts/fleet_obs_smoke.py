@@ -1,0 +1,295 @@
+#!/usr/bin/env python
+"""Fleet observability smoke for scripts/check.sh: root + one worker
+process on the fleet plane, pinned four ways.
+
+1. **Federated counters**: the root's ``/fleet`` body sums counter
+   families across replicas bit-equal to independently scraping each
+   replica's ``/metrics`` and summing them yourself; worker gauges come
+   back re-keyed with their ``replica=`` label.
+2. **Cross-host trace merge**: the worker's ``serve.dispatch`` span
+   carries rider ids shipped in a :class:`TraceContext`; merging the
+   root's and worker's per-process trace exports yields one timeline
+   whose ``--serve`` rollup attributes >= 95% of dispatch wall to
+   request ids — across both processes.
+3. **Registry lifecycle**: the worker joins the heartbeat registry,
+   is SIGKILL'd, goes stale after the staleness window, and is reaped.
+4. **Flight recorder**: a SIGKILL'd process leaves a parseable
+   postmortem dump (spans + counters) behind — the periodic flush
+   survives a kill no handler ever sees.
+
+Deterministic on CPU: no jax.distributed, plain subprocesses.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+import tnc_tpu.obs as obs  # noqa: E402
+from tnc_tpu.builders.random_circuit import brickwork_circuit  # noqa: E402
+from tnc_tpu.obs.core import MetricsRegistry  # noqa: E402
+from tnc_tpu.obs.export import (  # noqa: E402
+    merge_trace_files,
+    serve_trace_rollup,
+)
+from tnc_tpu.obs.fleet import (  # noqa: E402
+    FleetRegistry,
+    _series_family,
+    _series_without_replica,
+)
+from tnc_tpu.obs.http import parse_prometheus  # noqa: E402
+from tnc_tpu.serve import ContractionService  # noqa: E402
+
+N_QUBITS = 6
+DEPTH = 4
+QUERIES = 12
+
+WORKER_SRC = """
+import json, os, sys, time
+import tnc_tpu.obs as obs
+from tnc_tpu.obs.core import MetricsRegistry
+from tnc_tpu.obs.fleet import FleetRegistry, TraceContext, adopt_trace_context
+from tnc_tpu.obs.http import TelemetryServer
+
+fleet_dir, trace_path, riders = sys.argv[1], sys.argv[2], sys.argv[3]
+obs.configure(enabled=True, registry=MetricsRegistry())
+# the same counter families a serving worker bumps, plus a labeled one
+obs.counter_add("serve.batches", 3)
+obs.counter_add("serve.query.completed", 7, type="amplitude")
+obs.gauge_set("serve.queue.depth", 2)
+# a dispatch span carrying the root's rider ids, as _serve_cluster_loop
+# records it after adopt_trace_context
+ctx = TraceContext(riders=riders, kind="amplitude", generation=1, seq=1)
+with adopt_trace_context(ctx):
+    with obs.span("serve.dispatch", riders=ctx.riders, kind=ctx.kind,
+                  batch=len(riders.split(",")), remote=1):
+        time.sleep(0.05)
+obs.export_chrome_trace(trace_path)
+telemetry = TelemetryServer(
+    registry=obs.get_registry(), port=0, base_labels={"replica": "w1"}
+).start()
+FleetRegistry(fleet_dir, name="w1").heartbeat(
+    {"role": "worker", "url": telemetry.url, "queue_depth": 0}
+)
+print("READY " + telemetry.url, flush=True)
+time.sleep(120)
+"""
+
+FLIGHT_SRC = """
+import sys, time
+import tnc_tpu.obs as obs
+obs.refresh_from_env()
+obs.counter_add("smoke.widgets", 41)
+with obs.span("smoke.outer", stage=1):
+    with obs.span("smoke.inner"):
+        pass
+obs.counter_add("smoke.widgets", 1)
+print("ARMED", flush=True)
+time.sleep(120)
+"""
+
+
+def fetch(url: str) -> str:
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.read().decode("utf-8")
+
+
+def start_worker(fleet_dir: str, trace_path: str, riders: str):
+    proc = subprocess.Popen(
+        [sys.executable, "-c", WORKER_SRC, fleet_dir, trace_path, riders],
+        stdout=subprocess.PIPE, text=True, cwd=REPO,
+        env={**os.environ, "TNC_TPU_PLATFORM": "cpu"},
+    )
+    line = proc.stdout.readline().strip()
+    assert line.startswith("READY "), f"worker never came up: {line!r}"
+    return proc, line.split(" ", 1)[1]
+
+
+def check_federation(svc, worker_url: str) -> None:
+    """Pin 1: /fleet counters == sum of independent per-replica scrapes."""
+    base = svc._telemetry.url
+    body = json.loads(fetch(base + "/fleet"))
+    assert body["enabled"], body
+    assert set(body["replicas"]) >= {"p0", "w1"}, body["replicas"]
+
+    # independent ground truth: scrape both replicas ourselves and sum.
+    # Only the serve.* families are compared bit-equal — traffic is
+    # quiesced so they are static, while fleet.* counters keep moving
+    # (every heartbeat/roster read bumps them between the two scrapes)
+    want: dict[str, float] = {}
+    for text in (fetch(base + "/metrics"), fetch(worker_url + "/metrics")):
+        series_map = parse_prometheus(text)
+        for series in sorted(series_map):
+            fam = _series_family(series)
+            if not (
+                fam.startswith("tnc_tpu_serve_") and fam.endswith("_total")
+            ):
+                continue
+            key = _series_without_replica(series)
+            want[key] = want.get(key, 0.0) + series_map[series]
+    got = body["counters"]
+    mismatches = {
+        k: (got.get(k), want[k]) for k in want if got.get(k) != want[k]
+    }
+    assert not mismatches, f"fleet counter sums diverge: {mismatches}"
+    assert len(want) >= 4, f"too few counter families federated ({len(want)})"
+    # worker families actually contributed (batches: root + worker's 3)
+    assert got["tnc_tpu_serve_batches_total"] >= 3.0, got
+
+    # gauges stay per-replica with replica= labels
+    per_rep = body["per_replica"]
+    assert any('replica="w1"' in k for k in per_rep), per_rep
+    roster = body["roster"]
+    states = {r["name"]: r["state"] for r in roster["replicas"]}
+    assert states.get("w1") == "live", roster
+    print(
+        f"[fleet_obs_smoke] /fleet: {len(want)} counter families bit-equal "
+        f"to per-replica sums across {sorted(body['replicas'])}"
+    )
+
+
+def check_trace_merge(root_trace: str, worker_trace: str) -> None:
+    """Pin 2: merged fleet timeline attributes >= 95% of dispatch wall."""
+    merged = merge_trace_files([root_trace, worker_trace])
+    assert all(r["aligned"] for r in merged["replicas"]), merged["replicas"]
+    rollup = serve_trace_rollup(merged["events"])
+    share = rollup["attributed_share"]
+    assert share >= 0.95, (
+        f"only {share:.1%} of merged dispatch wall attributed to rider ids"
+    )
+    pids = {
+        e.get("pid") for e in merged["events"]
+        if e.get("ph") == "B" and e.get("name") == "serve.dispatch"
+    }
+    assert len(pids) >= 2, (
+        f"merged rollup covers one process only (pids {pids})"
+    )
+    print(
+        f"[fleet_obs_smoke] merged timeline: {share:.1%} of "
+        f"{rollup['dispatch_wall_ms']:.1f} ms dispatch wall attributed "
+        f"across {len(pids)} processes"
+    )
+
+
+def check_lifecycle(fleet_dir: str, worker) -> None:
+    """Pin 3: join -> SIGKILL -> stale -> reap."""
+    reader = FleetRegistry(fleet_dir, stale_after_s=1.0)
+    roster = reader.roster()
+    assert roster["transitions"]["joined"] >= 2, roster["transitions"]
+    worker.send_signal(signal.SIGKILL)
+    worker.wait(timeout=10)
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        roster = reader.roster()
+        states = {r["name"]: r["state"] for r in roster["replicas"]}
+        if states.get("w1") == "stale":
+            break
+        time.sleep(0.2)
+    assert states.get("w1") == "stale", f"worker never went stale: {states}"
+    assert roster["transitions"]["went_stale"] >= 1, roster["transitions"]
+    reaped = reader.reap(reap_after_s=1.0)
+    assert "w1" in reaped, f"stale worker not reaped: {reaped}"
+    names = {r["name"] for r in reader.roster()["replicas"]}
+    assert "w1" not in names, names
+    print(
+        "[fleet_obs_smoke] registry lifecycle: w1 joined -> SIGKILL -> "
+        "stale -> reaped"
+    )
+
+
+def check_flight_recorder() -> None:
+    """Pin 4: a SIGKILL'd process leaves a parseable postmortem dump."""
+    with tempfile.TemporaryDirectory() as d:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", FLIGHT_SRC],
+            stdout=subprocess.PIPE, text=True, cwd=REPO,
+            env={
+                **os.environ,
+                "TNC_TPU_PLATFORM": "cpu",
+                "TNC_TPU_TRACE": "1",
+                "TNC_TPU_FLIGHT_RECORDER": d,
+                "TNC_TPU_FLIGHT_INTERVAL": "0.2",
+            },
+        )
+        line = proc.stdout.readline().strip()
+        assert line == "ARMED", f"flight process never armed: {line!r}"
+        time.sleep(1.0)  # let the periodic flush capture the spans
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+        dumps = [f for f in os.listdir(d) if f.startswith("flight-")]
+        assert dumps, f"no flight-recorder dump after SIGKILL: {os.listdir(d)}"
+        doc = json.load(open(os.path.join(d, dumps[0])))
+        assert doc["counters"].get("smoke.widgets") == 42.0, doc["counters"]
+        names = {s["name"] for s in doc["spans"]}
+        assert {"smoke.outer", "smoke.inner"} <= names, names
+        assert doc["replica"]["pid"] == proc.pid, doc["replica"]
+    print(
+        f"[fleet_obs_smoke] flight recorder: SIGKILL'd pid {proc.pid} left "
+        f"dump '{dumps[0]}' ({len(doc['spans'])} spans, reason "
+        f"'{doc['reason']}')"
+    )
+
+
+def main() -> int:
+    obs.configure(enabled=True, registry=MetricsRegistry())
+    rng = np.random.default_rng(7)
+    circuit = brickwork_circuit(N_QUBITS, DEPTH, np.random.default_rng(0))
+
+    with tempfile.TemporaryDirectory() as fleet_dir:
+        worker_trace = os.path.join(fleet_dir, "trace.w1.json")
+        root_trace = os.path.join(fleet_dir, "trace.p0.json")
+        with ContractionService.from_circuit(
+            circuit,
+            telemetry_port=0,
+            fleet_dir=fleet_dir,
+            fleet_heartbeat_s=0.5,
+            max_batch=4,
+            max_wait_ms=1.0,
+        ) as svc:
+            futs = [
+                svc.submit("".join(rng.choice(["0", "1"], N_QUBITS)))
+                for _ in range(QUERIES)
+            ]
+            for f in futs:
+                f.result(timeout=600)
+            # quiesce: serve.request spans close after futures resolve
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if svc.stats()["counts"]["completed"] >= QUERIES:
+                    break
+                time.sleep(0.01)
+            time.sleep(0.1)
+            obs.export_chrome_trace(root_trace)
+            root_rollup = serve_trace_rollup(obs.load_trace_events(root_trace))
+            rids = sorted(root_rollup["requests"])[:4]
+            assert rids, "root trace recorded no serve.request spans"
+            worker, worker_url = start_worker(
+                fleet_dir, worker_trace, ",".join(rids)
+            )
+            try:
+                time.sleep(0.2)  # worker heartbeat lands
+                check_federation(svc, worker_url)
+                check_trace_merge(root_trace, worker_trace)
+                check_lifecycle(fleet_dir, worker)
+            finally:
+                if worker.poll() is None:
+                    worker.kill()
+    check_flight_recorder()
+    print("[fleet_obs_smoke] OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

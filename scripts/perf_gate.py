@@ -290,6 +290,30 @@ def compare(
             f"bench run: {', '.join(str(a) for a in slo_alerts)}"
         )
 
+    # fleet cross-check (cluster runs / BENCH_SERVE_FLEET_DIR): rider
+    # attribution below the 0.95 pin means dispatch wall went missing
+    # from the cross-host trace (a worker span lost the root's rids);
+    # a replica going stale mid-run means heartbeat gaps exceeded the
+    # staleness window — dispatches may have run against a dead member
+    cfl = (cand.get("serving") or {}).get("fleet") or {}
+    attribution = cfl.get("attribution_share")
+    if attribution is not None and float(attribution) < 0.95:
+        msgs.append(
+            f"warning: fleet dispatch attribution {float(attribution):.1%} "
+            "below the 0.95 pin (worker spans missing rider ids?)"
+        )
+    if cfl.get("stale_transitions"):
+        msgs.append(
+            f"warning: {cfl['stale_transitions']} fleet replica(s) went "
+            "stale during the candidate bench run (heartbeat gaps "
+            f"up to {cfl.get('max_heartbeat_gap_s')} s)"
+        )
+    if cfl.get("replicas_stale"):
+        msgs.append(
+            f"warning: {cfl['replicas_stale']} fleet replica(s) still "
+            "stale at the end of the candidate bench run"
+        )
+
     # serving reuse cross-check (the BENCH_SERVE_SWEEP block): the
     # pinned-reference-model speedup is the reuse feature's headline —
     # below 2x the prefix store is no longer paying for itself; a hit

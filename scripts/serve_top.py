@@ -16,10 +16,19 @@ shows, per refresh:
 - SLO burn rates per objective/window, drift ratio per executor
   bucket, and the currently-firing alerts.
 
+Fleet mode renders one row per replica instead: pass several endpoint
+URLs, or ``--fleet <registry-dir>`` to discover replicas from a
+:class:`~tnc_tpu.obs.fleet.FleetRegistry` heartbeat directory (each
+row shows heartbeat age/state, queue depth, qps, p99, SLO alerts;
+replicas whose heartbeat carries a scrape ``url`` are polled live,
+the rest render from their last heartbeat payload).
+
 Usage:
     python scripts/serve_top.py http://127.0.0.1:9100
     python scripts/serve_top.py --interval 5 http://host:9100
     python scripts/serve_top.py --once http://host:9100   # one frame (CI)
+    python scripts/serve_top.py http://h0:9100 http://h1:9100  # fleet
+    python scripts/serve_top.py --fleet /shared/fleet-dir --once
 """
 
 from __future__ import annotations
@@ -172,12 +181,104 @@ def render_frame(
     return "\n".join(lines), completed_now
 
 
+def _fleet_sources(urls: list[str], fleet_dir: str | None) -> list[dict]:
+    """One source dict per replica: {name, url?, state, age_s, payload}."""
+    sources: list[dict] = []
+    if fleet_dir is not None:
+        from tnc_tpu.obs.fleet import FleetRegistry
+
+        roster = FleetRegistry(fleet_dir).roster()
+        for rep in roster["replicas"]:
+            payload = rep.get("payload") or {}
+            sources.append({
+                "name": rep["name"],
+                "url": (payload.get("url") or "").rstrip("/") or None,
+                "state": rep["state"],
+                "age_s": rep["age_s"],
+                "payload": payload,
+            })
+    for u in urls:
+        base = u.rstrip("/")
+        sources.append({
+            "name": base, "url": base, "state": "?", "age_s": None,
+            "payload": {},
+        })
+    return sources
+
+
+def _replica_stats(metrics: dict) -> tuple[float, float]:
+    """(total completed across types, worst p99 seconds)."""
+    rows = per_type_rows(metrics)
+    done = sum(r.get("completed", 0.0) for r in rows.values())
+    p99 = max((r.get("p0.99", 0.0) for r in rows.values()), default=0.0)
+    return done, p99
+
+
+def render_fleet_frame(
+    sources: list[dict],
+    prev: dict[str, float] | None,
+    dt: float,
+) -> tuple[str, dict[str, float]]:
+    head = (
+        f"{'replica':<18} {'state':<7} {'hb age':>7} {'queue':>6} "
+        f"{'qps':>7} {'p99 ms':>8} {'alerts':>6}"
+    )
+    lines = [
+        f"fleet_top — {len(sources)} replicas   {time.strftime('%H:%M:%S')}",
+        head,
+        "-" * len(head),
+    ]
+    completed_now: dict[str, float] = {}
+    for src in sources:
+        name, payload = src["name"], src["payload"]
+        queue = payload.get("queue_depth", "?")
+        alerts = payload.get("slo_alerts", "?")
+        qps_s, p99_s = "-", "-"
+        state = src["state"]
+        if src["url"] is not None:
+            health = fetch_json(src["url"], "/healthz")
+            metrics = fetch_metrics(src["url"])
+            if "__error_msg__" in metrics or "error" in health:
+                state = f"{state}/unreachable" if state != "?" else "down"
+            else:
+                if state == "?":
+                    state = health.get("status", "ok")
+                queue = health.get("queue_depth", queue)
+                slo = fetch_json(src["url"], "/slo")
+                if slo.get("enabled"):
+                    alerts = len(slo.get("alerts", []))
+                done, p99 = _replica_stats(metrics)
+                completed_now[name] = done
+                qps = (
+                    (done - prev.get(name, done)) / dt
+                    if prev is not None and dt > 0
+                    else 0.0
+                )
+                qps_s, p99_s = f"{qps:.1f}", f"{p99 * 1e3:.2f}"
+        age = src["age_s"]
+        age_s = f"{age:.1f}s" if age is not None else "-"
+        lines.append(
+            f"{name:<18} {state:<7} {age_s:>7} {queue!s:>6} "
+            f"{qps_s:>7} {p99_s:>8} {alerts!s:>6}"
+        )
+    return "\n".join(lines), completed_now
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         description="Refresh-loop ops view over a serving replica's "
         "telemetry endpoint"
     )
-    parser.add_argument("url", help="endpoint base, e.g. http://host:9100")
+    parser.add_argument(
+        "url", nargs="*",
+        help="endpoint base(s), e.g. http://host:9100; several URLs "
+             "switch to per-replica fleet rows",
+    )
+    parser.add_argument(
+        "--fleet", metavar="DIR", default=None,
+        help="FleetRegistry heartbeat directory — discover replicas "
+             "from heartbeats instead of (or in addition to) URLs",
+    )
     parser.add_argument(
         "--interval", type=float, default=2.0, help="refresh seconds"
     )
@@ -186,7 +287,25 @@ def main(argv: list[str] | None = None) -> int:
         help="print one frame and exit (no screen clearing) — CI/tests",
     )
     args = parser.parse_args(argv)
-    base = args.url.rstrip("/")
+    if not args.url and args.fleet is None:
+        parser.error("need at least one endpoint URL or --fleet DIR")
+
+    if args.fleet is not None or len(args.url) > 1:
+        prev_f: dict[str, float] | None = None
+        t_prev = time.monotonic()
+        while True:
+            sources = _fleet_sources(args.url, args.fleet)
+            now = time.monotonic()
+            frame, prev_f = render_fleet_frame(sources, prev_f, now - t_prev)
+            t_prev = now
+            if args.once:
+                print(frame)
+                return 0
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+
+    base = args.url[0].rstrip("/")
 
     prev: dict[str, float] | None = None
     t_prev = time.monotonic()

@@ -20,11 +20,21 @@ each ``serve.request`` span's args are that request's timeline
 ``serve.dispatch`` span's wall time is attributed back to the rider id
 list it carries — the per-request complement of the per-stage views.
 
+``--fleet`` merges the per-process trace files a multi-host run leaves
+behind (``TNC_TPU_TRACE=<path>.json`` exports ``<path>.p<idx>.json``
+per process, aligned on each file's wall-clock export anchor) into one
+timeline before summarizing. Pass a directory of trace files or the
+files themselves; combine with ``--serve`` for the cross-host dispatch
+rollup — worker ``serve.dispatch`` spans carry the root's rider ids,
+so dispatch wall is attributed across hosts.
+
 Usage:
     python scripts/trace_summarize.py bench_trace.json
     python scripts/trace_summarize.py --top 10 bench_trace.json
     python scripts/trace_summarize.py --roofline bench_trace.json
     python scripts/trace_summarize.py --serve serve_trace.json
+    python scripts/trace_summarize.py --fleet --serve trace_dir/
+    python scripts/trace_summarize.py --fleet t.p0.json t.p1.json
 """
 
 from __future__ import annotations
@@ -40,7 +50,11 @@ def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         description="Per-stage summary of a tnc_tpu Chrome trace"
     )
-    parser.add_argument("trace", help="Chrome-trace JSON file")
+    parser.add_argument(
+        "trace", nargs="+",
+        help="Chrome-trace JSON file(s); with --fleet, a directory of "
+             "per-process trace files or the files themselves",
+    )
     parser.add_argument(
         "--top", type=int, default=0,
         help="show only the N most expensive stages (default: all)",
@@ -55,18 +69,58 @@ def main(argv: list[str] | None = None) -> int:
         help="per-request/per-query-type rollup of serve.* spans "
              "(queue-age / batch-wait / dispatch attribution)",
     )
+    parser.add_argument(
+        "--fleet", action="store_true",
+        help="merge per-process trace files (directory or explicit "
+             "files) into one wall-clock-aligned timeline first",
+    )
     args = parser.parse_args(argv)
 
     from tnc_tpu.obs.export import (
         format_serve_rollup,
         format_summary_table,
         load_trace_events,
+        merge_trace_files,
         serve_trace_rollup,
         trace_summary,
     )
 
+    if args.fleet:
+        paths: list[str] = []
+        for entry in args.trace:
+            if os.path.isdir(entry):
+                paths.extend(
+                    os.path.join(entry, f)
+                    for f in sorted(os.listdir(entry))
+                    if f.endswith(".json")
+                )
+            else:
+                paths.append(entry)
+        if not paths:
+            print("no trace files found", file=sys.stderr)
+            return 1
+        merged = merge_trace_files(paths)
+        events = merged["events"]
+        for rep in merged["replicas"]:
+            tag = "" if rep["aligned"] else "  (no wall-clock anchor)"
+            ident = rep["replica"] or {}
+            who = (
+                f"p{ident.get('process', '?')}@{ident.get('host', '?')} "
+                f"pid={ident.get('pid', '?')}"
+                if isinstance(ident, dict) else str(ident)
+            )
+            print(
+                f"# {who}: {rep['path']} "
+                f"shift {rep['shift_ms']:+.3f} ms{tag}",
+                file=sys.stderr,
+            )
+    else:
+        if len(args.trace) != 1:
+            parser.error("multiple trace files require --fleet")
+        events = load_trace_events(args.trace[0])
+
     if args.serve:
-        rollup = serve_trace_rollup(load_trace_events(args.trace))
+        rollup = serve_trace_rollup(events)
         if not rollup["requests"] and rollup["dispatch_wall_ms"] == 0.0:
             print(
                 "no serve.* spans in trace (record a served workload "
@@ -77,7 +131,7 @@ def main(argv: list[str] | None = None) -> int:
         print(format_serve_rollup(rollup))
         return 0
 
-    rows = trace_summary(load_trace_events(args.trace))
+    rows = trace_summary(events)
     if not rows:
         print("no spans in trace", file=sys.stderr)
         return 1

@@ -1,0 +1,209 @@
+"""Worker for the 2-process fleet-observability test: cross-host trace
+propagation and federated ``/fleet`` telemetry across real OS process
+boundaries.
+
+Run as: python _fleet_obs_worker.py <pid> <nprocs> <port> <work_dir>
+
+Phases (every process walks the same collective sequence):
+
+A. **Bind through the shared cache** — process 0 plans + publishes,
+   process 1 binds planner-free.
+B. **Fleet serving** — process 0 runs a ``ContractionService`` with a
+   ``ClusterDispatcher``, a telemetry endpoint and ``attach_fleet``;
+   process 1 parks in ``serve_cluster(..., fleet_dir=...)``. While the
+   worker serves, the root pins:
+
+   - the ``/fleet`` roster sees both replicas live, and the federated
+     ``serve.*`` counter sums are bit-equal to independently scraping
+     each replica's ``/metrics`` and summing;
+   - after shutdown, each process exports its per-process trace; the
+     root merges them and asserts the worker's ``serve.dispatch``
+     spans carry the root's rider ids (>= 95% of the merged dispatch
+     wall attributed) and the root's plan generation/dispatch seq.
+"""
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ.setdefault("TNC_TPU_TRACE", "1")
+
+import jax
+
+pid, nprocs, port, work_dir = (
+    int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+)
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
+jax.distributed.initialize(
+    f"127.0.0.1:{port}", num_processes=nprocs, process_id=pid
+)
+assert jax.process_count() == nprocs, jax.process_count()
+
+import numpy as np
+
+import tnc_tpu.obs as obs
+from tnc_tpu.builders.random_circuit import brickwork_circuit
+from tnc_tpu.obs.export import merge_trace_files, serve_trace_rollup
+from tnc_tpu.obs.fleet import _series_family, _series_without_replica
+from tnc_tpu.obs.http import parse_prometheus
+from tnc_tpu.parallel.partitioned import broadcast_object
+from tnc_tpu.serve import (
+    ClusterDispatcher,
+    ContractionService,
+    PlanCache,
+    bind_circuit,
+    serve_cluster,
+)
+
+fleet_dir = os.path.join(work_dir, "fleet")
+cache_dir = os.path.join(work_dir, "plans")
+trace_path = os.path.join(work_dir, f"trace.p{pid}.json")
+
+
+def fetch(url: str) -> str:
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.read().decode("utf-8")
+
+
+# ---- phase A: bind through the shared plan cache -----------------------
+cache = PlanCache(cache_dir)
+circuit = lambda: brickwork_circuit(8, 4, np.random.default_rng(5))
+if pid == 0:
+    bound = bind_circuit(circuit(), plan_cache=cache)
+broadcast_object(None, root=0)  # barrier: plan published
+if pid != 0:
+    bound = bind_circuit(circuit(), plan_cache=cache)
+print(f"proc {pid}: FLEET BIND OK", flush=True)
+
+# ---- phase B: fleet serving --------------------------------------------
+bits = [
+    format(v, "08b") for v in
+    np.random.default_rng(23).integers(0, 256, size=16)
+]
+
+if pid == 0:
+    dispatcher = ClusterDispatcher()
+    svc = ContractionService(
+        bound, dispatcher=dispatcher, max_batch=8, max_wait_ms=20.0
+    )
+    svc.start()
+    svc.serve_telemetry(port=0)
+    svc.attach_fleet(directory=fleet_dir, heartbeat_s=0.3)
+    base = svc._telemetry.url
+
+    futs = [svc.submit(b) for b in bits]
+    got = np.asarray([f.result(timeout=120) for f in futs])
+    oracle = bound.amplitudes_det(
+        [bound.template.request_bits(b) for b in bits]
+    )
+    assert np.array_equal(got, oracle), "cluster amplitudes drifted"
+    # quiesce the request spans, then wait for the worker's heartbeat
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if svc.stats()["counts"]["completed"] >= len(bits):
+            break
+        time.sleep(0.05)
+
+    body, worker_url = None, None
+    while time.monotonic() < deadline:
+        body = json.loads(fetch(base + "/fleet"))
+        roster = {
+            r["name"]: r for r in body.get("roster", {}).get("replicas", [])
+        }
+        live = [n for n, r in roster.items() if r["state"] == "live"]
+        if len(live) >= 2:
+            others = [n for n in live if n != "p0"]
+            worker_url = roster[others[0]]["payload"].get("url")
+            if worker_url:
+                break
+        time.sleep(0.1)
+    assert worker_url, f"worker replica never joined the roster: {body}"
+    assert sorted(body["replicas"]) == ["p0", "p1"], body["replicas"]
+
+    # federated counters: bit-equal to summing the replicas yourself
+    # (serve.* families only: the serving traffic is quiesced, while
+    # fleet.* heartbeat counters keep moving between scrapes)
+    want: dict[str, float] = {}
+    for text in (fetch(base + "/metrics"), fetch(worker_url + "/metrics")):
+        series_map = parse_prometheus(text)
+        for series in sorted(series_map):
+            fam = _series_family(series)
+            if not (
+                fam.startswith("tnc_tpu_serve_") and fam.endswith("_total")
+            ):
+                continue
+            key = _series_without_replica(series)
+            want[key] = want.get(key, 0.0) + series_map[series]
+    refetched = json.loads(fetch(base + "/fleet"))["counters"]
+    mismatches = {
+        k: (refetched.get(k), want[k])
+        for k in want if refetched.get(k) != want[k]
+    }
+    assert not mismatches, f"fleet counter sums diverge: {mismatches}"
+    # the worker's dispatch counters actually contributed
+    assert want.get("tnc_tpu_serve_batches_total", 0.0) >= 1.0, want
+    print(f"proc {pid}: FLEET COUNTERS OK ({len(want)} families)", flush=True)
+
+    svc.stop()
+    dispatcher.stop()
+else:
+    served = serve_cluster(
+        bound, plan_cache=cache, telemetry_port=0,
+        fleet_dir=fleet_dir, heartbeat_s=0.3,
+    )
+    assert served >= 1, "worker served no batches"
+    print(f"proc {pid}: FLEET COUNTERS OK (worker)", flush=True)
+
+# ---- trace export + merged cross-host rollup ---------------------------
+obs.export_chrome_trace(trace_path)
+broadcast_object(None, root=1)  # barrier: worker trace on disk
+if pid == 0:
+    merged = merge_trace_files(
+        [trace_path, os.path.join(work_dir, "trace.p1.json")]
+    )
+    assert all(r["aligned"] for r in merged["replicas"]), merged["replicas"]
+    rollup = serve_trace_rollup(merged["events"])
+    share = rollup["attributed_share"]
+    assert share >= 0.95, (
+        f"only {share:.1%} of merged dispatch wall attributed"
+    )
+    # the worker's dispatch spans carry the root's rider ids + plan
+    # generation + dispatch seq (remote=1 marks the worker side)
+    remote = [
+        e for e in merged["events"]
+        if e.get("ph") == "B" and e.get("name") == "serve.dispatch"
+        and e.get("args", {}).get("remote") == 1
+    ]
+    assert remote, "no worker-side serve.dispatch spans in merged trace"
+    rids = set(rollup["requests"])
+    for e in remote:
+        riders = [r for r in e["args"].get("riders", "").split(",") if r]
+        assert riders and set(riders) <= rids, (
+            f"worker span riders {riders} not among root rids {rids}"
+        )
+        assert e["args"].get("seq", 0) >= 1, e["args"]
+        assert e["args"].get("process") == 1, e["args"]
+    pids = {
+        e.get("pid") for e in merged["events"]
+        if e.get("ph") == "B" and e.get("name") == "serve.dispatch"
+    }
+    assert len(pids) == 2, f"expected dispatch spans from 2 processes: {pids}"
+    print(
+        f"proc {pid}: FLEET TRACE OK ({share:.1%} of "
+        f"{rollup['dispatch_wall_ms']:.1f} ms across {len(pids)} procs, "
+        f"{len(remote)} remote dispatches)",
+        flush=True,
+    )
+else:
+    print(f"proc {pid}: FLEET TRACE OK (exported)", flush=True)
+print(f"proc {pid}: FLEET OBS OK", flush=True)

@@ -1,0 +1,485 @@
+"""Fleet observability plane: cross-host trace propagation
+(TraceContext / dispatch_context / adopt_trace_context), the replica
+registry with heartbeats (join -> stale -> reap), federated metric
+merging (/fleet counter sums, per-replica gauges, quantile envelopes),
+per-process trace merge, and the crash flight recorder — including a
+2-process ``jax.distributed`` pin that worker dispatch spans carry the
+root's request ids (``tests/_fleet_obs_worker.py``)."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+import pytest
+
+import tnc_tpu.obs as obs
+from tnc_tpu.obs.core import MetricsRegistry
+from tnc_tpu.obs.export import merge_trace_files, serve_trace_rollup
+from tnc_tpu.obs.fleet import (
+    FleetRegistry,
+    Heartbeat,
+    TraceContext,
+    adopt_trace_context,
+    current_dispatch_context,
+    dispatch_context,
+    merge_fleet_metrics,
+    replica_identity,
+    replica_name,
+    _series_with_replica,
+    _series_without_replica,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def enabled_obs():
+    reg = obs.configure(enabled=True, registry=MetricsRegistry())
+    try:
+        yield reg
+    finally:
+        obs.configure(enabled=False, registry=MetricsRegistry())
+
+
+# ---------------------------------------------------------------------------
+# trace propagation primitives
+
+
+class TestTraceContext:
+    def test_roundtrip_through_broadcast_form(self):
+        ctx = TraceContext(
+            riders="r1,r2,r3", kind="marginal", generation=4, seq=17,
+            root_process=0, root_pid=1234,
+        )
+        assert TraceContext.from_obj(ctx.to_obj()) == ctx
+
+    def test_from_obj_tolerates_junk(self):
+        assert TraceContext.from_obj(None) is None
+        assert TraceContext.from_obj("nope") is None
+        assert TraceContext.from_obj(["r1"]) is None
+        # unknown keys ignored, missing keys defaulted
+        got = TraceContext.from_obj({"riders": "r9", "future_field": 1})
+        assert got.riders == "r9" and got.seq == 0
+
+    def test_dispatch_context_is_thread_local_and_restores(self):
+        assert current_dispatch_context() is None
+        with dispatch_context(riders="r7,r8", kind="amplitude",
+                              generation=2) as ctx:
+            assert current_dispatch_context() is ctx
+            assert ctx.riders == "r7,r8"
+            assert ctx.root_pid == os.getpid()
+            with dispatch_context(riders="r9") as inner:
+                assert current_dispatch_context() is inner
+            assert current_dispatch_context() is ctx
+        assert current_dispatch_context() is None
+
+    def test_adopted_context_rides_every_span(self, enabled_obs):
+        ctx = TraceContext(riders="r1,r2", kind="amplitude",
+                           generation=3, seq=5)
+        with adopt_trace_context(ctx):
+            with obs.span("serve.dispatch", remote=1):
+                with obs.span("partitioned.local_phase"):
+                    pass
+        by_name = {r.name: r for r in enabled_obs.span_records()}
+        for name in ("serve.dispatch", "partitioned.local_phase"):
+            args = by_name[name].args
+            assert args["riders"] == "r1,r2", (name, args)
+            assert args["generation"] == 3 and args["seq"] == 5, args
+        # explicit span args win over the ambient ones
+        with adopt_trace_context(ctx):
+            with obs.span("x", riders="override"):
+                pass
+        rec = [r for r in enabled_obs.span_records() if r.name == "x"][0]
+        assert rec.args["riders"] == "override"
+
+    def test_adopting_none_is_a_noop(self, enabled_obs):
+        with adopt_trace_context(None):
+            with obs.span("plain"):
+                pass
+        rec = [r for r in enabled_obs.span_records() if r.name == "plain"][0]
+        assert "riders" not in rec.args
+
+    def test_replica_identity_shape(self):
+        ident = replica_identity()
+        assert ident["pid"] == os.getpid()
+        assert ident["host"] == socket.gethostname()
+        assert ident["process"] == 0 and ident["process_count"] == 1
+        assert replica_name(ident) == "p0"
+
+
+# ---------------------------------------------------------------------------
+# replica registry
+
+
+class TestFleetRegistry:
+    def test_join_stale_recover_reap_cycle(self, enabled_obs, tmp_path):
+        writer = FleetRegistry(tmp_path, name="w1", stale_after_s=0.2)
+        reader = FleetRegistry(tmp_path, name="r0", stale_after_s=0.2)
+        writer.heartbeat({"queue_depth": 3})
+        roster = reader.roster()
+        states = {r["name"]: r["state"] for r in roster["replicas"]}
+        assert states == {"w1": "live"}
+        assert roster["transitions"]["joined"] == 1
+        assert roster["replicas"][0]["payload"] == {"queue_depth": 3}
+
+        time.sleep(0.3)  # heartbeat ages out -> stale
+        roster = reader.roster()
+        assert roster["stale"] == 1 and roster["live"] == 0
+        assert roster["transitions"]["went_stale"] == 1
+
+        writer.heartbeat({"queue_depth": 0})  # comes back
+        roster = reader.roster()
+        assert roster["live"] == 1
+        assert roster["transitions"]["recovered"] == 1
+
+        time.sleep(0.3)
+        assert reader.reap(reap_after_s=0.2) == ["w1"]
+        assert reader.roster()["replicas"] == []
+        counters = obs.counters_by_prefix("fleet.replica.")
+        assert counters["fleet.replica.reaped"] == 1.0
+
+    def test_retire_is_a_clean_leave(self, enabled_obs, tmp_path):
+        writer = FleetRegistry(tmp_path, name="w1")
+        reader = FleetRegistry(tmp_path, name="r0")
+        writer.heartbeat()
+        assert reader.roster()["live"] == 1
+        writer.retire()
+        roster = reader.roster()
+        assert roster["replicas"] == []
+        assert roster["transitions"]["left"] == 1
+
+    def test_corrupt_entry_dropped_not_raised(self, enabled_obs, tmp_path):
+        FleetRegistry(tmp_path, name="ok").heartbeat()
+        (tmp_path / "hb-bad.json").write_text("{not json", encoding="utf-8")
+        reader = FleetRegistry(tmp_path, name="r0")
+        names = [r["name"] for r in reader.roster()["replicas"]]
+        assert names == ["ok"]
+        assert not (tmp_path / "hb-bad.json").exists()
+        counters = obs.counters_by_prefix("fleet.registry.")
+        assert counters["fleet.registry.corrupt_dropped"] == 1.0
+
+    def test_heartbeat_thread_cadence_and_provider_errors(
+        self, enabled_obs, tmp_path
+    ):
+        registry = FleetRegistry(tmp_path, name="w1")
+        calls = []
+
+        def provider():
+            calls.append(1)
+            if len(calls) == 2:
+                raise RuntimeError("stats hook broke")
+            return {"queue_depth": len(calls)}
+
+        hb = Heartbeat(registry, provider=provider, interval_s=0.05).start()
+        try:
+            deadline = time.monotonic() + 5
+            while len(calls) < 3 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert len(calls) >= 3, "heartbeat cadence stalled"
+        finally:
+            hb.stop()
+        # provider blew up once; the cadence survived and the entry is
+        # retired on stop (clean leave)
+        counters = obs.counters_by_prefix("fleet.heartbeat")
+        assert counters["fleet.heartbeat.provider_errors"] == 1.0
+        assert counters["fleet.heartbeats"] >= 3.0
+        assert list(tmp_path.glob("hb-*.json")) == []
+
+    def test_last_heartbeat_age(self, tmp_path):
+        reg = FleetRegistry(tmp_path, name="w1")
+        assert reg.last_heartbeat_age_s() is None
+        reg.heartbeat()
+        assert reg.last_heartbeat_age_s() < 5.0
+
+
+# ---------------------------------------------------------------------------
+# federated metric merging
+
+
+class TestMergeFleetMetrics:
+    def test_counters_sum_bit_equal_in_replica_order(self):
+        per = {
+            "p1": {"x_total": 0.3, 'y_total{type="a"}': 1.0},
+            "p0": {"x_total": 0.1, 'y_total{type="a"}': 2.0},
+            "p2": {"x_total": 0.2},
+        }
+        merged = merge_fleet_metrics(
+            per, types={"x_total": "counter", "y_total": "counter"}
+        )
+        # deterministic sorted-replica order: p0 + p1 + p2
+        assert merged["counters"]["x_total"] == (0.1 + 0.3) + 0.2
+        assert merged["counters"]['y_total{type="a"}'] == 3.0
+        assert merged["replicas"] == ["p0", "p1", "p2"]
+
+    def test_replica_label_stripped_before_summing(self):
+        merged = merge_fleet_metrics(
+            {
+                "p0": {"x_total": 2.0},
+                "w1": {'x_total{replica="w1"}': 3.0},
+            },
+            types={"x_total": "counter"},
+        )
+        assert merged["counters"] == {"x_total": 5.0}
+
+    def test_gauges_stay_per_replica(self):
+        merged = merge_fleet_metrics(
+            {"p0": {"depth": 1.0}, "p1": {"depth": 4.0}},
+            types={"depth": "gauge"},
+        )
+        assert merged["counters"] == {}
+        assert merged["per_replica"] == {
+            'depth{replica="p0"}': 1.0,
+            'depth{replica="p1"}': 4.0,
+        }
+
+    def test_quantile_envelope_bounds_not_fabricated_percentiles(self):
+        series = 'lat{quantile="0.99",type="amplitude"}'
+        merged = merge_fleet_metrics(
+            {"p0": {series: 0.010}, "p1": {series: 0.030}},
+            types={"lat": "summary"},
+        )
+        env = merged["quantile_envelope"][series]
+        assert env == {"min": 0.010, "max": 0.030, "replicas": 2}
+        # no pooled p99 anywhere in the merge
+        assert "pooled" not in json.dumps(merged)
+
+    def test_typeless_fallback_uses_total_suffix(self):
+        merged = merge_fleet_metrics(
+            {"p0": {"a_total": 1.0, "b": 2.0},
+             "p1": {"a_total": 2.0, "b": 3.0}}
+        )
+        assert merged["counters"] == {"a_total": 3.0}
+        assert set(merged["per_replica"]) == {
+            'b{replica="p0"}', 'b{replica="p1"}'
+        }
+
+    def test_series_label_helpers(self):
+        assert _series_with_replica("x", "p0") == 'x{replica="p0"}'
+        assert (
+            _series_with_replica('x{type="a"}', "p0")
+            == 'x{replica="p0",type="a"}'
+        )
+        # idempotent on source-labeled series
+        keyed = 'x{replica="w1",type="a"}'
+        assert _series_with_replica(keyed, "p0") == keyed
+        assert _series_without_replica(keyed) == 'x{type="a"}'
+        assert _series_without_replica('x{replica="w1"}') == "x"
+
+
+# ---------------------------------------------------------------------------
+# per-process trace merge
+
+
+class TestMergeTraceFiles:
+    @staticmethod
+    def _doc(epoch_unix_ns, replica, events):
+        return {
+            "traceEvents": events,
+            "otherData": {
+                "epoch_unix_ns": epoch_unix_ns,
+                "replica": replica,
+            },
+        }
+
+    def test_wall_clock_alignment_and_rollup(self, tmp_path):
+        # root exported 2ms after the worker's epoch: identical local
+        # timestamps must land 2ms apart in the merged timeline
+        root = self._doc(1_000_000_000, {"process": 0}, [
+            {"name": "serve.dispatch", "ph": "B", "ts": 0.0, "pid": 1,
+             "tid": 1, "args": {"riders": "r1,r2", "kind": "amplitude"}},
+            {"name": "serve.dispatch", "ph": "E", "ts": 1000.0, "pid": 1,
+             "tid": 1, "args": {}},
+        ])
+        worker = self._doc(1_002_000_000, {"process": 1}, [
+            {"name": "serve.dispatch", "ph": "B", "ts": 0.0, "pid": 2,
+             "tid": 1, "args": {"riders": "r1,r2", "kind": "amplitude",
+                                "remote": 1}},
+            {"name": "serve.dispatch", "ph": "E", "ts": 500.0, "pid": 2,
+             "tid": 1, "args": {}},
+        ])
+        p0, p1 = tmp_path / "t.p0.json", tmp_path / "t.p1.json"
+        p0.write_text(json.dumps(root), encoding="utf-8")
+        p1.write_text(json.dumps(worker), encoding="utf-8")
+
+        merged = merge_trace_files([p0, p1])
+        assert [r["replica"]["process"] for r in merged["replicas"]] == [0, 1]
+        assert all(r["aligned"] for r in merged["replicas"])
+        shifts = {r["path"]: r["shift_ms"] for r in merged["replicas"]}
+        assert shifts[str(p0)] == 0.0 and shifts[str(p1)] == 2.0
+        begins = {
+            e["pid"]: e["ts"] for e in merged["events"] if e["ph"] == "B"
+        }
+        assert begins[2] - begins[1] == 2000.0  # µs
+
+        rollup = serve_trace_rollup(merged["events"])
+        assert rollup["attributed_share"] == 1.0
+        assert rollup["dispatch_wall_ms"] == 1.5  # 1ms root + 0.5ms worker
+
+    def test_unanchored_file_merges_unshifted(self, tmp_path):
+        anchored = self._doc(1_000_000_000, {"process": 0}, [])
+        legacy = {"traceEvents": [
+            {"name": "s", "ph": "B", "ts": 5.0, "pid": 9, "tid": 1,
+             "args": {}},
+        ]}
+        p0, p1 = tmp_path / "a.json", tmp_path / "b.json"
+        p0.write_text(json.dumps(anchored), encoding="utf-8")
+        p1.write_text(json.dumps(legacy), encoding="utf-8")
+        merged = merge_trace_files([p0, p1])
+        flags = {r["path"]: r["aligned"] for r in merged["replicas"]}
+        assert flags[str(p0)] and not flags[str(p1)]
+        assert merged["events"][0]["ts"] == 5.0
+
+    def test_process_trace_path_suffixes_only_in_fleets(self):
+        from tnc_tpu.obs import process_trace_path
+
+        assert process_trace_path(
+            "/tmp/t.json", process_index=0, process_count=1
+        ) == "/tmp/t.json"
+        assert process_trace_path(
+            "/tmp/t.json", process_index=3, process_count=4
+        ) == "/tmp/t.p3.json"
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+
+
+FLIGHT_CHILD = """
+import sys, time
+import tnc_tpu.obs as obs
+obs.refresh_from_env()
+obs.counter_add("crash.widgets", 41)
+with obs.span("crash.outer", stage=1):
+    with obs.span("crash.inner"):
+        pass
+obs.counter_add("crash.widgets", 1)
+print("ARMED", flush=True)
+time.sleep(120)
+"""
+
+
+class TestFlightRecorder:
+    def _spawn(self, directory, extra_env=None):
+        env = {
+            k: v for k, v in os.environ.items()
+            if not k.startswith(("XLA_", "TPU_", "LIBTPU"))
+        }
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "TNC_TPU_TRACE": "1",
+            "TNC_TPU_FLIGHT_RECORDER": str(directory),
+            "TNC_TPU_FLIGHT_INTERVAL": "0.1",
+            **(extra_env or {}),
+        })
+        proc = subprocess.Popen(
+            [sys.executable, "-c", FLIGHT_CHILD],
+            stdout=subprocess.PIPE, text=True, env=env, cwd=REPO,
+        )
+        line = proc.stdout.readline().strip()
+        assert line == "ARMED", f"flight child never armed: {line!r}"
+        return proc
+
+    def _dump(self, directory):
+        dumps = [f for f in os.listdir(directory) if f.startswith("flight-")]
+        assert dumps, f"no flight dump in {os.listdir(directory)}"
+        with open(os.path.join(directory, dumps[0]), encoding="utf-8") as fh:
+            return json.load(fh)
+
+    @pytest.mark.slow
+    def test_sigkill_leaves_parseable_dump(self, tmp_path):
+        """The acceptance pin: SIGKILL is uncatchable, yet the periodic
+        flush leaves a postmortem artifact at most one interval stale."""
+        proc = self._spawn(tmp_path)
+        time.sleep(0.6)  # > flush interval: the ring reached disk
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+        assert proc.returncode == -signal.SIGKILL
+        doc = self._dump(tmp_path)
+        assert doc["counters"]["crash.widgets"] == 42.0
+        names = {s["name"] for s in doc["spans"]}
+        assert {"crash.outer", "crash.inner"} <= names
+        assert doc["replica"]["pid"] == proc.pid
+        outer = [s for s in doc["spans"] if s["name"] == "crash.outer"][0]
+        assert outer["args"] == {"stage": 1}
+
+    @pytest.mark.slow
+    def test_sigterm_dumps_and_preserves_termination(self, tmp_path):
+        proc = self._spawn(tmp_path)
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=10)
+        assert proc.returncode == -signal.SIGTERM  # disposition preserved
+        doc = self._dump(tmp_path)
+        assert doc["reason"] in ("sigterm", "atexit", "periodic")
+        assert doc["counters"]["crash.widgets"] == 42.0
+
+    def test_in_process_dump_and_uninstall(self, enabled_obs, tmp_path):
+        from tnc_tpu.obs.fleet import FlightRecorder
+
+        obs.counter_add("fr.unit", 7)
+        with obs.span("fr.span"):
+            pass
+        fr = FlightRecorder(tmp_path, capacity=8, flush_interval_s=60)
+        path = fr.dump("unit-test")
+        assert path is not None
+        doc = json.load(open(path, encoding="utf-8"))
+        assert doc["reason"] == "unit-test"
+        assert doc["counters"]["fr.unit"] == 7.0
+        assert [s["name"] for s in doc["spans"]] == ["fr.span"]
+        fr.install()
+        assert fr._installed
+        fr.uninstall()
+        assert not fr._installed
+
+
+# ---------------------------------------------------------------------------
+# 2-process fleet: trace propagation + federated counters over real
+# OS process boundaries (the multihost-serve worker pattern)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_fleet_trace_and_counters(tmp_path):
+    """Worker dispatch spans carry the root's rids (>=95% of merged
+    dispatch wall attributed), and /fleet counter families equal the
+    sum of the per-replica registries — across real processes."""
+    port = _free_port()
+    here = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(here, "_fleet_obs_worker.py")
+    env = {
+        k: v for k, v in os.environ.items()
+        if not k.startswith(("XLA_", "TPU_", "LIBTPU"))
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(p), "2", str(port), str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env, cwd=REPO,
+        )
+        for p in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for idx, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {idx} failed:\n{out}"
+        assert "FLEET BIND OK" in out, out
+        assert "FLEET COUNTERS OK" in out, out
+        assert "FLEET TRACE OK" in out, out
+        assert "FLEET OBS OK" in out, out

@@ -21,10 +21,12 @@ from tnc_tpu.obs.core import (  # noqa: F401
     get_registry,
     maybe_jax_profiler_trace,
     observe,
+    process_trace_path,
     refresh_from_env,
     reset,
     span,
     step_timing_enabled,
+    trace_args,
     trace_path,
     traced,
 )
@@ -36,6 +38,7 @@ from tnc_tpu.obs.export import (  # noqa: F401
     format_serve_rollup,
     format_summary_table,
     load_trace_events,
+    merge_trace_files,
     serve_trace_rollup,
     trace_summary,
 )
@@ -57,7 +60,30 @@ from tnc_tpu.obs.slo import (  # noqa: F401
 # the HTTP endpoint layer re-exports lazily (PEP 562): `from tnc_tpu
 # import obs` happens in every module of the library, and only
 # telemetry-serving processes should pay the http.server import
-_HTTP_EXPORTS = ("TelemetryServer", "parse_prometheus", "render_prometheus")
+_HTTP_EXPORTS = (
+    "TelemetryServer",
+    "parse_prometheus",
+    "parse_prometheus_types",
+    "render_prometheus",
+)
+
+# the fleet plane (cross-host trace propagation, replica registry,
+# federation, flight recorder) re-exports lazily for the same reason
+_FLEET_EXPORTS = (
+    "FleetAggregator",
+    "FleetRegistry",
+    "FlightRecorder",
+    "Heartbeat",
+    "TraceContext",
+    "adopt_trace_context",
+    "current_dispatch_context",
+    "dispatch_context",
+    "flight_recorder",
+    "maybe_flight_recorder",
+    "merge_fleet_metrics",
+    "replica_identity",
+    "replica_name",
+)
 
 
 def __getattr__(name: str):
@@ -65,4 +91,8 @@ def __getattr__(name: str):
         from tnc_tpu.obs import http as _http
 
         return getattr(_http, name)
+    if name in _FLEET_EXPORTS:
+        from tnc_tpu.obs import fleet as _fleet
+
+        return getattr(_fleet, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -237,6 +237,12 @@ class MetricsRegistry:
             )
         self._max_spans = max_spans
         self.epoch_ns = time.perf_counter_ns()
+        # wall-clock twin of the perf-counter epoch, captured at the
+        # same instant: span timestamps are perf-counter-relative
+        # (monotonic, per-process), so merging traces from DIFFERENT
+        # processes needs this anchor to place them on one timeline
+        # (tnc_tpu.obs.export.merge_trace_files)
+        self.epoch_unix_ns = time.time_ns()
 
     # -- metrics ---------------------------------------------------------
     @staticmethod
@@ -298,6 +304,21 @@ class MetricsRegistry:
             recs = list(self._spans)
             if include_open:
                 recs.extend(sp._record(now) for sp in self._active.values())
+        return recs
+
+    def recent_spans(
+        self, n: int, include_open: bool = False
+    ) -> list[SpanRecord]:
+        """The last ``n`` completed spans (optionally with still-open
+        spans appended) — an O(n) slice under the lock, NOT a copy of
+        the whole store; the flight recorder polls this on a cadence."""
+        now = time.perf_counter_ns()
+        with self._lock:
+            recs = self._spans[-max(int(n), 0):]
+            if include_open:
+                recs = recs + [
+                    sp._record(now) for sp in self._active.values()
+                ]
         return recs
 
     def dropped_spans(self) -> int:
@@ -387,6 +408,48 @@ def _stack() -> list:
     return st
 
 
+class _TraceArgsCtx:
+    """Scope for :func:`trace_args`: while active, every span opened on
+    this thread inherits the given args (explicit span args win)."""
+
+    __slots__ = ("_args", "_prev")
+
+    def __init__(self, args: dict):
+        self._args = args
+
+    def __enter__(self) -> "_TraceArgsCtx":
+        self._prev = getattr(_TLS, "trace_extra", None)
+        if self._args:
+            merged = dict(self._prev) if self._prev else {}
+            merged.update(self._args)
+            _TLS.trace_extra = merged
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        if self._args:
+            _TLS.trace_extra = self._prev
+        return False
+
+
+def trace_args(**args: Any) -> _TraceArgsCtx:
+    """Attach ambient args to every span this thread opens inside the
+    context — the cross-host trace-propagation primitive: a
+    ``serve_cluster`` worker adopts the root's request ids here so its
+    ``partitioned.*`` / slice spans land in the merged fleet timeline
+    already carrying them. Nesting merges (inner wins); explicit span
+    args always win over ambient ones.
+
+    >>> _ = configure(enabled=True, registry=MetricsRegistry())
+    >>> with trace_args(riders="r1,r2"):
+    ...     with span("partitioned.shard") as sp:
+    ...         pass
+    >>> get_registry().span_records()[-1].args["riders"]
+    'r1,r2'
+    >>> _ = configure(enabled=False)
+    """
+    return _TraceArgsCtx(args)
+
+
 class Span:
     """A live span. Use via :func:`span`; not constructed directly."""
 
@@ -413,6 +476,9 @@ class Span:
         return self
 
     def __enter__(self) -> "Span":
+        extra = getattr(_TLS, "trace_extra", None)
+        if extra:
+            self.args = {**extra, **self.args}
         st = _stack()
         self._depth = len(st)
         st.append(self)
@@ -523,12 +589,70 @@ def refresh_from_env() -> bool:
     raw = os.environ.get("TNC_TPU_TRACE", "").strip()
     if not raw or raw == "0" or raw.lower() in ("false", "off", "no"):
         _ENABLED = False
-        return False
+        # the flight recorder needs span recording: arming it (env
+        # TNC_TPU_FLIGHT_RECORDER) turns the registry back on
+        _maybe_arm_flight_recorder()
+        return _ENABLED
     _ENABLED = True
     if raw.lower() not in _TRUTHY:
         _TRACE_PATH = raw
         _register_atexit()
+    _maybe_arm_flight_recorder()
     return True
+
+
+def process_trace_path(
+    path: str,
+    process_index: int | None = None,
+    process_count: int | None = None,
+) -> str:
+    """Per-process variant of a trace export path: in a multi-process
+    fleet every replica suffixes its process index (``trace.json`` →
+    ``trace.p1.json``) so a cluster run never clobbers its own export.
+    Single-process runs (and runs without a distributed runtime) keep
+    the path unchanged. Pass explicit index/count to override the
+    ``jax.distributed`` probe (tests).
+
+    >>> process_trace_path("/tmp/t.json", process_index=2,
+    ...                    process_count=4)
+    '/tmp/t.p2.json'
+    >>> process_trace_path("/tmp/t.json", process_index=0,
+    ...                    process_count=1)
+    '/tmp/t.json'
+    """
+    if process_index is None or process_count is None:
+        try:
+            import jax
+
+            process_count = int(jax.process_count())
+            process_index = int(jax.process_index())
+        except Exception:  # noqa: BLE001 — no jax / not initialized
+            return path
+    if process_count <= 1:
+        return path
+    root, ext = os.path.splitext(path)
+    return f"{root}.p{process_index}{ext or '.json'}"
+
+
+def _maybe_arm_flight_recorder() -> None:
+    """Arm the crash flight recorder when ``TNC_TPU_FLIGHT_RECORDER``
+    names a directory (lazy import — the fleet module only loads when
+    the feature is on). The recorder needs span recording, so arming it
+    also enables the registry."""
+    global _ENABLED
+    if not os.environ.get("TNC_TPU_FLIGHT_RECORDER", "").strip():
+        return
+    try:
+        from tnc_tpu.obs import fleet as _fleet
+
+        if _fleet.maybe_flight_recorder() is not None:
+            _ENABLED = True
+    except Exception:  # noqa: BLE001 — observability must not break import
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "obs: flight-recorder arming failed", exc_info=True
+        )
 
 
 def _register_atexit() -> None:
@@ -543,7 +667,11 @@ def _register_atexit() -> None:
             from tnc_tpu.obs.export import export_chrome_trace
 
             try:
-                export_chrome_trace(_TRACE_PATH, _REGISTRY)
+                # each replica of a fleet exports to its own
+                # process-suffixed file (trace.json -> trace.p1.json)
+                export_chrome_trace(
+                    process_trace_path(_TRACE_PATH), _REGISTRY
+                )
             except OSError:  # pragma: no cover - unwritable path at exit
                 pass
 

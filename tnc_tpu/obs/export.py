@@ -71,12 +71,34 @@ def chrome_trace_events(
         events.append({**common, "ph": "E", "ts": ts + rec.dur_ns / 1e3})
     # B before E at equal ts (zero-duration spans) keeps pairs balanced
     events.sort(key=lambda e: (e["ts"], 0 if e["ph"] != "E" else 1))
-    meta = [
+    meta = _process_meta({pid for pid, _tid in threads}) + [
         {"name": "thread_name", "ph": "M", "ts": 0.0, "pid": pid, "tid": tid,
          "args": {"name": tname}}
         for (pid, tid), tname in sorted(threads.items())
     ]
     return meta + events
+
+
+def _process_meta(pids: set[int]) -> list[dict]:
+    """``process_name`` metadata events carrying this replica's fleet
+    identity (process index / hostname / pid) — a merged multi-host
+    timeline then names every process track after the replica that
+    produced it."""
+    label = None
+    own_pid = None
+    try:
+        from tnc_tpu.obs.fleet import replica_identity, replica_name
+
+        ident = replica_identity()
+        own_pid = ident["pid"]
+        label = f"{replica_name(ident)} {ident['host']} pid={own_pid}"
+    except Exception:  # noqa: BLE001 — identity is best-effort metadata
+        pass
+    return [
+        {"name": "process_name", "ph": "M", "ts": 0.0, "pid": pid, "tid": 0,
+         "args": {"name": label if pid == own_pid and label else f"pid {pid}"}}
+        for pid in sorted(pids)
+    ]
 
 
 def _jsonable(v: Any) -> Any:
@@ -92,10 +114,21 @@ def export_chrome_trace(
     ``path``."""
     reg = registry if registry is not None else get_registry()
     _warn_if_truncated(reg, "Chrome-trace")
+    other = reg.snapshot()
+    # fleet-merge anchors: the wall-clock twin of the span epoch places
+    # this file on a cross-process timeline; the replica identity names
+    # which host/process produced it
+    other["epoch_unix_ns"] = getattr(reg, "epoch_unix_ns", None)
+    try:
+        from tnc_tpu.obs.fleet import replica_identity
+
+        other["replica"] = replica_identity()
+    except Exception:  # noqa: BLE001 — identity is best-effort metadata
+        pass
     doc = {
         "traceEvents": chrome_trace_events(reg),
         "displayTimeUnit": "ms",
-        "otherData": reg.snapshot(),
+        "otherData": other,
     }
     with open(path, "w", encoding="utf-8") as fh:
         json.dump(doc, fh)
@@ -184,6 +217,60 @@ def load_trace_events(path: str) -> list[dict]:
     with open(path, encoding="utf-8") as fh:
         doc = json.load(fh)
     return doc["traceEvents"] if isinstance(doc, dict) else doc
+
+
+def merge_trace_files(paths: Iterable[str]) -> dict:
+    """Merge per-process Chrome-trace exports into ONE fleet timeline.
+
+    Span timestamps are perf-counter-relative to each process's own
+    registry epoch; every export since the fleet plane also carries the
+    wall-clock twin of that epoch (``otherData.epoch_unix_ns``), so the
+    merge shifts each file onto the earliest epoch and re-sorts. Files
+    without the anchor (pre-fleet exports) merge unshifted — their
+    spans still aggregate correctly, they just don't align in time.
+
+    Returns ``{"events": [...], "replicas": [{path, replica,
+    shift_ms}, ...]}`` — feed ``events`` to :func:`trace_summary` /
+    :func:`serve_trace_rollup` for the cross-host view (the ``--fleet``
+    mode of ``scripts/trace_summarize.py``).
+    """
+    docs: list[tuple[str, dict]] = []
+    for path in sorted(str(p) for p in paths):
+        with open(path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        if not isinstance(doc, dict):
+            doc = {"traceEvents": doc, "otherData": {}}
+        docs.append((path, doc))
+    epochs = [
+        (doc.get("otherData") or {}).get("epoch_unix_ns")
+        for _path, doc in docs
+    ]
+    known = [e for e in epochs if e]
+    base = min(known) if known else None
+    events: list[dict] = []
+    replicas: list[dict] = []
+    for (path, doc), epoch in zip(docs, epochs):
+        shift_us = (epoch - base) / 1e3 if (epoch and base) else 0.0
+        for ev in doc.get("traceEvents", []):
+            if shift_us and ev.get("ph") in ("B", "E"):
+                ev = {**ev, "ts": ev["ts"] + shift_us}
+            events.append(ev)
+        replicas.append({
+            "path": path,
+            "replica": (doc.get("otherData") or {}).get("replica"),
+            "shift_ms": shift_us / 1e3,
+            "aligned": bool(epoch and base),
+        })
+    # metadata events (ts 0) first, then the same B-before-E tie-break
+    # the per-process exporter uses; the sort is stable, so each file's
+    # internal order survives ties and B/E pairs stay balanced per
+    # (pid, tid)
+    events.sort(key=lambda e: (
+        0 if e.get("ph") == "M" else 1,
+        e.get("ts", 0.0),
+        0 if e.get("ph") != "E" else 1,
+    ))
+    return {"events": events, "replicas": replicas}
 
 
 def trace_summary(events: Iterable[dict]) -> list[dict]:

@@ -104,12 +104,15 @@ def _fmt_value(v: float) -> str:
 def render_prometheus(
     registry: MetricsRegistry | None = None,
     extra: Iterable[Sample] = (),
+    base_labels: dict | None = None,
 ) -> str:
     """Render a registry (+ provider samples) as Prometheus text
     exposition format v0.0.4. Counters gain the conventional ``_total``
     suffix; histograms render as summaries with ``quantile`` series.
     Output ordering is deterministic: families by name, series by label
-    block."""
+    block. ``base_labels`` are merged into EVERY series (series labels
+    win) — how a fleet replica stamps ``replica=`` onto its whole
+    endpoint."""
     reg = registry if registry is not None else get_registry()
     # family name -> (type, {label_block: value}); keyed by label block
     # so a provider sample OVERRIDES a registry series with the same
@@ -119,6 +122,8 @@ def render_prometheus(
     families: dict[str, tuple[str, dict[str, float]]] = {}
 
     def add(ftype: str, fname: str, labels, value: float) -> None:
+        if base_labels:
+            labels = {**base_labels, **dict(labels or {})}
         fam = families.setdefault(fname, (ftype, {}))
         if fam[0] != ftype:
             # same family name claimed by two metric types: keep the
@@ -180,6 +185,23 @@ def parse_prometheus(text: str) -> dict[str, float]:
     return out
 
 
+def parse_prometheus_types(text: str) -> dict[str, str]:
+    """Family-name → type map from the ``# TYPE`` lines — the half of
+    the exposition :func:`parse_prometheus` drops, needed by the fleet
+    aggregator to tell summed-across-replicas counters from
+    kept-per-replica gauges/summaries.
+
+    >>> parse_prometheus_types('# TYPE a counter\\na 1.0\\n')
+    {'a': 'counter'}
+    """
+    out: dict[str, str] = {}
+    for line in text.splitlines():
+        parts = line.strip().split()
+        if len(parts) == 4 and parts[0] == "#" and parts[1] == "TYPE":
+            out[parts[2]] = parts[3]
+    return out
+
+
 class _Handler(http.server.BaseHTTPRequestHandler):
     server_version = "tnc-tpu-telemetry/1.0"
     protocol_version = "HTTP/1.1"
@@ -199,6 +221,10 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 status = 200 if health.get("status") == "ok" else 503
             elif path == "/slo":
                 body = json.dumps(srv.slo()).encode("utf-8")
+                ctype = "application/json"
+                status = 200
+            elif path == "/fleet":
+                body = json.dumps(srv.fleet()).encode("utf-8")
                 ctype = "application/json"
                 status = 200
             else:
@@ -230,7 +256,14 @@ class TelemetryServer:
       families merged into ``/metrics`` next to the obs registry;
     - ``health_fn() -> dict`` — the ``/healthz`` body (``status`` key;
       anything but ``"ok"`` answers 503);
-    - ``slo_fn() -> dict`` — the ``/slo`` JSON body.
+    - ``slo_fn() -> dict`` — the ``/slo`` JSON body;
+    - ``fleet_fn() -> dict`` — the ``/fleet`` JSON body (the federated
+      cross-replica view, usually a
+      :meth:`~tnc_tpu.obs.fleet.FleetAggregator.snapshot`).
+
+    ``base_labels`` stamps every ``/metrics`` series (fleet replicas
+    pass ``{"replica": "p<idx>"}`` so scrapes stay distinguishable
+    after federation).
 
     :meth:`stop` shuts the listener down and **releases the port**
     (pinned by ``tests/test_slo.py::test_endpoint_port_release``).
@@ -251,6 +284,8 @@ class TelemetryServer:
         health_fn: Callable[[], dict] | None = None,
         slo_fn: Callable[[], dict] | None = None,
         extra_metrics_fn: Callable[[], Iterable[Sample]] | None = None,
+        fleet_fn: Callable[[], dict] | None = None,
+        base_labels: dict | None = None,
     ):
         self.registry = registry
         self.host = host
@@ -258,6 +293,8 @@ class TelemetryServer:
         self.health_fn = health_fn
         self.slo_fn = slo_fn
         self.extra_metrics_fn = extra_metrics_fn
+        self.fleet_fn = fleet_fn
+        self.base_labels = dict(base_labels) if base_labels else None
         self._httpd: http.server.ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
@@ -268,6 +305,7 @@ class TelemetryServer:
         return render_prometheus(
             self.registry if self.registry is not None else get_registry(),
             extra,
+            base_labels=self.base_labels,
         )
 
     def health(self) -> dict:
@@ -275,6 +313,9 @@ class TelemetryServer:
 
     def slo(self) -> dict:
         return self.slo_fn() if self.slo_fn else {}
+
+    def fleet(self) -> dict:
+        return self.fleet_fn() if self.fleet_fn else {"enabled": False}
 
     # -- lifecycle -------------------------------------------------------
 

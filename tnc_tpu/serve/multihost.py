@@ -46,6 +46,7 @@ Deployment shape (see ``docs/serving.md``):
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import weakref
 from typing import Sequence
@@ -53,6 +54,7 @@ from typing import Sequence
 import numpy as np
 
 from tnc_tpu import obs
+from tnc_tpu.obs import fleet as _fleet
 from tnc_tpu.parallel.partitioned import broadcast_object, gather_objects
 from tnc_tpu.serve.rebind import BoundProgram, bind_template
 
@@ -242,6 +244,7 @@ class ClusterDispatcher:
         self.root = int(root)
         self._lock = threading.Lock()
         self._stopped = False
+        self._seq = 0  # dispatch sequence, rides the TraceContext
         # (weakref to bound, sig): an `is` check on the live object —
         # never id(), which CPython recycles across swap generations
         self._sig_cache: tuple | None = None
@@ -273,10 +276,24 @@ class ClusterDispatcher:
         with self._lock:
             if self._stopped:
                 raise RuntimeError("ClusterDispatcher is stopped")
+            self._seq += 1
+            # cross-host trace propagation: the service set this batch's
+            # identity (request ids, kind, plan generation) in a
+            # thread-local around the dispatcher call; ship it with the
+            # command so every worker's spans carry the root's rids
+            ctx = _fleet.current_dispatch_context()
+            trace = _fleet.TraceContext(
+                riders=ctx.riders if ctx is not None else "",
+                kind=ctx.kind if ctx is not None else mode,
+                generation=ctx.generation if ctx is not None else 0,
+                seq=self._seq,
+                root_process=me,
+                root_pid=os.getpid(),
+            ).to_obj()
             if n > 1:
                 try:
                     broadcast_object(
-                        (mode, list(bits), self._plan_sig(bound)),
+                        (mode, list(bits), self._plan_sig(bound), trace),
                         root=self.root,
                     )
                 except Exception as exc:
@@ -305,7 +322,7 @@ class ClusterDispatcher:
                 return
             self._stopped = True
             if n > 1:
-                broadcast_object(("stop", None, None), root=self.root)
+                broadcast_object(("stop", None, None, None), root=self.root)
 
 
 def serve_cluster(
@@ -315,6 +332,8 @@ def serve_cluster(
     plan_cache=None,
     telemetry_port: int | None = None,
     telemetry_host: str = "127.0.0.1",
+    fleet_dir: str | None = None,
+    heartbeat_s: float = 2.0,
 ) -> int:
     """Worker-process serving loop: park on the root's command channel
     and answer each batch's shard until the root's
@@ -332,6 +351,16 @@ def serve_cluster(
     serve_telemetry` instead — one scrape target per replica either
     way. The endpoint stops (port released) when the loop exits.
 
+    ``fleet_dir`` (or ``TNC_TPU_FLEET_DIR``) joins this worker to the
+    shared :class:`~tnc_tpu.obs.fleet.FleetRegistry`: a background
+    :class:`~tnc_tpu.obs.fleet.Heartbeat` republishes identity, batches
+    served, the in-flight state and the scrape URL every
+    ``heartbeat_s`` seconds, and the entry retires (clean leave) when
+    the loop exits. With a registry joined, ``/healthz`` reports the
+    replica identity and heartbeat age, and every ``/metrics`` family
+    carries a ``replica=`` label — the root's
+    :class:`~tnc_tpu.obs.fleet.FleetAggregator` federates both.
+
     Every command carries the root's plan signature; a mismatch (the
     root's service adopted a background-replanner/shared-cache swap)
     makes the worker rebuild its bound through ``plan_cache`` — a
@@ -346,7 +375,13 @@ def serve_cluster(
         raise RuntimeError(
             "serve_cluster is the NON-root side of a multi-process fleet"
         )
-    progress = {"served": 0}
+    progress = {"served": 0, "inflight": 0}
+    identity = _fleet.replica_identity()
+    name = _fleet.replica_name(identity)
+    fleet_dir = fleet_dir or os.environ.get("TNC_TPU_FLEET_DIR") or None
+    registry = (
+        _fleet.FleetRegistry(fleet_dir, name=name) if fleet_dir else None
+    )
     telemetry = None
     if telemetry_port is not None:
         from tnc_tpu.obs.http import TelemetryServer
@@ -358,14 +393,35 @@ def serve_cluster(
                 "status": "ok",
                 "role": "worker",
                 "process": me,
+                "replica": identity,
+                "heartbeat_age_s": (
+                    registry.last_heartbeat_age_s()
+                    if registry is not None else None
+                ),
                 "batches_served": progress["served"],
             },
+            base_labels={"replica": name},
+        ).start()
+    heartbeat = None
+    if registry is not None:
+        heartbeat = _fleet.Heartbeat(
+            registry,
+            provider=lambda: {
+                "role": "worker",
+                "queue_depth": 0,
+                "inflight": progress["inflight"],
+                "batches_served": progress["served"],
+                "url": telemetry.url if telemetry is not None else None,
+            },
+            interval_s=heartbeat_s,
         ).start()
     try:
         return _serve_cluster_loop(
             bound, backend, root, plan_cache, n, me, progress
         )
     finally:
+        if heartbeat is not None:
+            heartbeat.stop()  # retires the registry entry: clean leave
         if telemetry is not None:
             telemetry.stop()
 
@@ -376,8 +432,12 @@ def _serve_cluster_loop(
     served = 0
     my_sig = bound.program.signature_digest()
     while True:
-        cmd, payload, want_sig = broadcast_object(
-            None, root=root, wait_forever=True
+        msg = broadcast_object(None, root=root, wait_forever=True)
+        cmd, payload, want_sig = msg[0], msg[1], msg[2]
+        # 4th element since the fleet plane: the root's TraceContext
+        # (absent from an older root's 3-tuple — adoption just skips)
+        trace = _fleet.TraceContext.from_obj(
+            msg[3] if len(msg) > 3 else None
         )
         if cmd == "stop":
             logger.info("serve_cluster: stop after %d batches", served)
@@ -413,12 +473,30 @@ def _serve_cluster_loop(
             bound, my_sig = new_bound, new_sig
             obs.counter_add("serve.cluster.worker_rebinds")
             logger.info("serve_cluster: adopted root's plan swap")
-        if cmd == "slices":
-            cluster_amplitudes_sliced(bound, payload, backend, root=root)
-        elif cmd == "bras":
-            cluster_amplitudes(bound, payload, backend, root=root)
-        else:  # unknown command: the fleet is version-skewed — stop loud
+        if cmd not in ("slices", "bras"):
+            # unknown command: the fleet is version-skewed — stop loud
             raise RuntimeError(f"serve_cluster: unknown command {cmd!r}")
+        progress["inflight"] = len(payload) if payload is not None else 0
+        # adopt the root's trace context: this worker's serve.dispatch
+        # span (and, via the ambient trace args, every partitioned.* /
+        # slice span nested under it) carries the ROOT's request ids,
+        # so the merged fleet timeline attributes this host's dispatch
+        # wall time to the same rids the root's rollup uses
+        with _fleet.adopt_trace_context(trace), obs.span(
+            "serve.dispatch",
+            batch=len(payload) if payload is not None else 0,
+            kind=trace.kind if trace is not None else cmd,
+            riders=trace.riders if trace is not None else "",
+            generation=trace.generation if trace is not None else 0,
+            seq=trace.seq if trace is not None else 0,
+            remote=1,
+            process=me,
+        ):
+            if cmd == "slices":
+                cluster_amplitudes_sliced(bound, payload, backend, root=root)
+            else:
+                cluster_amplitudes(bound, payload, backend, root=root)
         served += 1
         progress["served"] = served
+        progress["inflight"] = 0
         obs.counter_add("serve.cluster.worker_batches")

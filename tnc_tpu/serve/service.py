@@ -72,6 +72,7 @@ from typing import Iterable
 import numpy as np
 
 from tnc_tpu import obs
+from tnc_tpu.obs import fleet as _fleet
 from tnc_tpu.obs.core import QuantileSummary
 from tnc_tpu.ops.backends import JaxBackend
 from tnc_tpu.resilience import retry as _retry
@@ -239,6 +240,11 @@ class ContractionService:
         # latency change is attributable to the plan that served it
         self._generation = 0
         self._telemetry = None  # attached TelemetryServer, if any
+        # fleet plane (attach_fleet): replica-registry membership +
+        # heartbeat + the /fleet federation source
+        self._fleet_registry = None
+        self._fleet_heartbeat = None
+        self._fleet_aggregator = None
         self._slo = None
         self._slo_last_check = 0.0
         self.attach_slo(slo)
@@ -261,13 +267,23 @@ class ContractionService:
         approx: bool = False,
         approx_options: dict | None = None,
         telemetry_port: int | None = None,
+        fleet_dir: str | None = None,
+        fleet_endpoints=None,
+        fleet_heartbeat_s: float = 2.0,
         **kwargs,
     ) -> "ContractionService":
         """Build (plan/compile once, plan cache honored) and start.
 
         ``telemetry_port`` (0 = ephemeral) additionally starts the live
         scrape endpoint (:meth:`serve_telemetry`): ``/metrics`` +
-        ``/healthz`` + ``/slo``.
+        ``/healthz`` + ``/slo`` (+ ``/fleet`` once the fleet plane is
+        attached).
+
+        ``fleet_dir`` / ``fleet_endpoints`` join the fleet
+        observability plane (:meth:`attach_fleet`): this replica
+        heartbeats into the shared registry directory every
+        ``fleet_heartbeat_s`` seconds and the ``/fleet`` endpoint
+        federates every replica's telemetry.
 
         ``queries=True`` additionally registers the sampling /
         expectation / marginal query handlers for the same circuit
@@ -333,6 +349,12 @@ class ContractionService:
                 watcher.start()
             if telemetry_port is not None:
                 svc.serve_telemetry(port=telemetry_port)
+            if fleet_dir is not None or fleet_endpoints:
+                svc.attach_fleet(
+                    directory=fleet_dir,
+                    endpoints=fleet_endpoints or (),
+                    heartbeat_s=fleet_heartbeat_s,
+                )
         except Exception:
             # a bad option kwarg must not leak a running dispatcher
             # thread (or half the attachments) the caller can't reach
@@ -364,6 +386,9 @@ class ContractionService:
         watchers, self._watchers = list(self._watchers), []
         for watcher in watchers:
             watcher.stop()
+        heartbeat, self._fleet_heartbeat = self._fleet_heartbeat, None
+        if heartbeat is not None:
+            heartbeat.stop()  # retires the registry entry: clean leave
         telemetry, self._telemetry = self._telemetry, None
         if telemetry is not None:
             telemetry.stop()  # releases the port
@@ -880,8 +905,13 @@ class ContractionService:
         try:
             # the batch-level span carries the rider id list so the
             # trace rollup can attribute shared batch time back to
-            # request ids and query types
-            with obs.span(
+            # request ids and query types; the thread-local dispatch
+            # context carries the same identity to the pluggable
+            # dispatcher (whose signature has no rids) so a
+            # ClusterDispatcher can ship it to every worker's spans
+            with _fleet.dispatch_context(
+                riders=riders, kind=kind, generation=generation
+            ), obs.span(
                 "serve.dispatch",
                 batch=len(group), kind=kind, riders=riders,
                 generation=generation,
@@ -931,7 +961,10 @@ class ContractionService:
         for req in batch:
             t0 = time.monotonic()
             try:
-                with obs.span(
+                with _fleet.dispatch_context(
+                    riders=f"r{req.rid}", kind=req.kind,
+                    generation=generation,
+                ), obs.span(
                     "serve.dispatch",
                     batch=1, kind=req.kind, riders=f"r{req.rid}",
                     generation=generation, degraded=1,
@@ -1298,11 +1331,17 @@ class ContractionService:
 
         def health() -> dict:
             running = self._running
-            return {
+            body = {
                 "status": "ok" if running else "stopped",
                 "running": running,
                 "queue_depth": self.queue_depth() if running else 0,
+                "replica": _fleet.replica_identity(),
             }
+            if self._fleet_registry is not None:
+                body["heartbeat_age_s"] = (
+                    self._fleet_registry.last_heartbeat_age_s()
+                )
+            return body
 
         def slo() -> dict:
             if self._slo is None:
@@ -1312,6 +1351,14 @@ class ContractionService:
             body["recent_requests"] = self._slo.timelines()[-32:]
             return body
 
+        def fleet() -> dict:
+            # late-bound: attach_fleet may run after serve_telemetry
+            if self._fleet_aggregator is None:
+                return {"enabled": False}
+            body = self._fleet_aggregator.snapshot()
+            body["enabled"] = True
+            return body
+
         self._telemetry = TelemetryServer(
             registry=obs.get_registry(),
             host=host,
@@ -1319,8 +1366,89 @@ class ContractionService:
             health_fn=health,
             slo_fn=slo,
             extra_metrics_fn=self._prometheus_families,
+            fleet_fn=fleet,
         ).start()
         return self._telemetry
+
+    # -- fleet observability plane ----------------------------------------
+
+    def attach_fleet(
+        self,
+        directory: str | None = None,
+        endpoints=(),
+        heartbeat_s: float = 2.0,
+        name: str | None = None,
+        stale_after_s: float = 10.0,
+    ) -> None:
+        """Join the fleet observability plane (idempotent re-attach
+        replaces the previous membership).
+
+        ``directory`` — the shared :class:`~tnc_tpu.obs.fleet.
+        FleetRegistry` directory: this replica heartbeats its identity,
+        queue depth, SLO-alert/drift state and scrape URL every
+        ``heartbeat_s`` seconds, and the roster (with join/stale/leave
+        transitions) rides the ``/fleet`` body. ``endpoints`` — extra
+        ``{name: url}`` scrape targets (replicas outside the registry).
+        The root's own metrics are read in-process (no HTTP round-trip
+        to itself). See :class:`~tnc_tpu.obs.fleet.FleetAggregator`."""
+        if self._fleet_heartbeat is not None:
+            self._fleet_heartbeat.stop()
+            self._fleet_heartbeat = None
+        registry = None
+        if directory is not None:
+            registry = _fleet.FleetRegistry(
+                directory, name=name, stale_after_s=stale_after_s
+            )
+
+            def provider() -> dict:
+                payload = {
+                    "role": "root",
+                    "queue_depth": self.queue_depth(),
+                    "url": (
+                        self._telemetry.url
+                        if self._telemetry is not None else None
+                    ),
+                }
+                if self._slo is not None:
+                    slo_stats = self._slo.stats()
+                    payload["slo_alerts"] = len(slo_stats.get("alerts", ()))
+                    payload["slo_alerts_total"] = slo_stats.get(
+                        "alerts_total", 0
+                    )
+                    drift = slo_stats.get("drift", {})
+                    payload["drift_alerting"] = sum(
+                        1 for row in drift.values()
+                        if isinstance(row, dict) and row.get("alerting")
+                    )
+                return payload
+
+            self._fleet_registry = registry
+            self._fleet_heartbeat = _fleet.Heartbeat(
+                registry, provider=provider, interval_s=heartbeat_s
+            ).start()
+
+        def local_render() -> str:
+            if self._telemetry is not None:
+                return self._telemetry.render_metrics()
+            from tnc_tpu.obs.http import render_prometheus
+
+            return render_prometheus(
+                obs.get_registry(), self._prometheus_families()
+            )
+
+        local_name = name if name is not None else _fleet.replica_name()
+        self._fleet_aggregator = _fleet.FleetAggregator(
+            endpoints=endpoints,
+            registry=registry,
+            local=(local_name, local_render),
+        )
+
+    def fleet_snapshot(self) -> dict | None:
+        """The federated fleet view (same body as ``/fleet``), or None
+        before :meth:`attach_fleet`."""
+        if self._fleet_aggregator is None:
+            return None
+        return self._fleet_aggregator.snapshot()
 
     def _prometheus_families(self) -> list:
         """The service's own metric families for ``/metrics`` —
