@@ -82,7 +82,12 @@ JSON — queries/sec, batch-size distribution, p50/p99 latency — so the
 perf gate can watch serving throughput alongside contraction
 wall-clock (knobs: BENCH_SERVE_QUERIES (256), BENCH_SERVE_QUBITS (10),
 BENCH_SERVE_DEPTH (6), BENCH_SERVE_BATCH (32), BENCH_SERVE_WAIT_MS
-(2), BENCH_SERVE_BACKEND jax|numpy).
+(2), BENCH_SERVE_BACKEND jax|numpy). BENCH_SERVE_OPENLOOP=qps:duration
+adds the open-loop overload leg: arrivals at a fixed rate regardless
+of completions, on an elastic-enabled service with a priority rider
+every BENCH_SERVE_OPENLOOP_PRIO_EVERY-th (16) arrival — tail
+percentiles, admission rejections, and the serve.elastic
+preemption/reassignment counter deltas land in ``serving.openloop``.
 
 ``--resume`` arms slice-range checkpointing (sets TNC_TPU_CKPT
 to .cache/bench_ckpt unless already set): a run killed mid-slice-range
@@ -2290,7 +2295,141 @@ def _serve_bench() -> dict:
             f"max heartbeat gap {fleet_block['max_heartbeat_gap_s']} s, "
             f"dispatch attribution {fleet_block['attribution_share']}"
         )
+    openloop_spec = os.environ.get("BENCH_SERVE_OPENLOOP")
+    if openloop_spec:
+        block["openloop"] = _serve_openloop_block(
+            openloop_spec, backend, n, depth, max_batch, wait_ms
+        )
+        o = block["openloop"]
+        log(
+            f"[bench]   open-loop: offered {o['offered_qps']} q/s x "
+            f"{o['duration_s']} s ({o['offered']} arrivals), completed "
+            f"{o['completed_qps']} q/s, p99 "
+            f"{o['latency_s']['p99'] * 1e3:.2f} ms, max "
+            f"{o['latency_s']['max'] * 1e3:.2f} ms, rejected "
+            f"{o['rejected']}, preempted {o['preempted']}, reassigned "
+            f"{o['reassigned']}"
+        )
     return block
+
+
+def _serve_openloop_block(
+    spec: str, backend, n: int, depth: int, max_batch: int, wait_ms: float
+) -> dict:
+    """``BENCH_SERVE_OPENLOOP=qps:duration`` — open-loop overload leg.
+
+    Unlike the closed-loop headline run (a thread pool that can only
+    have 16 requests in flight, so a slow service throttles its own
+    offered load), arrivals here are fired at a FIXED rate for the
+    duration regardless of completions — queueing delay lands in the
+    tail percentiles instead of silently shrinking the load. The leg
+    runs on a fresh elastic-enabled service (``submit(tenant=,
+    priority=)``): every BENCH_SERVE_OPENLOOP_PRIO_EVERY-th (16)
+    arrival rides the priority lane under a separate tenant, so
+    weighted-fair ordering and (on sliced plans) checkpoint-boundary
+    preemption are exercised under overload. The block records offered
+    vs completed qps, admission rejections, failed requests, tail
+    latency (p50/p90/p99/max), and the run's delta of the
+    ``serve.elastic`` preemption/reassignment counters —
+    ``scripts/perf_gate.py`` warn cross-checks the tail, the completed
+    rate, and the failure/rejection shares."""
+    import tempfile
+
+    from tnc_tpu.builders.random_circuit import brickwork_circuit
+    from tnc_tpu.serve import ContractionService, ElasticConfig, QueueFullError
+    from tnc_tpu.serve import elastic as elastic_mod
+
+    rate_s, _, dur_s = spec.partition(":")
+    try:
+        rate, duration = float(rate_s), float(dur_s)
+    except ValueError:
+        raise ValueError(
+            f"BENCH_SERVE_OPENLOOP expects 'qps:duration', got {spec!r}"
+        ) from None
+    if rate <= 0 or duration <= 0:
+        raise ValueError(
+            f"BENCH_SERVE_OPENLOOP qps and duration must be > 0: {spec!r}"
+        )
+    prio_every = _env_int("BENCH_SERVE_OPENLOOP_PRIO_EVERY", 16)
+    max_queue = _env_int("BENCH_SERVE_OPENLOOP_QUEUE", 256)
+    rng = np.random.default_rng(_env_int("BENCH_SEED", 42) + 1)
+    tick = 1.0 / rate
+    # a Circuit converts to a network exactly once, and the closed-loop
+    # leg already consumed the shared one — rebuild the same structure
+    circuit = brickwork_circuit(
+        n, depth, np.random.default_rng(_env_int("BENCH_SEED", 42))
+    )
+
+    with tempfile.TemporaryDirectory(prefix="tnc_bench_openloop_") as ckpt:
+        with ContractionService.from_circuit(
+            circuit,
+            backend=backend,
+            max_batch=max_batch,
+            max_wait_ms=wait_ms,
+            max_queue=max_queue,
+        ) as svc:
+            svc.enable_elastic(ElasticConfig(ckpt_dir=ckpt))
+            # warmup: singleton + full batch buckets compile before the
+            # clock starts, same as the closed-loop leg
+            warm_bits = "".join(rng.choice(["0", "1"], n))
+            svc.amplitude(warm_bits)
+            for f in [svc.submit(warm_bits) for _ in range(max_batch)]:
+                f.result(timeout=600)
+            svc.reset_stats()
+            before = dict(elastic_mod.counters())
+            futs = []
+            rejected = 0
+            i = 0
+            t0 = time.monotonic()
+            deadline = t0 + duration
+            while True:
+                target = t0 + i * tick
+                if target >= deadline:
+                    break
+                now = time.monotonic()
+                if now >= deadline:
+                    break
+                if now < target:
+                    time.sleep(target - now)
+                prio = bool(prio_every) and i % prio_every == prio_every - 1
+                try:
+                    futs.append(
+                        svc.submit(
+                            "".join(rng.choice(["0", "1"], n)),
+                            tenant="burst" if prio else "default",
+                            priority=5 if prio else 0,
+                        )
+                    )
+                except QueueFullError:
+                    rejected += 1  # admission control under overload
+                i += 1
+            offered = i
+            failed = 0
+            for f in futs:
+                try:
+                    f.result(timeout=600)
+                except Exception:
+                    failed += 1
+            wall = time.monotonic() - t0  # arrival window + drain
+            stats = svc.stats()
+            after = dict(elastic_mod.counters())
+    delta = {
+        k: after.get(k, 0) - before.get(k, 0) for k in set(after) | set(before)
+    }
+    completed = stats["counts"]["completed"]
+    return {
+        "offered_qps": rate,
+        "duration_s": duration,
+        "offered": offered,
+        "rejected": rejected,
+        "failed": failed,
+        "completed": completed,
+        "completed_qps": round(completed / wall, 1) if wall > 0 else 0.0,
+        "drain_wall_s": round(wall, 4),
+        "latency_s": stats["latency_s"],
+        "preempted": delta.get("preempted", 0),
+        "reassigned": delta.get("reassigned", 0),
+    }
 
 
 def _serve_fleet_block() -> dict | None:

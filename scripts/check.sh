@@ -101,6 +101,9 @@ TNC_TPU_PLATFORM=cpu python scripts/fleet_obs_smoke.py
 echo "== distributed smoke (2-process scatter -> overlapped fan-in -> gather, oracle bit-compare) =="
 python scripts/distributed_smoke.py
 
+echo "== elastic smoke (2-process fleet, SIGKILL worker mid-sliced-request: one reassignment, checkpoint resume, bit-identical) =="
+python scripts/elastic_smoke.py
+
 echo "== fused-chain smoke (multi-step Pallas kernel, interpret mode: dispatch spans drop) =="
 TNC_TPU_PLATFORM=cpu python scripts/chain_smoke.py
 

@@ -58,6 +58,7 @@ REQUIRED_MODULES = (
     os.path.join("tnc_tpu", "serve", "replan.py"),
     os.path.join("tnc_tpu", "serve", "multihost.py"),
     os.path.join("tnc_tpu", "serve", "reuse.py"),
+    os.path.join("tnc_tpu", "serve", "elastic.py"),
 )
 
 executed: set[tuple[str, int]] = set()

@@ -1,0 +1,225 @@
+#!/usr/bin/env python
+"""2-process elastic-fleet smoke for check.sh: SIGKILL one worker
+mid-sliced-request, bit-identical completion, exactly one reassignment.
+
+Spawns a 2-process serving fleet under ``jax.distributed.initialize``
+(CPU + the coordination-KV transport). The root runs a
+``ContractionService`` with a roster-aware ``ClusterDispatcher``
+(FleetRegistry membership, bounded collective timeouts, shared
+slice-range checkpoint directory); the worker parks in
+``serve_cluster`` with a deterministic ``cluster.worker`` kill rule
+armed — it SIGKILLs itself at its first slice-boundary callback of the
+round, mid-way through its assigned slice range, AFTER its checkpoint
+persisted the partial accumulator.
+
+The root's bounded gather then yields a ``GatherLost`` for the dead
+worker, reassigns the lost range to itself, and RESUMES from the
+worker's checkpoint — so the batch completes **bit-identical** to the
+unfailed 2-process oracle (the same per-range partials summed in the
+same order), with exactly one ``serve.elastic.reassigned`` event and
+zero failed requests.
+
+Usage:  python scripts/elastic_smoke.py            # runner
+        python scripts/elastic_smoke.py --role PID NPROCS PORT DIR
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N_SLICES = 4  # brickwork(8, 6) @ target_size=64 slices into 4
+BITS = ["00000011", "01001101", "11110000", "00101010", "10000001",
+        "01111110"]
+
+
+def _bind(cache_dir: str):
+    import numpy as np
+
+    from tnc_tpu.builders.random_circuit import brickwork_circuit
+    from tnc_tpu.serve import PlanCache, bind_circuit
+
+    cache = PlanCache(cache_dir)
+    bound = bind_circuit(
+        brickwork_circuit(8, 6, np.random.default_rng(9)),
+        plan_cache=cache, target_size=64,
+    )
+    assert bound.sliced is not None, "expected an HBM-sliced structure"
+    assert bound.sliced.slicing.num_slices == N_SLICES, (
+        bound.sliced.slicing.num_slices
+    )
+    return bound, cache
+
+
+def role(pid: int, nprocs: int, port: str, base: str) -> None:
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    sys.path.insert(0, REPO)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
+    jax.distributed.initialize(
+        f"127.0.0.1:{port}", num_processes=nprocs, process_id=pid
+    )
+
+    import numpy as np
+
+    from tnc_tpu.obs.fleet import FleetRegistry
+    from tnc_tpu.parallel.partitioned import broadcast_object
+    from tnc_tpu.resilience.faultinject import configure_faults
+    from tnc_tpu.serve import (
+        ClusterDispatcher,
+        ContractionService,
+        serve_cluster,
+    )
+    from tnc_tpu.serve import elastic as elastic_mod
+
+    fleet_dir = os.path.join(base, "fleet")
+    ckpt_dir = os.path.join(base, "ckpt")
+    os.makedirs(ckpt_dir, exist_ok=True)
+
+    if pid == 0:
+        bound, cache = _bind(os.path.join(base, "plans"))
+    broadcast_object(None, root=0)  # barrier: root published the plan
+    if pid != 0:
+        bound, cache = _bind(os.path.join(base, "plans"))
+
+    if pid != 0:
+        # die at the FIRST slice-boundary callback of the collective
+        # round — mid-range, one slice in, checkpoint already persisted
+        # (TNC_TPU_CKPT_EVERY=1 from the runner env)
+        configure_faults(f"cluster.worker(phase=slice,process={pid})=kill")
+        serve_cluster(
+            bound, plan_cache=cache, fleet_dir=fleet_dir, heartbeat_s=0.3
+        )
+        # unreachable: the kill rule fires during the first sliced round
+        print("worker survived the kill round", flush=True)
+        os._exit(3)
+
+    # ---- root -----------------------------------------------------------
+    registry = FleetRegistry(fleet_dir, name="smoke-root", stale_after_s=3.0)
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        live = elastic_mod.live_processes(registry, nprocs, root=0)
+        if 1 in live:
+            break
+        time.sleep(0.1)
+    assert 1 in elastic_mod.live_processes(registry, nprocs, root=0), (
+        "worker never joined the fleet registry"
+    )
+
+    det = [bound.template.request_bits(b) for b in BITS]
+    # the unfailed 2-process oracle: the roster-aware round assigns
+    # contiguous slice ranges over live {0, 1}; each range partial is
+    # deterministic, and the root sums partials in range order — so the
+    # oracle is computable locally, bitwise
+    ranges = elastic_mod.assign_ranges(N_SLICES, {0, 1}, nprocs)
+    oracle = None
+    for lo, hi in ranges:
+        if hi <= lo:
+            continue
+        part = np.asarray(bound.amplitudes_det(det, slice_range=(lo, hi)))
+        oracle = part if oracle is None else oracle + part
+
+    dispatcher = ClusterDispatcher(
+        registry=registry, stale_after_s=3.0, timeout_s=5.0,
+        ckpt_dir=ckpt_dir,
+    )
+    svc = ContractionService(
+        bound, dispatcher=dispatcher, max_batch=8, max_wait_ms=250.0
+    )
+    svc.start()
+    futs = [svc.submit(b) for b in BITS]
+    got = np.asarray([f.result(timeout=180) for f in futs])
+    stats = svc.stats()
+    svc.stop()
+    try:
+        dispatcher.stop(drain_timeout_s=10.0)
+    except Exception as exc:  # noqa: BLE001 — the peer is dead by design
+        print(f"dispatcher stop vs dead worker: {exc}", flush=True)
+
+    assert np.array_equal(got, oracle), (
+        "killed-worker batch is not bit-identical to the unfailed "
+        "2-process oracle", got, oracle,
+    )
+    reassigned = elastic_mod.counters().get("reassigned", 0)
+    assert reassigned == 1, f"expected exactly 1 reassignment, {reassigned}"
+    assert stats["counts"]["failed"] == 0, stats["counts"]
+    assert stats["counts"]["completed"] == len(BITS), stats["counts"]
+    print(f"proc {pid}: reassigned={reassigned}", flush=True)
+    print(f"proc {pid}: ELASTIC SMOKE OK", flush=True)
+    sys.stdout.flush()
+    os._exit(0)  # skip jax.distributed teardown against a dead peer
+
+
+def runner() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = str(s.getsockname()[1])
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if not k.startswith(("XLA_", "TPU_", "LIBTPU"))
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    env["TNC_TPU_CKPT_EVERY"] = "1"  # per-slice cadence: resume substrate
+    nprocs = 2
+    with tempfile.TemporaryDirectory(prefix="tnc_elastic_smoke_") as base:
+        procs = [
+            subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--role",
+                 str(pid), str(nprocs), port, base],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env, cwd=REPO,
+            )
+            for pid in range(nprocs)
+        ]
+        outs = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=300)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                raise
+            outs.append(out)
+    ok = True
+    root_rc, root_out = procs[0].returncode, outs[0]
+    if root_rc != 0 or "ELASTIC SMOKE OK" not in root_out:
+        print(f"-- root FAILED (rc={root_rc}):\n{root_out}", file=sys.stderr)
+        ok = False
+    if "reassigned=1" not in root_out:
+        print(f"-- root missing reassignment pin:\n{root_out}",
+              file=sys.stderr)
+        ok = False
+    # the worker must have died to the injected SIGKILL, not exited
+    worker_rc = procs[1].returncode
+    if worker_rc != -signal.SIGKILL:
+        print(f"-- worker expected SIGKILL, rc={worker_rc}:\n{outs[1]}",
+              file=sys.stderr)
+        ok = False
+    if not ok:
+        return 1
+    print("elastic smoke: worker SIGKILLed mid-sliced-request; range "
+          "reassigned once, resumed from checkpoint, batch bit-identical "
+          "to the unfailed 2-process oracle, zero failed requests")
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--role":
+        role(int(sys.argv[2]), int(sys.argv[3]), sys.argv[4], sys.argv[5])
+    else:
+        sys.exit(runner())

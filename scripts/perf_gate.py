@@ -349,6 +349,54 @@ def compare(
             f"numerically transparent"
         )
 
+    # open-loop overload cross-check (the BENCH_SERVE_OPENLOOP block):
+    # under fixed-rate arrivals the service cannot throttle its own
+    # load, so a completed-rate collapse or a tail blow-up is real
+    # capacity loss even when the closed-loop headline absorbed it; a
+    # failed request under overload means a deadline/dispatch error
+    # leaked to a caller instead of admission control rejecting early
+    bol = (base.get("serving") or {}).get("openloop") or {}
+    col = (cand.get("serving") or {}).get("openloop") or {}
+    if col.get("failed"):
+        msgs.append(
+            f"warning: {col['failed']} open-loop serving request(s) "
+            "failed under overload (errors leaking past admission "
+            "control?)"
+        )
+    bq, cq = bol.get("completed_qps"), col.get("completed_qps")
+    if bq and cq and float(cq) < float(bq) / 1.5:
+        msgs.append(
+            f"warning: open-loop completed rate regressed "
+            f"{float(bq):.4g} -> {float(cq):.4g} q/s at the same "
+            f"offered rate"
+        )
+    bp99 = (bol.get("latency_s") or {}).get("p99")
+    cp99 = (col.get("latency_s") or {}).get("p99")
+    if bp99 and cp99 and float(cp99) / float(bp99) > 1.5:
+        msgs.append(
+            f"warning: open-loop p99 latency regressed "
+            f"{float(cp99) / float(bp99):.2f}x "
+            f"({float(bp99) * 1e3:.4g}ms -> {float(cp99) * 1e3:.4g}ms)"
+        )
+
+    def _reject_share(row):
+        offered = (row or {}).get("offered", 0) or 0
+        return ((row or {}).get("rejected", 0) or 0) / offered if offered else 0.0
+
+    if col and _reject_share(col) > _reject_share(bol) + 0.25:
+        msgs.append(
+            f"warning: open-loop admission rejections jumped "
+            f"{_reject_share(bol):.2f} -> {_reject_share(col):.2f} of "
+            f"offered arrivals (queue draining slower?)"
+        )
+    for counter in ("preempted", "reassigned"):
+        bv, cv = bol.get(counter), col.get(counter)
+        if bv and not cv:
+            msgs.append(
+                f"warning: open-loop {counter} count dropped "
+                f"{bv} -> 0 (elastic path no longer exercised?)"
+            )
+
     # kernel-ladder per-bucket cross-check: effective-flop-credited MFU
     # when both records carry it, achieved FLOP/s otherwise — a bucket
     # whose kernel rung regressed (chain unfused, strassen fallen back)

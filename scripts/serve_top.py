@@ -19,9 +19,12 @@ shows, per refresh:
 Fleet mode renders one row per replica instead: pass several endpoint
 URLs, or ``--fleet <registry-dir>`` to discover replicas from a
 :class:`~tnc_tpu.obs.fleet.FleetRegistry` heartbeat directory (each
-row shows heartbeat age/state, queue depth, qps, p99, SLO alerts;
-replicas whose heartbeat carries a scrape ``url`` are polled live,
-the rest render from their last heartbeat payload).
+row shows heartbeat age/state, queue depth, qps, p99, SLO alerts,
+plus the elastic columns — the last collective round's per-process
+slice-range ``assign``ment from the root's heartbeat and the
+per-``tenant`` queue depths of elastic-enabled replicas; replicas
+whose heartbeat carries a scrape ``url`` are polled live, the rest
+render from their last heartbeat payload).
 
 Usage:
     python scripts/serve_top.py http://127.0.0.1:9100
@@ -221,7 +224,8 @@ def render_fleet_frame(
 ) -> tuple[str, dict[str, float]]:
     head = (
         f"{'replica':<18} {'state':<7} {'hb age':>7} {'queue':>6} "
-        f"{'qps':>7} {'p99 ms':>8} {'alerts':>6}"
+        f"{'qps':>7} {'p99 ms':>8} {'alerts':>6} {'assign':>12} "
+        f"{'tenants':<18}"
     )
     lines = [
         f"fleet_top — {len(sources)} replicas   {time.strftime('%H:%M:%S')}",
@@ -233,6 +237,19 @@ def render_fleet_frame(
         name, payload = src["name"], src["payload"]
         queue = payload.get("queue_depth", "?")
         alerts = payload.get("slo_alerts", "?")
+        # elastic columns: the root's heartbeat carries the last
+        # collective round's per-process slice-range assignment; any
+        # elastic-enabled replica carries its per-tenant queue depths
+        assignment = payload.get("assignment")
+        assign_s = (
+            ",".join(f"{lo}-{hi}" for lo, hi in assignment)
+            if assignment
+            else "-"
+        )
+        tenants = payload.get("tenants") or {}
+        tenants_s = (
+            ",".join(f"{t}:{d}" for t, d in sorted(tenants.items())) or "-"
+        )
         qps_s, p99_s = "-", "-"
         state = src["state"]
         if src["url"] is not None:
@@ -259,7 +276,8 @@ def render_fleet_frame(
         age_s = f"{age:.1f}s" if age is not None else "-"
         lines.append(
             f"{name:<18} {state:<7} {age_s:>7} {queue!s:>6} "
-            f"{qps_s:>7} {p99_s:>8} {alerts!s:>6}"
+            f"{qps_s:>7} {p99_s:>8} {alerts!s:>6} {assign_s:>12} "
+            f"{tenants_s:<18}"
         )
     return "\n".join(lines), completed_now
 

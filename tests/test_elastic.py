@@ -1,0 +1,431 @@
+"""tnc_tpu.serve.elastic: the elastic preemptible fleet's brain.
+
+Pins the subsystem's contracts:
+
+- **membership** — ``live_processes`` folds real FleetRegistry
+  heartbeats (process-index payloads, staleness, junk rows, roster
+  errors) into the live set; ``assign_ranges`` places contiguous
+  in-order ranges on exactly the live slots under every churn shape;
+- **scheduling** — ``weighted_fair_order`` is stride scheduling:
+  priority classes strictly first, a weight-2 tenant gets two slots
+  per weight-1 slot, FIFO within a tenant; per-tenant quotas reject
+  with :class:`TenantQuotaError` at admission;
+- **preemption** — a higher-priority submit preempts a running sliced
+  contraction at a checkpoint boundary, is served during the
+  interlude, and BOTH answers are **bit-identical** to their
+  never-preempted goldens; an always-yielding gate trips
+  :class:`PreemptionExhaustedError` instead of spinning;
+- **scaling** — :class:`ElasticController` decision table (depth/burn
+  thresholds, min/max clamps, cooldown, hooks) under an injected
+  clock; :class:`LocalAutoscaler` subprocess workers join/leave the
+  registry observably; the service surfaces ``stats()["elastic"]`` and
+  the ``serve_elastic_*`` Prometheus families.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tnc_tpu.serve import (
+    ContractionService,
+    ElasticConfig,
+    ElasticController,
+    LocalAutoscaler,
+    PlanCache,
+    TenantQuotaError,
+    assign_ranges,
+    bind_circuit,
+    live_processes,
+    weighted_fair_order,
+)
+from tnc_tpu.serve import elastic as elastic_mod
+
+
+@pytest.fixture(scope="module")
+def sliced_bound(tmp_path_factory):
+    """One sliced bound program for the whole module (4 slices)."""
+    from tnc_tpu.builders.random_circuit import brickwork_circuit
+
+    cache = PlanCache(str(tmp_path_factory.mktemp("plans")))
+    bound = bind_circuit(
+        brickwork_circuit(8, 6, np.random.default_rng(9)),
+        plan_cache=cache,
+        target_size=64,
+    )
+    assert bound.sliced is not None
+    assert bound.sliced.slicing.num_slices == 4
+    return bound
+
+
+# ---------------------------------------------------------------------------
+# membership
+# ---------------------------------------------------------------------------
+
+
+class TestLiveProcesses:
+    def test_roster_payloads(self, tmp_path):
+        from tnc_tpu.obs.fleet import FleetRegistry
+
+        d = str(tmp_path / "fleet")
+        FleetRegistry(d, name="w1").heartbeat({"process": 1})
+        FleetRegistry(d, name="w9").heartbeat({"process": 9})  # out of range
+        FleetRegistry(d, name="aux").heartbeat({"role": "aux"})  # no index
+        FleetRegistry(d, name="junk").heartbeat({"process": "nan"})  # bad
+        observer = FleetRegistry(d, name="obs")
+        assert live_processes(observer, 2, root=0) == {0, 1}
+        # the root is always a member, even when nothing heartbeats
+        assert live_processes(
+            FleetRegistry(str(tmp_path / "empty"), name="obs"), 4, root=3
+        ) == {3}
+
+    def test_stale_override_and_roster_error(self, tmp_path):
+        from tnc_tpu.obs.fleet import FleetRegistry
+
+        d = str(tmp_path / "fleet")
+        FleetRegistry(d, name="w1").heartbeat({"process": 1})
+        observer = FleetRegistry(d, name="obs")
+        # an impossible staleness bound judges every heartbeat dead
+        assert live_processes(
+            observer, 2, root=0, stale_after_s=-1.0
+        ) == {0}
+        # a generous one keeps it live
+        assert 1 in live_processes(
+            observer, 2, root=0, stale_after_s=60.0
+        )
+
+        class Boom:
+            def roster(self):
+                raise OSError("shared volume gone")
+
+        assert live_processes(Boom(), 4, root=0) == {0}
+
+
+class TestAssignRanges:
+    def test_known_placement(self):
+        assert assign_ranges(10, {0, 2}, 3) == [(0, 5), (0, 0), (5, 10)]
+        assert assign_ranges(4, {0, 1}, 2) == [(0, 2), (2, 4)]
+
+    @pytest.mark.parametrize(
+        "live", [set(), {0}, {0, 1}, {1, 2}, {3}, {0, 1, 2, 3}, {0, 7}]
+    )
+    def test_coverage_under_churn(self, live):
+        """Whatever subset is alive: a length-n map, contiguous
+        ascending ranges on live slots, (0, 0) on dead slots, and the
+        slot-order concatenation covers [0, n_items) exactly once IN
+        ORDER — the property the root's in-order partial sum needs."""
+        n = 4
+        ranges = assign_ranges(10, live, n)
+        assert len(ranges) == n
+        members = sorted(p for p in live if 0 <= p < n) or [0]
+        covered = []
+        for slot, (lo, hi) in enumerate(ranges):
+            assert 0 <= lo <= hi
+            if slot not in members:
+                assert (lo, hi) == (0, 0)
+            covered.extend(range(lo, hi))
+        assert covered == list(range(10))
+
+    def test_more_members_than_items(self):
+        ranges = assign_ranges(2, {0, 1, 2, 3}, 4)
+        assert [hi - lo for lo, hi in ranges] == [1, 1, 0, 0]
+
+
+# ---------------------------------------------------------------------------
+# weighted-fair scheduling
+# ---------------------------------------------------------------------------
+
+
+class TestWeightedFairOrder:
+    def test_priority_classes_first(self):
+        items = [("t", 0), ("t", 5), ("t", 0), ("u", 9)]
+        order = weighted_fair_order(
+            items, lambda i: i[0], lambda i: i[1]
+        )
+        assert order == [3, 1, 0, 2]
+
+    def test_stride_weights(self):
+        # [a, a, b, b] with b at weight 2: b's first request finishes
+        # at virtual time 0.5, a's at 1.0 — b gets the first slot and
+        # interleaves two-for-one
+        items = ["a", "a", "b", "b"]
+        order = weighted_fair_order(
+            items, lambda t: t, lambda t: 0, weights={"b": 2.0}
+        )
+        assert order == [2, 0, 3, 1]
+
+    def test_fifo_within_tenant_and_nonpositive_weight(self):
+        items = ["a", "a", "a"]
+        assert weighted_fair_order(
+            items, lambda t: t, lambda t: 0
+        ) == [0, 1, 2]
+        # a non-positive weight must not divide by zero or starve
+        assert sorted(
+            weighted_fair_order(
+                items, lambda t: t, lambda t: 0, weights={"a": 0.0}
+            )
+        ) == [0, 1, 2]
+
+
+# ---------------------------------------------------------------------------
+# tenant quotas
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_quota_rejects_at_admission(sliced_bound):
+    # a 10 s batching window parks submissions in the queue, so quota
+    # and depth assertions see them before any dispatch
+    svc = ContractionService(sliced_bound, max_batch=64, max_wait_ms=1e4)
+    svc.enable_elastic(ElasticConfig(tenant_quotas={"capped": 1}))
+    svc.start()
+    try:
+        svc.submit("0" * 8, tenant="capped")
+        with pytest.raises(TenantQuotaError):
+            svc.submit("1" * 8, tenant="capped")
+        # other tenants are uncapped; the quota is per-tenant
+        svc.submit("1" * 8, tenant="other")
+        assert svc.stats()["counts"]["rejected"] == 1
+        assert svc.stats()["elastic"]["tenants"] == {
+            "capped": 1, "other": 1,
+        }
+    finally:
+        svc.stop(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# preemption
+# ---------------------------------------------------------------------------
+
+
+def test_priority_preempts_sliced_contraction_bitwise(
+    sliced_bound, tmp_path, monkeypatch
+):
+    """The preemption pin: a priority-5 submit lands mid-way through a
+    long (slowed) sliced contraction, preempts it at a checkpoint
+    boundary, completes FIRST, and both answers are bit-identical to
+    their never-preempted goldens."""
+    from tnc_tpu.resilience.faultinject import faults
+
+    monkeypatch.setenv("TNC_TPU_CKPT_EVERY", "1")
+    long_bits, hi_bits = "00000011", "11110000"
+    golden_long = np.asarray(sliced_bound.amplitudes_det(
+        [sliced_bound.template.request_bits(long_bits)]
+    ))
+    golden_hi = np.asarray(sliced_bound.amplitudes_det(
+        [sliced_bound.template.request_bits(hi_bits)]
+    ))
+    before = elastic_mod.counters().get("preempted", 0)
+    done_order = []
+    svc = ContractionService(sliced_bound, max_batch=1, max_wait_ms=1.0)
+    svc.enable_elastic(ElasticConfig(ckpt_dir=str(tmp_path / "ckpt")))
+    with faults("sliced.slice=slow:0.1*-1"):
+        with svc:
+            f_long = svc.submit(long_bits, priority=0)
+            f_long.add_done_callback(lambda f: done_order.append("long"))
+            time.sleep(0.15)  # the long contraction is mid-slice-loop
+            f_hi = svc.submit(hi_bits, priority=5)
+            f_hi.add_done_callback(lambda f: done_order.append("hi"))
+            hi = np.asarray([f_hi.result(timeout=120)])
+            long = np.asarray([f_long.result(timeout=120)])
+    preempted = elastic_mod.counters().get("preempted", 0) - before
+    assert preempted >= 1, "the priority submit never preempted"
+    # the interlude ran the priority request to completion before the
+    # preempted contraction resumed — it must finish first
+    assert done_order[0] == "hi", done_order
+    assert np.array_equal(hi, golden_hi)
+    assert np.array_equal(long, golden_long), (
+        "preempted-and-resumed contraction is not bit-identical"
+    )
+    assert svc.stats()["counts"]["failed"] == 0
+
+
+def test_preemption_exhausted(sliced_bound, tmp_path, monkeypatch):
+    from tnc_tpu.serve.elastic import (
+        PreemptionExhaustedError,
+        preemptible_amplitudes,
+    )
+
+    monkeypatch.setenv("TNC_TPU_CKPT_EVERY", "1")
+    det = [sliced_bound.template.request_bits("00000011")]
+    with pytest.raises(PreemptionExhaustedError):
+        preemptible_amplitudes(
+            sliced_bound, det,
+            ckpt=str(tmp_path / "ckpt"),
+            should_yield=lambda cursor: True,
+            max_yields=2,
+        )
+
+
+# ---------------------------------------------------------------------------
+# scaling controller
+# ---------------------------------------------------------------------------
+
+
+class TestElasticController:
+    def _ctrl(self, clk, **kw):
+        kw.setdefault("min_replicas", 1)
+        kw.setdefault("max_replicas", 3)
+        kw.setdefault("scale_up_depth", 4)
+        kw.setdefault("scale_down_depth", 0)
+        kw.setdefault("burn_threshold", 2.0)
+        kw.setdefault("cooldown_s", 10.0)
+        return ElasticController(clock=lambda: clk["t"], **kw)
+
+    def test_decision_table_and_cooldown(self):
+        clk = {"t": 0.0}
+        ctrl = self._ctrl(clk)
+        d = ctrl.decide(queue_depth=10, live_replicas=1)
+        assert (d["action"], d["target"]) == ("scale_up", 2)
+        assert d["reason"].startswith("queue_depth")
+        # inside the cooldown a second trigger converts to hold
+        d = ctrl.decide(10, 2)
+        assert (d["action"], d["reason"]) == ("hold", "cooldown")
+        clk["t"] = 20.0
+        d = ctrl.decide(0, 2)
+        assert (d["action"], d["target"]) == ("scale_down", 1)
+        clk["t"] = 40.0
+        assert ctrl.decide(0, 1)["reason"] == "at_min"
+        # SLO burn forces capacity even with an empty queue...
+        assert ctrl.decide(0, 3, burn=5.0)["reason"] == "at_max"
+        clk["t"] = 60.0
+        d = ctrl.decide(0, 2, burn=5.0)
+        assert (d["action"], d["target"]) == ("scale_up", 3)
+        assert d["reason"].startswith("burn")
+        assert ctrl.last_decision == d
+
+    def test_steady_state_holds(self):
+        clk = {"t": 0.0}
+        ctrl = self._ctrl(clk)
+        d = ctrl.decide(2, 2, burn=0.5)  # neither threshold crossed
+        assert (d["action"], d["reason"]) == ("hold", "steady")
+        assert d["target"] == 2
+
+    def test_hooks_fan_out_and_survive_errors(self):
+        clk = {"t": 0.0}
+        ctrl = self._ctrl(clk)
+        seen = []
+        ctrl.on_decision.append(seen.append)
+        ctrl.on_decision.append(lambda d: 1 / 0)  # must not propagate
+        d = ctrl.decide(10, 1)
+        assert seen and seen[0]["action"] == d["action"] == "scale_up"
+
+    def test_burn_from_slo(self):
+        assert ElasticController.burn_from_slo(None) == 0.0
+        assert ElasticController.burn_from_slo({}) == 0.0
+        stats = {
+            "objectives": [
+                {"windows": [{"burn_long": 3.5}, {"burn_long": 1.0}]},
+                {"windows": [{"burn_long": "junk"}]},
+            ]
+        }
+        assert ElasticController.burn_from_slo(stats) == 3.5
+
+
+def test_service_elastic_check_uses_controller(sliced_bound):
+    clk = {"t": 0.0}
+    ctrl = ElasticController(
+        scale_up_depth=1, cooldown_s=0.0, clock=lambda: clk["t"]
+    )
+    svc = ContractionService(sliced_bound, max_batch=64, max_wait_ms=1e4)
+    svc.enable_elastic(ElasticConfig(), controller=ctrl)
+    svc.start()
+    try:
+        svc.submit("0" * 8)  # parked in the window: depth 1 >= threshold
+        decision = svc.elastic_check()
+        assert decision["action"] == "scale_up"
+        assert svc.stats()["elastic"]["controller"] == decision
+    finally:
+        svc.stop(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# local autoscaler (subprocess membership)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_local_autoscaler_joins_and_leaves_registry(tmp_path):
+    from tnc_tpu.obs.fleet import FleetRegistry
+
+    fleet = str(tmp_path / "fleet")
+    observer = FleetRegistry(fleet, name="observer")
+
+    def wait_for(pred, timeout=30.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            live = live_processes(observer, 8, root=0)
+            if pred(live):
+                return live
+            time.sleep(0.1)
+        return live_processes(observer, 8, root=0)
+
+    with LocalAutoscaler(fleet, base_process=1, interval_s=0.2) as auto:
+        assert auto.scale_to(2) == 2
+        live = wait_for(lambda s: {1, 2} <= s)
+        assert {0, 1, 2} <= live, live
+        # controller-driven actuation: scale_down retires the highest
+        assert auto.apply({"action": "scale_down"}) == 1
+        live = wait_for(lambda s: 2 not in s)
+        assert 2 not in live and 1 in live, live
+    assert auto.count() == 0
+
+
+# ---------------------------------------------------------------------------
+# observability surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_stats_and_prometheus_families(sliced_bound):
+    elastic_mod.count_event("reassigned")
+    ctrl = ElasticController()
+    svc = ContractionService(sliced_bound, max_batch=64, max_wait_ms=1e4)
+    svc.enable_elastic(
+        ElasticConfig(tenant_weights={"b": 2.0}, tenant_quotas={"b": 9}),
+        controller=ctrl,
+    )
+    svc.start()
+    try:
+        svc.submit("0" * 8, tenant="b")
+        block = svc.stats()["elastic"]
+        assert block["counters"].get("reassigned", 0) >= 1
+        assert block["tenants"] == {"b": 1}
+        assert block["weights"] == {"b": 2.0}
+        assert block["quotas"] == {"b": 9}
+        fams = svc._prometheus_families()
+        names = {name for (_kind, name, _labels, _v) in fams}
+        assert "serve.elastic.events" in names
+        assert "serve.elastic.tenant_queue" in names
+        assert "serve.elastic.scale_target" in names
+        tenant_rows = {
+            labels["tenant"]: v
+            for (_k, name, labels, v) in fams
+            if name == "serve.elastic.tenant_queue"
+        }
+        assert tenant_rows == {"b": 1.0}
+    finally:
+        svc.stop(drain=False)
+
+
+def test_counters_roundtrip():
+    before = elastic_mod.counters().get("__test__", 0)
+    elastic_mod.count_event("__test__")
+    elastic_mod.count_event("__test__", 2)
+    assert elastic_mod.counters()["__test__"] == before + 3
+
+
+# ---------------------------------------------------------------------------
+# dispatcher round-trip with an elastic envelope (single process)
+# ---------------------------------------------------------------------------
+
+
+def test_dispatcher_records_last_ranges(sliced_bound):
+    """Single-process dispatch degrades to local execution and leaves
+    the assignment surface (``last_ranges``) in its no-registry state."""
+    from tnc_tpu.serve import ClusterDispatcher
+
+    d = ClusterDispatcher()
+    out = d(sliced_bound, [sliced_bound.template.request_bits("0" * 8)])
+    assert out is not None
+    assert d.last_ranges is None  # no roster: even split, not recorded
+    d.stop()

@@ -4,13 +4,23 @@ contraction (bit-identical to the single-host executor), the sharded
 serving fan-out (batched bras across hosts, bit-identical to the
 single-host oracle batch), the shared plan cache (replica B binds with
 zero ``plan.find_path`` spans), and slice-range-sharded sliced serving
-— ``tests/_multihost_serve_worker.py`` is the per-process script."""
+— ``tests/_multihost_serve_worker.py`` is the per-process script.
+
+Single-process companions pin the elastic machinery the 2-process tier
+leans on: ``shard_ranges`` degenerate shapes and roster churn coverage,
+the reassigned-range checkpoint resume (bitwise equal to the unfailed
+oracle, provably skipping completed slices), and the
+``ClusterDispatcher.stop()`` drain — a stop racing an in-flight
+collective round waits behind it (or poisons on a bounded drain
+timeout) instead of interleaving the fleet's collective sequence."""
 
 import os
 import socket
 import subprocess
 import sys
 import tempfile
+import threading
+import time
 
 
 def _free_port() -> int:
@@ -68,3 +78,182 @@ def test_two_process_sharded_contraction_and_serving():
     fleet: shared-plan-cache replica hit, bra-sharded batches
     bit-identical to the oracle, slice-range-sharded sliced serving."""
     _run_workers(2, timeout=420)
+
+
+# ---------------------------------------------------------------------------
+# elastic companions (single process)
+# ---------------------------------------------------------------------------
+
+
+def test_shard_ranges_degenerate():
+    """Contiguous, in-order, complete under every degenerate shape —
+    the invariant the root's in-order partial concatenation/sum needs."""
+    from tnc_tpu.serve import shard_ranges
+
+    assert shard_ranges(7, 3) == [(0, 3), (3, 5), (5, 7)]
+    # more parts than items: trailing parts go empty, never negative
+    assert shard_ranges(2, 4) == [(0, 1), (1, 2), (2, 2), (2, 2)]
+    assert shard_ranges(0, 3) == [(0, 0), (0, 0), (0, 0)]
+    assert shard_ranges(5, 1) == [(0, 5)]
+    # nonsense part counts clamp instead of dividing by zero
+    assert shard_ranges(5, 0) == [(0, 5)]
+    assert shard_ranges(-3, 2) == [(0, 0), (0, 0)]
+    for n_items in (0, 1, 2, 7, 16):
+        for n_parts in (1, 2, 3, 8):
+            ranges = shard_ranges(n_items, n_parts)
+            assert len(ranges) == n_parts
+            flat = [i for lo, hi in ranges for i in range(lo, hi)]
+            assert flat == list(range(max(n_items, 0)))
+
+
+def test_assign_ranges_churn_coverage():
+    """Roster churn between rounds (members joining/leaving in any
+    combination) never loses or reorders work: dead slots get (0, 0),
+    live slots cover the items completely and in slot order."""
+    from tnc_tpu.serve import assign_ranges
+
+    n = 3
+    rosters = [{0, 1, 2}, {0, 2}, {2}, set(), {0, 1, 2}, {1}]
+    for live in rosters:  # successive rounds of one churning fleet
+        for n_items in (0, 1, 4, 10):
+            ranges = assign_ranges(n_items, live, n)
+            assert len(ranges) == n
+            members = sorted(p for p in live if 0 <= p < n) or [0]
+            flat = []
+            for slot, (lo, hi) in enumerate(ranges):
+                if slot not in members:
+                    assert (lo, hi) == (0, 0)
+                flat.extend(range(lo, hi))
+            assert flat == list(range(n_items))
+
+
+def test_reassigned_range_resumes_from_checkpoint_bitwise(
+    tmp_path, monkeypatch
+):
+    """The mid-request reassignment resume, single-process: a 'worker'
+    dies mid-range AFTER its slice checkpoint persisted; the
+    'survivor' reruns the same range against the shared checkpoint
+    directory and (a) provably does NOT re-execute completed slices (a
+    fatal rule armed on the completed slice stays silent), (b) returns
+    a partial bitwise-equal to the unfailed range, so (c) the root's
+    in-order sum equals the unfailed 2-member oracle bitwise."""
+    import numpy as np
+
+    from tnc_tpu.builders.random_circuit import brickwork_circuit
+    from tnc_tpu.resilience.faultinject import InjectedFatal, faults
+    from tnc_tpu.serve import PlanCache, assign_ranges, bind_circuit
+
+    import pytest
+
+    monkeypatch.setenv("TNC_TPU_CKPT_EVERY", "1")  # per-slice cadence
+    bound = bind_circuit(
+        brickwork_circuit(8, 6, np.random.default_rng(9)),
+        plan_cache=PlanCache(str(tmp_path / "plans")),
+        target_size=64,
+    )
+    num = bound.sliced.slicing.num_slices
+    assert num == 4
+    # ONE request for the armed-fatal leg: serving dispatches sliced
+    # structures as one slice-loop execution PER request (stacked_rows),
+    # each with its own checkpoint — a second request would rightly run
+    # fresh on resume and trip the rule armed on the completed slice
+    bits = ["00000011"]
+    det = [bound.template.request_bits(b) for b in bits]
+    ranges = assign_ranges(num, {0, 1}, 2)
+    assert ranges == [(0, 2), (2, 4)]
+    # the unfailed oracle: fresh per-range partials, summed in order
+    parts = [
+        np.asarray(bound.amplitudes_det(det, slice_range=r))
+        for r in ranges
+    ]
+    oracle = parts[0] + parts[1]
+
+    ckpt = str(tmp_path / "ckpt")
+    # the doomed worker: dies at slice 3, AFTER slice 2's checkpoint
+    # (cursor 3 + partial accumulator) persisted to the shared dir
+    with faults("sliced.slice(s=3)=fatal*1"):
+        with pytest.raises(InjectedFatal):
+            bound.amplitudes_det(det, slice_range=(2, 4), ckpt=ckpt)
+    # the survivor resumes the lost range: a fatal rule on the ALREADY
+    # COMPLETED slice must never fire — resume skips it via the cursor
+    with faults("sliced.slice(s=2)=fatal*1"):
+        resumed = np.asarray(
+            bound.amplitudes_det(det, slice_range=(2, 4), ckpt=ckpt)
+        )
+    assert np.array_equal(resumed, parts[1]), (
+        "checkpoint-resumed range partial is not bit-identical"
+    )
+    assert np.array_equal(parts[0] + resumed, oracle)
+
+    # multi-request leg: the doomed worker dies inside request 0's
+    # slice loop, so request 1 never checkpointed — the resume mixes a
+    # checkpoint-resumed execution with a fresh one and must still be
+    # bitwise equal to the unfailed oracle batch
+    bits2 = ["00000011", "01001101"]
+    det2 = [bound.template.request_bits(b) for b in bits2]
+    oracle2 = np.asarray(bound.amplitudes_det(det2, slice_range=(2, 4)))
+    ckpt2 = str(tmp_path / "ckpt2")
+    with faults("sliced.slice(s=3)=fatal*1"):
+        with pytest.raises(InjectedFatal):
+            bound.amplitudes_det(det2, slice_range=(2, 4), ckpt=ckpt2)
+    resumed2 = np.asarray(
+        bound.amplitudes_det(det2, slice_range=(2, 4), ckpt=ckpt2)
+    )
+    assert np.array_equal(resumed2, oracle2), (
+        "mixed resumed+fresh batch is not bit-identical to the oracle"
+    )
+
+
+class _LocalBound:
+    """Minimal dispatcher target for single-process drain tests."""
+
+    sliced = None
+
+    def amplitudes_det(self, bits, backend=None, **kw):
+        import numpy as np
+
+        return np.zeros(len(bits), dtype=complex)
+
+
+def test_dispatcher_stop_drains_inflight_round():
+    """stop() must serialize behind an in-flight collective round: a
+    plain stop waits for the round to finish; a bounded drain that
+    expires poisons the dispatcher (TimeoutError) instead of
+    broadcasting into an unknown collective state. Either way, later
+    calls fail with DispatcherStoppedError — never a hang."""
+    import pytest
+
+    from tnc_tpu.resilience.faultinject import faults
+    from tnc_tpu.serve import ClusterDispatcher, DispatcherStoppedError
+
+    bound = _LocalBound()
+
+    # -- bounded drain expires: poison, TimeoutError -------------------
+    d = ClusterDispatcher()
+    with faults("cluster.broadcast(side=root)=slow:0.6*1"):
+        t = threading.Thread(target=lambda: d(bound, ["00"]))
+        t.start()
+        time.sleep(0.15)  # the round holds the dispatch lock, sleeping
+        with pytest.raises(TimeoutError):
+            d.stop(drain_timeout_s=0.05)
+        t.join()
+    with pytest.raises(DispatcherStoppedError):
+        d(bound, ["00"])
+    d.stop()  # idempotent on a poisoned dispatcher
+
+    # -- plain stop drains cleanly -------------------------------------
+    d2 = ClusterDispatcher()
+    results = []
+    with faults("cluster.broadcast(side=root)=slow:0.4*1"):
+        t = threading.Thread(
+            target=lambda: results.append(d2(bound, ["00", "11"]))
+        )
+        t.start()
+        time.sleep(0.15)
+        d2.stop()  # blocks behind the round, then stops
+        t.join()
+    assert len(results) == 1 and results[0].shape == (2,), (
+        "the in-flight round must complete, not be dropped by stop()"
+    )
+    with pytest.raises(DispatcherStoppedError):
+        d2(bound, ["00"])

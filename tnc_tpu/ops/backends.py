@@ -37,6 +37,11 @@ logger = logging.getLogger(__name__)
 
 class Backend:
     name: str = "base"
+    # True when execute_sliced accepts ckpt= / on_slice= (slice-boundary
+    # checkpointing + cooperative preemption); callers (the elastic
+    # serving layer) only pass those kwargs when the flag is set, so a
+    # backend without them keeps serving whole runs unchanged
+    supports_slice_hooks: bool = False
 
     def execute(self, program: ContractionProgram, arrays: Sequence[Any]) -> np.ndarray:
         raise NotImplementedError
@@ -591,6 +596,8 @@ class NumpyBackend(Backend):
             list(arrays), batched, b, program.result_shape,
         )
 
+    supports_slice_hooks = True
+
     def execute_sliced(
         self,
         sp,
@@ -599,6 +606,8 @@ class NumpyBackend(Backend):
         host: bool = True,
         hoist: bool | None = None,
         slice_range: tuple[int, int] | None = None,
+        ckpt: str | None = None,
+        on_slice=None,
     ) -> np.ndarray:
         """``host=False`` mirrors the device backends' contract as far
         as it applies here (data is already host-resident): the result
@@ -606,12 +615,16 @@ class NumpyBackend(Backend):
         ``result_shape``. ``hoist`` defaults to off — the naive loop
         is the oracle the hoisted executors are tested against.
         ``slice_range=(lo, hi)`` sums only that contiguous slice shard
-        (the multi-host serving partial)."""
+        (the multi-host serving partial). ``ckpt`` / ``on_slice``
+        (``supports_slice_hooks``): slice-boundary checkpointing and
+        cooperative preemption — see
+        :func:`~tnc_tpu.ops.sliced.execute_sliced_numpy`."""
         from tnc_tpu.ops.sliced import execute_sliced_numpy
 
         out = execute_sliced_numpy(
             sp, arrays, dtype=self.dtype, max_slices=max_slices,
             hoist=bool(hoist), slice_range=slice_range,
+            ckpt=ckpt, on_slice=on_slice,
         )
         if not host:
             return out.reshape(sp.program.stored_result_shape)

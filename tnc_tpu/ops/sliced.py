@@ -63,6 +63,20 @@ class SlicedProgram:
         return stable_digest(self.signature())
 
 
+class SliceYield(Exception):
+    """A sliced execution yielded voluntarily at a checkpoint boundary
+    (``on_slice`` returned True): the partial accumulator is persisted
+    (when a checkpoint is armed) and ``cursor`` names the next slice to
+    run. Re-invoking the same call resumes bit-identically from the
+    checkpoint — the mechanism behind priority preemption in
+    :mod:`tnc_tpu.serve.elastic`. Not an error: the caller chose to be
+    interrupted."""
+
+    def __init__(self, cursor: int):
+        super().__init__(f"sliced execution yielded at slice {cursor}")
+        self.cursor = int(cursor)
+
+
 def build_sliced_program(
     tn: CompositeTensor, contract_path: ContractionPath, slicing: Slicing
 ) -> SlicedProgram:
@@ -171,6 +185,7 @@ def execute_sliced_numpy(
     ckpt: str | None = None,
     step_spans: bool | None = None,
     slice_range: tuple[int, int] | None = None,
+    on_slice=None,
 ) -> np.ndarray:
     """CPU oracle: python loop over slices, sum of program results.
 
@@ -194,8 +209,17 @@ def execute_sliced_numpy(
     ``slice_range=(lo, hi)``: partial sum over slice ids ``[lo, hi)``
     only — the multi-host serving shard shape (each host covers a
     contiguous range; the root sums the range partials in range order).
-    Mutually exclusive with ``max_slices`` and checkpointing (a range
-    partial is already someone else's resume unit).
+    Mutually exclusive with ``max_slices``. ``ckpt`` composes with a
+    range since the elastic fleet (:mod:`tnc_tpu.serve.elastic`): the
+    range partial checkpoints its own cursor + accumulator (signature
+    includes the range), so a range shard lost to a dead worker resumes
+    bit-identically on a survivor.
+
+    ``on_slice``: optional ``cb(next_cursor) -> bool`` invoked after
+    every completed slice. Returning True forces a checkpoint save (when
+    armed) and raises :class:`SliceYield` — cooperative preemption at a
+    slice boundary; the same call re-invoked resumes from the
+    checkpoint.
     """
     from tnc_tpu.resilience import checkpoint as _ckpt
     from tnc_tpu.resilience import faultinject as _faults
@@ -222,21 +246,48 @@ def execute_sliced_numpy(
     if max_slices is not None:
         num = min(num, max_slices)
     if slice_range is not None:
-        if max_slices is not None or ckpt is not None:
+        if max_slices is not None:
             raise ValueError(
-                "slice_range is mutually exclusive with max_slices/ckpt"
+                "slice_range is mutually exclusive with max_slices"
             )
         lo, hi = slice_range
         lo = max(0, int(lo))
         hi = min(int(hi), sp.slicing.num_slices)
+        ckpt_path = _ckpt.resolve_ckpt(ckpt)
+        mgr = None
+        start = lo
+        if ckpt_path is not None:
+            # the range rides the signature: a (lo, hi) shard's
+            # accumulator must never resume a different shard of the
+            # same program (and arrays_digest keeps different leaf data
+            # — different bitstrings — apart, as in the full-run path)
+            sig = _ckpt.signature_hash(
+                "numpy-range-v1", sp.signature(), str(np.dtype(dtype)),
+                lo, hi, hoist, _ckpt.arrays_digest(arrays),
+            )
+            mgr = _ckpt.SliceCheckpoint(ckpt_path, sig)
+            loaded = mgr.load()
+            if loaded is not None:
+                start, (saved,) = loaded
+                start = max(lo, min(int(start), hi))
+                acc = np.asarray(saved, dtype=dtype)
         with obs.span("sliced.range", lo=lo, hi=hi):
-            for s in range(lo, hi):
+            for s in range(start, hi):
+                _faults.fault_point("sliced.slice", s=s)
                 indices = _slice_indices(sp.slicing, s)
                 buffers = [
                     index_buffer(np, arr, info, indices)
                     for arr, info in zip(full, sp.slot_slices)
                 ]
                 acc = acc + _run_steps(np, sp.program, buffers)
+                if mgr is not None:
+                    mgr.maybe_save(s + 1, lambda _a=acc: [_a])
+                if on_slice is not None and s + 1 < hi and on_slice(s + 1):
+                    if mgr is not None:
+                        mgr.save(s + 1, [acc])
+                    raise SliceYield(s + 1)
+        if mgr is not None:
+            mgr.finalize()
         return acc.reshape(sp.program.result_shape)
     ckpt_path = _ckpt.resolve_ckpt(ckpt)
     mgr = None
@@ -276,6 +327,10 @@ def execute_sliced_numpy(
             acc = acc + contrib
             if mgr is not None:
                 mgr.maybe_save(s + 1, lambda _a=acc: [_a])
+            if on_slice is not None and s + 1 < num and on_slice(s + 1):
+                if mgr is not None:
+                    mgr.save(s + 1, [acc])
+                raise SliceYield(s + 1)
         if obs.enabled():
             osp.add(
                 slices=num - start,

@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import time
 from dataclasses import dataclass
 from typing import Any, Sequence
 
@@ -1402,7 +1403,12 @@ def _coordination_client():
         return None
 
 
-def broadcast_object(obj, root: int = 0, wait_forever: bool = False):
+def broadcast_object(
+    obj,
+    root: int = 0,
+    wait_forever: bool = False,
+    timeout_s: float | None = None,
+):
     """Broadcast any picklable object from host process ``root`` to all
     processes — the generic transport under :func:`broadcast_path` and
     the cross-process fan-in (the reference's serialized MPI broadcast,
@@ -1417,6 +1423,14 @@ def broadcast_object(obj, root: int = 0, wait_forever: bool = False):
     blocks on the *next* command through arbitrarily long idle periods.
     The per-call sequence key is armed exactly once, so retried waits
     stay in lockstep with the sender.
+
+    ``timeout_s``: bound EVERY wait in this call (the payload get and
+    the cleanup barrier) instead of the 120 s transport default. An
+    expired wait raises :class:`TimeoutError` — which
+    :func:`~tnc_tpu.resilience.retry.classify_exception` maps to
+    TRANSIENT — so an elastic fleet's command round degrades to a
+    retry/reassign decision instead of hanging on a dead peer. Ignored
+    by ``wait_forever`` (which re-arms by design).
 
     Transport: the distributed **coordination-service KV store** (root
     ``key_value_set``s the pickled payload under a per-call sequence
@@ -1444,6 +1458,10 @@ def broadcast_object(obj, root: int = 0, wait_forever: bool = False):
     if client is not None:
         import base64
 
+        timeout_ms = (
+            max(int(float(timeout_s) * 1000.0), 1)
+            if timeout_s is not None else _KV_BCAST_TIMEOUT_MS
+        )
         seq = _KV_BCAST_SEQ
         _KV_BCAST_SEQ += 1
         key = f"tnc_tpu/bcast/{root}/{seq}"
@@ -1453,21 +1471,27 @@ def broadcast_object(obj, root: int = 0, wait_forever: bool = False):
             )
         while True:
             try:
-                blob = client.blocking_key_value_get(key, _KV_BCAST_TIMEOUT_MS)
+                blob = client.blocking_key_value_get(key, timeout_ms)
                 break
             except Exception as exc:  # noqa: BLE001 — deadline probe
-                if wait_forever and "deadline" in str(exc).lower():
-                    continue  # same key: the sender hasn't spoken yet
+                if "deadline" in str(exc).lower():
+                    if wait_forever:
+                        continue  # same key: the sender hasn't spoken yet
+                    raise TimeoutError(
+                        f"broadcast wait for {key} expired after "
+                        f"{timeout_ms} ms (sender dead or stalled)"
+                    ) from exc
                 raise
         out = pickle.loads(base64.b64decode(blob))
         # reclaim the key: a barrier proves every process has read it,
         # then the root deletes — without this, a long-running job's
         # pickled payloads accumulate in the coordination service
         # forever. Best-effort: on any barrier/delete hiccup the key
-        # simply stays resident (leak-not-break).
+        # simply stays resident (leak-not-break) — and a dead peer
+        # stalls the live fleet here only for timeout_ms, never forever.
         try:
             client.wait_at_barrier(
-                f"tnc_tpu/bcast_done/{root}/{seq}", _KV_BCAST_TIMEOUT_MS
+                f"tnc_tpu/bcast_done/{root}/{seq}", timeout_ms
             )
             if is_root:
                 client.key_value_delete(key)
@@ -1500,7 +1524,25 @@ def broadcast_object(obj, root: int = 0, wait_forever: bool = False):
         ) from exc
 
 
-def gather_objects(obj, root: int = 0) -> list | None:
+class GatherLost:
+    """Root-side placeholder for a gather slot whose sender never
+    delivered within the timeout (dead or stalled process). Carries the
+    source process index; only ever appears in :func:`gather_objects`
+    output when ``missing_ok=True``."""
+
+    def __init__(self, process: int):
+        self.process = int(process)
+
+    def __repr__(self) -> str:
+        return f"GatherLost(process={self.process})"
+
+
+def gather_objects(
+    obj,
+    root: int = 0,
+    timeout_s: float | None = None,
+    missing_ok: bool = False,
+) -> list | None:
     """Gather one picklable object per process at ``root``: returns the
     per-process list (index = process) on the root, ``None`` elsewhere.
     The collective inverse of :func:`broadcast_object` — and unlike a
@@ -1510,6 +1552,15 @@ def gather_objects(obj, root: int = 0) -> list | None:
     per call). Every process must call this in the same collective
     order; the serving fleet's batch gather rides it
     (:mod:`tnc_tpu.serve.multihost`).
+
+    ``timeout_s`` bounds the root's whole collection (a shared deadline
+    across slots, floor 1 s per remaining slot) and the cleanup barrier
+    on every process. An expired slot raises :class:`TimeoutError`
+    (TRANSIENT under :func:`~tnc_tpu.resilience.retry.
+    classify_exception`) — or, with ``missing_ok=True``, lands a
+    :class:`GatherLost` marker in that slot so the caller can reassign
+    the lost work instead of failing the round (the elastic fleet's
+    worker-loss path, :mod:`tnc_tpu.serve.elastic`).
 
     Identity when running single-process (returns ``[obj]``). Falls
     back to n-1 :func:`broadcast_object` rounds when the coordination
@@ -1536,6 +1587,10 @@ def gather_objects(obj, root: int = 0) -> list | None:
 
     import base64
 
+    timeout_ms = (
+        max(int(float(timeout_s) * 1000.0), 1)
+        if timeout_s is not None else _KV_BCAST_TIMEOUT_MS
+    )
     seq = _KV_BCAST_SEQ
     _KV_BCAST_SEQ += 1
     prefix = f"tnc_tpu/gather/{root}/{seq}"
@@ -1548,18 +1603,34 @@ def gather_objects(obj, root: int = 0) -> list | None:
     if me == root:
         parts = [None] * n
         parts[root] = obj
+        deadline = time.monotonic() + timeout_ms / 1000.0
         for src in range(n):
             if src == root:
                 continue
-            blob = client.blocking_key_value_get(
-                f"{prefix}/{src}", _KV_BCAST_TIMEOUT_MS
+            remaining_ms = max(
+                int((deadline - time.monotonic()) * 1000.0), 1000
             )
+            try:
+                blob = client.blocking_key_value_get(
+                    f"{prefix}/{src}", remaining_ms
+                )
+            except Exception as exc:  # noqa: BLE001 — deadline probe
+                if "deadline" not in str(exc).lower():
+                    raise
+                if not missing_ok:
+                    raise TimeoutError(
+                        f"gather wait for process {src} expired after "
+                        f"{remaining_ms} ms (process dead or stalled)"
+                    ) from exc
+                parts[src] = GatherLost(src)
+                continue
             parts[src] = pickle.loads(base64.b64decode(blob))
     # reclaim: the barrier proves the root has read every slot, then
-    # each sender deletes its own key (best-effort, leak-not-break)
+    # each sender deletes its own key (best-effort, leak-not-break;
+    # a dead peer stalls everyone here only for timeout_ms)
     try:
         client.wait_at_barrier(
-            f"tnc_tpu/gather_done/{root}/{seq}", _KV_BCAST_TIMEOUT_MS
+            f"tnc_tpu/gather_done/{root}/{seq}", timeout_ms
         )
         if me != root:
             client.key_value_delete(f"{prefix}/{me}")

@@ -19,7 +19,13 @@ separated by ``;``, each
 
 - ``site`` — the fault-point name (``chunked.batch``, ``chunked.plan``,
   ``backend.dispatch``, ``spmd.dispatch``, ``partition.local``,
-  ``sliced.slice``).
+  ``sliced.slice``, and the cluster-serving boundaries:
+  ``cluster.worker`` — per-round (``phase=round, process=``) and
+  per-slice (``phase=slice, s=, process=``) on the worker loop, the
+  elastic kill-pin's SIGKILL site — and ``cluster.broadcast``
+  (``side=root, seq=`` on the dispatcher, ``side=worker, process=`` on
+  the parked loop), where a ``slow`` rule holds a collective round
+  open against ``stop()``'s drain).
 - ``(key=value, ...)`` — optional match on the call-site context
   (compared as strings): ``chunked.batch(start=8)`` fires only for the
   batch starting at slice 8; ``partition.local(partition=1)`` kills

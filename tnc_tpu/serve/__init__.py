@@ -36,6 +36,14 @@ The serving pipeline, front to back:
   process of a ``jax.distributed`` fleet via
   :class:`ClusterDispatcher` / :func:`serve_cluster`, results
   gathering at the root over the coordination-KV transport.
+- elastic fleet (``elastic.py``) — live membership (per-round
+  roster-aware slice-range assignment), mid-request reassignment
+  (a dead worker's range resumes from its checkpoint at the root,
+  bit-identically), priority preemption (``submit(tenant=,
+  priority=)`` + weighted-fair scheduling, long sliced contractions
+  yield at checkpoint boundaries), and load-aware scaling
+  (:class:`ElasticController` advisory decisions +
+  :class:`LocalAutoscaler` subprocess actuation).
 
 See ``docs/serving.md`` and ``docs/planning.md``.
 """
@@ -58,8 +66,17 @@ from tnc_tpu.serve.reuse import (  # noqa: F401
     ReuseBinding,
     compute_split,
 )
+from tnc_tpu.serve.elastic import (  # noqa: F401
+    ElasticConfig,
+    ElasticController,
+    LocalAutoscaler,
+    assign_ranges,
+    live_processes,
+    weighted_fair_order,
+)
 from tnc_tpu.serve.multihost import (  # noqa: F401
     ClusterDispatcher,
+    DispatcherStoppedError,
     cluster_amplitudes,
     cluster_amplitudes_sliced,
     serve_cluster,
@@ -77,4 +94,5 @@ from tnc_tpu.serve.service import (  # noqa: F401
     QueueFullError,
     ServeError,
     ServiceClosedError,
+    TenantQuotaError,
 )

@@ -55,10 +55,22 @@ import numpy as np
 
 from tnc_tpu import obs
 from tnc_tpu.obs import fleet as _fleet
-from tnc_tpu.parallel.partitioned import broadcast_object, gather_objects
+from tnc_tpu.parallel.partitioned import (
+    GatherLost,
+    broadcast_object,
+    gather_objects,
+)
+from tnc_tpu.resilience.faultinject import fault_point
 from tnc_tpu.serve.rebind import BoundProgram, bind_template
 
 logger = logging.getLogger(__name__)
+
+
+class DispatcherStoppedError(RuntimeError):
+    """The ClusterDispatcher was stopped; the call never entered the
+    fleet's collective sequence. A clean shutdown signal (the service's
+    degrade path fails only the in-flight requests), never a sign of
+    fleet desync."""
 
 
 class _ShardFailure:
@@ -131,14 +143,24 @@ def _concat_rows(parts: Sequence) -> np.ndarray:
     return np.concatenate(filled, axis=0)
 
 
-def _gather_rows(mine, me: int, n: int, root: int) -> list | None:
+def _gather_rows(
+    mine, me: int, n: int, root: int, timeout_s: float | None = None
+) -> list | None:
     """Collective gather of per-process payloads at the root (one
     root-only-read KV round, O(n · payload) — not n broadcasts); every
     process participates, non-root processes get ``None``. ``mine`` is
     this process's payload — possibly a :class:`_ShardFailure`, which
     the root raises only after the gather completed, keeping the
-    fleet's collective sequence in lockstep through shard errors."""
-    parts = gather_objects(mine, root=root)
+    fleet's collective sequence in lockstep through shard errors.
+
+    ``timeout_s`` bounds every wait (elastic fleets): a slot whose
+    process died mid-round comes back as a
+    :class:`~tnc_tpu.parallel.partitioned.GatherLost` marker instead of
+    hanging the root — the caller reassigns that shard to a survivor."""
+    parts = gather_objects(
+        mine, root=root, timeout_s=timeout_s,
+        missing_ok=timeout_s is not None,
+    )
     if me == root:
         _raise_shard_failures(parts)
     return parts
@@ -149,6 +171,8 @@ def cluster_amplitudes(
     batch_bits: Sequence[str],
     backend=None,
     root: int = 0,
+    ranges: Sequence[tuple[int, int]] | None = None,
+    timeout_s: float | None = None,
 ) -> np.ndarray | None:
     """One collective bra-sharded batch: every process of the fleet
     computes a contiguous shard of ``batch_bits`` with its local
@@ -160,12 +184,20 @@ def cluster_amplitudes(
     Bit-identical to a single-host ``bound.amplitudes_det``: each row
     is produced by the same program, backend, and arithmetic — sharding
     only changes *where*, never *how*.
+
+    ``ranges`` overrides the default even split with an explicit
+    per-process row assignment (the elastic dispatcher's roster-aware
+    placement: stale members get empty ranges). ``timeout_s`` bounds
+    the gather; a shard lost to a dead process is recomputed at the
+    root (bit-identical — same program, same rows) and counted as
+    ``serve.elastic.reassigned``.
     """
     n, me = _procs()
     if n == 1:
         return bound.amplitudes_det(list(batch_bits), backend)
-    ranges = shard_ranges(len(batch_bits), n)
-    lo, hi = ranges[me]
+    if ranges is None:
+        ranges = shard_ranges(len(batch_bits), n)
+    lo, hi = ranges[me] if me < len(ranges) else (0, 0)
     try:
         with obs.span(
             "serve.cluster_shard", mode="bras", rows=hi - lo, process=me
@@ -173,10 +205,34 @@ def cluster_amplitudes(
             mine = bound.amplitudes_det(list(batch_bits[lo:hi]), backend)
     except Exception as exc:  # noqa: BLE001 — stay in collective lockstep
         mine = _ShardFailure(me, exc)
-    parts = _gather_rows(mine, me, n, root)
+    parts = _gather_rows(mine, me, n, root, timeout_s=timeout_s)
     if me != root:
         return None
+    for src, part in enumerate(parts):
+        if not isinstance(part, GatherLost):
+            continue
+        # the process died mid-round: its rows rerun HERE, under the
+        # same program and backend, so the batch stays bit-identical
+        slo, shi = ranges[src] if src < len(ranges) else (0, 0)
+        logger.warning(
+            "cluster_amplitudes: process %d lost mid-round; recomputing "
+            "rows [%d, %d) at the root", src, slo, shi,
+        )
+        _note_reassigned(mode="bras")
+        parts[src] = bound.amplitudes_det(
+            list(batch_bits[slo:shi]), backend
+        )
     return _concat_rows(parts)
+
+
+def _note_reassigned(mode: str) -> None:
+    """Count a lost-shard reassignment on both surfaces: the obs
+    registry (``serve.elastic.reassigned`` — scraped via /metrics) and
+    the elastic module's cumulative tally (``stats()["elastic"]``)."""
+    obs.counter_add("serve.elastic.reassigned", mode=mode)
+    from tnc_tpu.serve import elastic as _elastic
+
+    _elastic.count_event("reassigned")
 
 
 def cluster_amplitudes_sliced(
@@ -184,6 +240,9 @@ def cluster_amplitudes_sliced(
     batch_bits: Sequence[str],
     backend=None,
     root: int = 0,
+    ranges: Sequence[tuple[int, int]] | None = None,
+    timeout_s: float | None = None,
+    ckpt_dir: str | None = None,
 ) -> np.ndarray | None:
     """One collective slice-range-sharded batch for an HBM-sliced
     structure: every process runs the WHOLE batch over its contiguous
@@ -192,6 +251,25 @@ def cluster_amplitudes_sliced(
     accumulation association (the single-host loop adds slice-by-slice,
     the fleet adds range partials) — use :func:`cluster_amplitudes`
     when bitwise reproducibility beats slice-loop wall-clock.
+
+    The elastic knobs (all optional, default = frozen fleet):
+
+    - ``ranges``: explicit per-process slice-range assignment (the
+      roster-aware placement — stale members get ``(0, 0)``);
+    - ``timeout_s``: bounds the gather. A range lost to a dead process
+      is *reassigned* to the root, which — with ``ckpt_dir`` — resumes
+      from the dead worker's last slice-boundary checkpoint on the
+      shared directory. The resumed partial accumulates the remaining
+      slices in the same order with the same kernels, so the recovered
+      batch is **bit-identical** to the unfailed run (the PR 3
+      guarantee, now load-bearing for host loss);
+    - ``ckpt_dir``: shared checkpoint directory; every range shard
+      persists its cursor + accumulator there at the configured cadence
+      (``TNC_TPU_CKPT_EVERY`` / ``TNC_TPU_CKPT_SECS``).
+
+    Workers expose the ``cluster.worker`` fault-injection site once per
+    completed slice (``phase="slice"``), so a deterministic mid-request
+    worker kill is one ``TNC_TPU_FAULTS`` rule away.
     """
     n, me = _procs()
     if n == 1:
@@ -201,20 +279,48 @@ def cluster_amplitudes_sliced(
             "cluster_amplitudes_sliced needs a sliced bound program"
         )
     num = bound.sliced.slicing.num_slices
-    ranges = shard_ranges(num, n)
-    lo, hi = ranges[me]
+    if ranges is None:
+        ranges = shard_ranges(num, n)
+    lo, hi = ranges[me] if me < len(ranges) else (0, 0)
+
+    def _on_slice(cursor: int, _me=me) -> bool:
+        # deterministic worker-loss injection: a `kill` rule here
+        # SIGKILLs this process mid-range, exactly at the configured
+        # slice — the scenario the reassignment path recovers from
+        fault_point("cluster.worker", phase="slice", s=cursor, process=_me)
+        return False
+
     try:
         with obs.span(
             "serve.cluster_shard", mode="slices", slices=hi - lo, process=me
         ):
             mine = bound.amplitudes_det(
-                list(batch_bits), backend, slice_range=(lo, hi)
+                list(batch_bits), backend, slice_range=(lo, hi),
+                ckpt=ckpt_dir, on_slice=_on_slice if ckpt_dir else None,
             )
     except Exception as exc:  # noqa: BLE001 — stay in collective lockstep
         mine = _ShardFailure(me, exc)
-    parts = _gather_rows(mine, me, n, root)
+    parts = _gather_rows(mine, me, n, root, timeout_s=timeout_s)
     if me != root:
         return None
+    for src, part in enumerate(parts):
+        if not isinstance(part, GatherLost):
+            continue
+        slo, shi = ranges[src] if src < len(ranges) else (0, 0)
+        logger.warning(
+            "cluster_amplitudes_sliced: process %d lost mid-round; "
+            "resuming its range [%d, %d) at the root%s", src, slo, shi,
+            " from checkpoint" if ckpt_dir else "",
+        )
+        _note_reassigned(mode="slices")
+        # resume, not restart: the dead worker's checkpoint (shared
+        # ckpt_dir, signature includes the range) carries its partial
+        # accumulator and cursor — the surviving recompute finishes the
+        # same accumulation sequence, bit-identical to the unfailed run
+        parts[src] = bound.amplitudes_det(
+            list(batch_bits), backend, slice_range=(slo, shi),
+            ckpt=ckpt_dir,
+        )
     acc = np.asarray(parts[0])
     for p in parts[1:]:
         acc = acc + np.asarray(p)
@@ -233,18 +339,52 @@ class ClusterDispatcher:
     an internal lock — the fleet's collective sequence must never
     interleave two batches (or a batch with :meth:`stop`).
 
-    ``stop()`` broadcasts the shutdown command and releases the
-    workers; call it after stopping the service.
+    ``stop()`` drains the in-flight collective round (the internal lock
+    serializes it behind the round), then broadcasts the shutdown
+    command and releases the workers; call it after stopping the
+    service. A stopped dispatcher raises
+    :class:`DispatcherStoppedError` — requests racing the shutdown fail
+    cleanly instead of desynchronizing the fleet.
+
+    Elastic operation (all optional):
+
+    - ``registry`` (a :class:`~tnc_tpu.obs.fleet.FleetRegistry` on the
+      fleet's shared directory): the dispatcher consults the live
+      roster **per collective round** instead of the frozen process
+      list — a worker whose heartbeat went stale gets an empty
+      assignment (and its lost in-flight range is resumed at the root),
+      a worker that recovers is assigned work again next round;
+    - ``timeout_s``: bounds every broadcast/gather wait of a round
+      (timeouts classify TRANSIENT through
+      :func:`~tnc_tpu.resilience.retry.classify_exception`);
+    - ``ckpt_dir``: shared slice-range checkpoint directory — the
+      mid-request reassignment resume substrate
+      (:func:`cluster_amplitudes_sliced`).
     """
 
-    def __init__(self, mode: str = "auto", root: int = 0):
+    def __init__(
+        self,
+        mode: str = "auto",
+        root: int = 0,
+        registry=None,
+        stale_after_s: float | None = None,
+        timeout_s: float | None = None,
+        ckpt_dir: str | None = None,
+    ):
         if mode not in ("auto", "bras", "slices"):
             raise ValueError(f"unknown dispatch mode {mode!r}")
         self.mode = mode
         self.root = int(root)
+        self.registry = registry
+        self.stale_after_s = stale_after_s
+        self.timeout_s = timeout_s
+        self.ckpt_dir = ckpt_dir
         self._lock = threading.Lock()
         self._stopped = False
         self._seq = 0  # dispatch sequence, rides the TraceContext
+        # the most recent round's per-process assignment (observability:
+        # the service heartbeat ships it to serve_top --fleet)
+        self.last_ranges: list | None = None
         # (weakref to bound, sig): an `is` check on the live object —
         # never id(), which CPython recycles across swap generations
         self._sig_cache: tuple | None = None
@@ -253,6 +393,26 @@ class ClusterDispatcher:
         if self.mode != "auto":
             return self.mode
         return "slices" if bound.sliced is not None else "bras"
+
+    def _round_ranges(
+        self, mode: str, bound: BoundProgram, bits: list, n: int
+    ) -> list | None:
+        """Per-round roster-aware assignment: contiguous ranges over the
+        LIVE members only (stale/dead processes get ``(0, 0)``), or
+        ``None`` (= even split over all n) without a registry."""
+        if self.registry is None or n <= 1:
+            return None
+        from tnc_tpu.serve import elastic as _elastic
+
+        live = _elastic.live_processes(
+            self.registry, n, root=self.root,
+            stale_after_s=self.stale_after_s,
+        )
+        n_items = (
+            bound.sliced.slicing.num_slices
+            if mode == "slices" else len(bits)
+        )
+        return _elastic.assign_ranges(n_items, live, n)
 
     def _plan_sig(self, bound: BoundProgram) -> str:
         """The bound's program signature, memoized per bound object —
@@ -275,8 +435,12 @@ class ClusterDispatcher:
         mode = self._resolve(bound)
         with self._lock:
             if self._stopped:
-                raise RuntimeError("ClusterDispatcher is stopped")
+                raise DispatcherStoppedError("ClusterDispatcher is stopped")
             self._seq += 1
+            # injectable collective boundary: a `slow` rule here holds
+            # the round open (the stop()-drain regression), a raising
+            # kind exercises the poison path deterministically
+            fault_point("cluster.broadcast", side="root", seq=self._seq)
             # cross-host trace propagation: the service set this batch's
             # identity (request ids, kind, plan generation) in a
             # thread-local around the dispatcher call; ship it with the
@@ -290,11 +454,29 @@ class ClusterDispatcher:
                 root_process=me,
                 root_pid=os.getpid(),
             ).to_obj()
+            ranges = self._round_ranges(mode, bound, bits, n)
+            self.last_ranges = ranges
             if n > 1:
+                # the per-round elastic envelope rides the command as a
+                # 5th element; older workers reading 4-tuples keep
+                # working when it is absent (frozen-fleet deployments)
+                extra = None
+                if (
+                    ranges is not None
+                    or self.timeout_s is not None
+                    or self.ckpt_dir is not None
+                ):
+                    extra = {
+                        "ranges": ranges,
+                        "timeout_s": self.timeout_s,
+                        "ckpt_dir": self.ckpt_dir,
+                    }
+                cmd = (mode, list(bits), self._plan_sig(bound), trace)
+                if extra is not None:
+                    cmd = cmd + (extra,)
                 try:
                     broadcast_object(
-                        (mode, list(bits), self._plan_sig(bound), trace),
-                        root=self.root,
+                        cmd, root=self.root, timeout_s=self.timeout_s
                     )
                 except Exception as exc:
                     # a failed COMMAND broadcast leaves the fleet's
@@ -310,19 +492,51 @@ class ClusterDispatcher:
             obs.counter_add("serve.cluster.batches", mode=mode)
             if mode == "slices":
                 return cluster_amplitudes_sliced(
-                    bound, bits, backend, root=self.root
+                    bound, bits, backend, root=self.root,
+                    ranges=ranges, timeout_s=self.timeout_s,
+                    ckpt_dir=self.ckpt_dir,
                 )
-            return cluster_amplitudes(bound, bits, backend, root=self.root)
+            return cluster_amplitudes(
+                bound, bits, backend, root=self.root,
+                ranges=ranges, timeout_s=self.timeout_s,
+            )
 
-    def stop(self) -> None:
-        """Release the worker processes (idempotent)."""
+    def stop(self, drain_timeout_s: float | None = None) -> None:
+        """Release the worker processes (idempotent), DRAINING first:
+        the lock serializes this call behind any in-flight collective
+        round, so the stop command can never interleave with (or
+        orphan) a round's broadcast/gather sequence — the shutdown race
+        a bare flag check used to leave open.
+
+        ``drain_timeout_s`` bounds the drain: when the in-flight round
+        is wedged past it, the dispatcher is poisoned (no stop command
+        can be safely broadcast into an unknown collective state) and
+        :class:`TimeoutError` is raised — classify and escalate, the
+        fleet needs a restart."""
         n, _me = _procs()
-        with self._lock:
+        if drain_timeout_s is None:
+            self._lock.acquire()
+        elif not self._lock.acquire(timeout=float(drain_timeout_s)):
+            # can't join the collective sequence safely: poison so no
+            # later call tries to; the flag write is atomic and the
+            # in-flight round's holder re-checks under the lock only on
+            # the NEXT round, which will now refuse cleanly
+            self._stopped = True
+            raise TimeoutError(
+                f"ClusterDispatcher.stop: in-flight round did not drain "
+                f"within {drain_timeout_s}s; dispatcher poisoned"
+            )
+        try:
             if self._stopped:
                 return
             self._stopped = True
             if n > 1:
-                broadcast_object(("stop", None, None, None), root=self.root)
+                broadcast_object(
+                    ("stop", None, None, None), root=self.root,
+                    timeout_s=self.timeout_s,
+                )
+        finally:
+            self._lock.release()
 
 
 def serve_cluster(
@@ -408,6 +622,11 @@ def serve_cluster(
             registry,
             provider=lambda: {
                 "role": "worker",
+                # the distributed process index: what the elastic
+                # dispatcher's roster-aware placement keys live
+                # membership on (obs/fleet knows replicas, the
+                # collective knows process slots — this joins them)
+                "process": me,
                 "queue_depth": 0,
                 "inflight": progress["inflight"],
                 "batches_served": progress["served"],
@@ -432,6 +651,11 @@ def _serve_cluster_loop(
     served = 0
     my_sig = bound.program.signature_digest()
     while True:
+        # injectable worker-loss boundary: `kill` drops this worker
+        # between rounds (a clean leave the roster notices), `slow`
+        # delays its next park — the hung-collective scenario the
+        # root's bounded gather must survive
+        fault_point("cluster.worker", phase="round", process=me)
         msg = broadcast_object(None, root=root, wait_forever=True)
         cmd, payload, want_sig = msg[0], msg[1], msg[2]
         # 4th element since the fleet plane: the root's TraceContext
@@ -439,6 +663,13 @@ def _serve_cluster_loop(
         trace = _fleet.TraceContext.from_obj(
             msg[3] if len(msg) > 3 else None
         )
+        # 5th element since the elastic fleet: the per-round envelope
+        # (roster-aware range assignment, wait bounds, shared ckpt dir)
+        extra = msg[4] if len(msg) > 4 and isinstance(msg[4], dict) else {}
+        ranges = extra.get("ranges")
+        timeout_s = extra.get("timeout_s")
+        ckpt_dir = extra.get("ckpt_dir")
+        fault_point("cluster.broadcast", side="worker", process=me)
         if cmd == "stop":
             logger.info("serve_cluster: stop after %d batches", served)
             return served
@@ -468,7 +699,9 @@ def _serve_cluster_loop(
                 # naming this process; a worker that raised here would
                 # instead hang the whole fleet's next collective
                 logger.exception("serve_cluster: plan-swap adoption failed")
-                _gather_rows(_ShardFailure(me, exc), me, n, root)
+                _gather_rows(
+                    _ShardFailure(me, exc), me, n, root, timeout_s=timeout_s
+                )
                 continue
             bound, my_sig = new_bound, new_sig
             obs.counter_add("serve.cluster.worker_rebinds")
@@ -493,9 +726,15 @@ def _serve_cluster_loop(
             process=me,
         ):
             if cmd == "slices":
-                cluster_amplitudes_sliced(bound, payload, backend, root=root)
+                cluster_amplitudes_sliced(
+                    bound, payload, backend, root=root,
+                    ranges=ranges, timeout_s=timeout_s, ckpt_dir=ckpt_dir,
+                )
             else:
-                cluster_amplitudes(bound, payload, backend, root=root)
+                cluster_amplitudes(
+                    bound, payload, backend, root=root,
+                    ranges=ranges, timeout_s=timeout_s,
+                )
         served += 1
         progress["served"] = served
         progress["inflight"] = 0

@@ -192,6 +192,8 @@ class BoundProgram:
         batch_bits: Sequence[str],
         backend: Backend | None = None,
         slice_range: tuple[int, int] | None = None,
+        ckpt: str | None = None,
+        on_slice=None,
     ) -> np.ndarray:
         """:meth:`amplitudes` over already-validated determined-position
         bit strings (``template.request_bits`` output) — the service
@@ -202,7 +204,15 @@ class BoundProgram:
         request's amplitude is the **partial sum** over that contiguous
         slice shard — the multi-host serving shape, where every host
         covers a range and the root adds the range partials in range
-        order (:mod:`tnc_tpu.serve.multihost`)."""
+        order (:mod:`tnc_tpu.serve.multihost`).
+
+        ``ckpt`` / ``on_slice`` (sliced structures, backends with
+        ``supports_slice_hooks``): slice-boundary checkpointing and
+        cooperative preemption for the elastic serving layer
+        (:mod:`tnc_tpu.serve.elastic`) — a killed or preempted slice
+        loop resumes bit-identically from its persisted cursor. Silently
+        dropped on backends without the hooks (the run is then simply
+        not resumable)."""
         if backend is None:
             backend = NumpyBackend()
         if slice_range is not None and self.sliced is None:
@@ -210,6 +220,9 @@ class BoundProgram:
                 "slice_range only applies to sliced structures "
                 "(this bound program has no slicing)"
             )
+        if not getattr(backend, "supports_slice_hooks", False):
+            ckpt = None
+            on_slice = None
         if not batch_bits:
             return np.zeros((0,) + self.result_shape, dtype=np.complex128)
         arrays = self._serving_arrays(backend)
@@ -221,6 +234,10 @@ class BoundProgram:
                 # must return the range PARTIAL, never the full sum
                 # (the root adds one partial per host)
                 kw = {} if slice_range is None else {"slice_range": slice_range}
+                if ckpt is not None:
+                    kw["ckpt"] = ckpt
+                if on_slice is not None:
+                    kw["on_slice"] = on_slice
                 out = np.asarray(
                     backend.execute_sliced(self.sliced, list(arrays), **kw)
                 )
@@ -240,6 +257,10 @@ class BoundProgram:
             # kwarg only when actually sharding: a backend subclass
             # predating slice_range keeps serving whole-range requests
             kw = {} if slice_range is None else {"slice_range": slice_range}
+            if ckpt is not None:
+                kw["ckpt"] = ckpt
+            if on_slice is not None:
+                kw["on_slice"] = on_slice
             return stacked_rows(
                 lambda per: backend.execute_sliced(self.sliced, per, **kw),
                 buffers, self.bra_slots, b, self.result_shape,

@@ -105,6 +105,12 @@ class QueueFullError(ServeError):
     """Admission control rejected the request (queue at ``max_queue``)."""
 
 
+class TenantQuotaError(QueueFullError):
+    """Admission control rejected the request: its tenant is at its
+    per-tenant queued-request quota (elastic scheduling); subclasses
+    :class:`QueueFullError` so existing backpressure handling applies."""
+
+
 class DeadlineExceededError(ServeError):
     """The request's deadline passed before it could be dispatched."""
 
@@ -129,6 +135,11 @@ class _Request:
     # request (scripts/trace_summarize.py --serve)
     rid: int = 0
     t_collect: float = 0.0  # when batch assembly pulled it off the queue
+    # elastic scheduling: weighted-fair tenant + priority class (higher
+    # wins; a strictly-higher priority may preempt a running sliced
+    # contraction at a checkpoint boundary)
+    tenant: str = "default"
+    priority: int = 0
 
 
 _STATS_CAP = 4096  # bounded in-memory samples for stats()/bench
@@ -247,6 +258,14 @@ class ContractionService:
         self._fleet_aggregator = None
         self._slo = None
         self._slo_last_check = 0.0
+        # elastic plane (enable_elastic): tenant/priority scheduling
+        # config, advisory scale controller, preemption state (the
+        # priority of the batch currently dispatching, and a recursion
+        # guard so interlude work is itself never preempted)
+        self._elastic = None
+        self._elastic_controller = None
+        self._active_priority = 0
+        self._in_interlude = False
         self.attach_slo(slo)
 
     @classmethod
@@ -470,6 +489,57 @@ class ContractionService:
         with self._cond:
             return len(self._queue)
 
+    # -- elastic scheduling (tenants / priority / scaling) -----------------
+
+    def enable_elastic(
+        self, config=None, controller=None
+    ) -> "ContractionService":
+        """Turn on elastic scheduling: ``submit(tenant=, priority=)``
+        gains weighted-fair window selection and per-tenant quotas
+        (``config``, an :class:`~tnc_tpu.serve.elastic.ElasticConfig`;
+        default config = fair weights, no quotas), and local sliced
+        dispatches become priority-preemptible at checkpoint
+        boundaries. ``controller`` (an :class:`~tnc_tpu.serve.elastic.
+        ElasticController`) additionally arms :meth:`elastic_check` —
+        the advisory scale-decision step."""
+        from tnc_tpu.serve import elastic as _elastic_mod
+
+        self._elastic = (
+            config if config is not None else _elastic_mod.ElasticConfig()
+        )
+        self._elastic_controller = controller
+        return self
+
+    def elastic_check(self) -> dict | None:
+        """One advisory controller step: fold the current queue depth,
+        the fleet roster's live count and the worst SLO burn rate into
+        a scale decision (None without a controller). The decision also
+        lands in ``stats()["elastic"]["controller"]`` and fans out to
+        the controller's ``on_decision`` hooks — actuate it with a
+        :class:`~tnc_tpu.serve.elastic.LocalAutoscaler` or external
+        infrastructure."""
+        ctrl = self._elastic_controller
+        if ctrl is None:
+            return None
+        live = 1
+        if self._fleet_registry is not None:
+            try:
+                live = max(int(self._fleet_registry.roster()["live"]), 1)
+            except Exception:  # noqa: BLE001 — roster is advisory input
+                pass
+        burn = 0.0
+        if self._slo is not None:
+            burn = type(ctrl).burn_from_slo(self._slo.stats())
+        return ctrl.decide(self.queue_depth(), live, burn)
+
+    def _tenant_depths(self) -> dict[str, int]:
+        """Queued requests per tenant (stats / heartbeat surface)."""
+        with self._cond:
+            depths: dict[str, int] = {}
+            for req in self._queue:
+                depths[req.tenant] = depths.get(req.tenant, 0) + 1
+            return depths
+
     def __enter__(self) -> "ContractionService":
         return self.start()
 
@@ -532,14 +602,17 @@ class ContractionService:
         key: tuple,
         payload,
         timeout_s: float | None,
+        tenant: str = "default",
+        priority: int = 0,
     ) -> concurrent.futures.Future:
         """Shared admission path for every query type: bounded queue,
-        deadline arming, request-id assignment, global + per-type
-        accounting."""
+        per-tenant quota (elastic), deadline arming, request-id
+        assignment, global + per-type accounting."""
         fut: concurrent.futures.Future = concurrent.futures.Future()
         deadline = (
             time.monotonic() + float(timeout_s) if timeout_s is not None else None
         )
+        tenant = str(tenant)
         with self._cond:
             if not self._running:
                 self._count("rejected")
@@ -555,10 +628,26 @@ class ContractionService:
                 raise QueueFullError(
                     f"queue at max_queue={self.max_queue}; retry later"
                 )
+            cfg = self._elastic
+            if cfg is not None and cfg.tenant_quotas:
+                quota = cfg.tenant_quotas.get(tenant)
+                if quota is not None and sum(
+                    1 for r in self._queue if r.tenant == tenant
+                ) >= int(quota):
+                    self._count("rejected")
+                    self._count_type(kind, "rejected")
+                    obs.counter_add(
+                        "serve.requests.rejected", reason="tenant_quota"
+                    )
+                    self._slo_request(kind, 0.0, "rejected")
+                    raise TenantQuotaError(
+                        f"tenant {tenant!r} at quota {quota}; retry later"
+                    )
             self._queue.append(
                 _Request(
                     payload, fut, deadline, kind=kind, key=key,
                     rid=next(self._rids),
+                    tenant=tenant, priority=int(priority),
                 )
             )
             depth = len(self._queue)
@@ -575,6 +664,8 @@ class ContractionService:
         bitstring: str | Iterable,
         timeout_s: float | None = None,
         rtol: float | None = None,
+        tenant: str = "default",
+        priority: int = 0,
     ) -> concurrent.futures.Future:
         """Enqueue one amplitude request; returns a ``Future`` resolving
         to the amplitude (complex scalar, or an ndarray over the
@@ -584,7 +675,13 @@ class ContractionService:
         approximate tier: the future resolves to an
         :class:`ApproxAnswer` whose error estimate meets
         ``rtol · max(|value|, 2^(-n/2))`` — or, when the chi-ladder
-        cannot meet it, to the escalated exact answer."""
+        cannot meet it, to the escalated exact answer.
+
+        ``tenant`` / ``priority`` engage the elastic scheduler
+        (:meth:`enable_elastic`): tenants share the window
+        weighted-fair under per-tenant quotas, and a strictly-higher
+        ``priority`` jumps the queue — preempting a running sliced
+        contraction at its next checkpoint boundary."""
         if rtol is not None:
             return self._submit_approx("amplitude", bitstring, rtol, timeout_s)
         # validate at admission: a malformed request must fail alone,
@@ -594,7 +691,8 @@ class ContractionService:
         # and dispatch never re-validates
         bitstring = self.bound.template.request_bits(bitstring)
         return self._enqueue(
-            "amplitude", ("amplitude",), bitstring, timeout_s
+            "amplitude", ("amplitude",), bitstring, timeout_s,
+            tenant=tenant, priority=priority,
         )
 
     def _submit_approx(
@@ -615,7 +713,8 @@ class ContractionService:
         return self._enqueue(APPROX_KIND, tuple(key), payload, timeout_s)
 
     def submit_query(
-        self, kind: str, payload, timeout_s: float | None = None
+        self, kind: str, payload, timeout_s: float | None = None,
+        tenant: str = "default", priority: int = 0,
     ) -> concurrent.futures.Future:
         """Enqueue one typed query request through its registered
         handler; the handler validates the payload at admission and
@@ -627,7 +726,10 @@ class ContractionService:
                 "(enable_queries / register_query_handler first)"
             )
         payload, key = handler.validate(payload)
-        return self._enqueue(kind, tuple(key), payload, timeout_s)
+        return self._enqueue(
+            kind, tuple(key), payload, timeout_s,
+            tenant=tenant, priority=priority,
+        )
 
     def submit_sample(
         self,
@@ -728,10 +830,31 @@ class ContractionService:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0 or not self._cond.wait(timeout=remaining):
                     break
-            batch = [
-                self._queue.popleft()
-                for _ in range(min(self.max_batch, len(self._queue)))
-            ]
+            cfg = self._elastic
+            if cfg is not None and len(self._queue) > 1:
+                # elastic window selection: priority classes first,
+                # weighted-fair across tenants within a class, FIFO
+                # within a tenant (stride scheduling — see elastic.py)
+                from tnc_tpu.serve import elastic as _elastic_mod
+
+                items = list(self._queue)
+                order = _elastic_mod.weighted_fair_order(
+                    items,
+                    lambda r: r.tenant,
+                    lambda r: r.priority,
+                    weights=cfg.tenant_weights,
+                )
+                picked = order[: self.max_batch]
+                taken = set(picked)
+                batch = [items[i] for i in picked]
+                self._queue = deque(
+                    items[i] for i in range(len(items)) if i not in taken
+                )
+            else:
+                batch = [
+                    self._queue.popleft()
+                    for _ in range(min(self.max_batch, len(self._queue)))
+                ]
             obs.gauge_set("serve.queue_depth", len(self._queue))
             return batch
 
@@ -785,10 +908,57 @@ class ContractionService:
 
     def _dispatch_amps(self, bound: BoundProgram, bits: list) -> np.ndarray:
         """One batch execution under ``bound`` — locally, or through the
-        pluggable ``dispatcher`` (multi-host fan-out)."""
+        pluggable ``dispatcher`` (multi-host fan-out). With elastic
+        scheduling enabled, local sliced dispatches run preemptibly: a
+        strictly-higher-priority arrival forces a checkpoint save at
+        the next slice boundary, the priority work runs in the
+        interlude, and the contraction resumes bit-identically."""
         if self.dispatcher is not None:
             return self.dispatcher(bound, bits, self.backend)
+        cfg = self._elastic
+        if cfg is not None and cfg.preempt_enabled and not self._in_interlude:
+            from tnc_tpu.serve import elastic as _elastic_mod
+
+            return _elastic_mod.preemptible_amplitudes(
+                bound, bits, self.backend,
+                ckpt=cfg.ckpt_dir,
+                should_yield=self._should_preempt,
+                interlude=self._priority_interlude,
+                max_yields=cfg.max_yields,
+            )
         return bound.amplitudes_det(bits, self.backend)
+
+    def _should_preempt(self, cursor: int) -> bool:
+        """The ``on_slice`` gate: yield when any queued request outranks
+        the batch currently dispatching (never from inside an
+        interlude — priority work itself runs to completion)."""
+        if self._in_interlude:
+            return False
+        prio = self._active_priority
+        with self._cond:
+            return any(req.priority > prio for req in self._queue)
+
+    def _priority_interlude(self) -> None:
+        """Runs between a preempted contraction's yield and its resume:
+        pull every request outranking the preempted batch off the queue
+        and serve them as a nested batch (same plumbing — grouping,
+        retry, degrade, accounting — under a recursion guard so the
+        interlude is itself never preempted)."""
+        prio = self._active_priority
+        with self._cond:
+            higher = [req for req in self._queue if req.priority > prio]
+            for req in higher:
+                self._queue.remove(req)
+            if higher:
+                obs.gauge_set("serve.queue_depth", len(self._queue))
+        if not higher:
+            return
+        self._in_interlude = True
+        try:
+            self._run_batch(higher)
+        finally:
+            self._in_interlude = False
+            self._active_priority = prio
 
     def _per_request(self, amps: np.ndarray, i: int):
         out = amps[i]
@@ -863,6 +1033,10 @@ class ContractionService:
         self, group: list[_Request], bound: BoundProgram
     ) -> None:
         kind = group[0].kind
+        # the running batch's priority class — what the preemption gate
+        # compares queued arrivals against (single dispatcher thread;
+        # interludes save/restore around their nested batch)
+        self._active_priority = max(req.priority for req in group)
         self._count("batches")
         self._count_type(kind, "batches")
         with self._lock:
@@ -959,6 +1133,7 @@ class ContractionService:
         with self._lock:
             generation = self._generation
         for req in batch:
+            self._active_priority = req.priority
             t0 = time.monotonic()
             try:
                 with _fleet.dispatch_context(
@@ -1301,6 +1476,19 @@ class ContractionService:
             out["plan_cache"] = self._plan_cache.stats()
         if self._slo is not None:
             out["slo"] = self._slo.stats()
+        if self._elastic is not None:
+            from tnc_tpu.serve import elastic as _elastic_mod
+
+            out["elastic"] = {
+                "counters": _elastic_mod.counters(),
+                "tenants": self._tenant_depths(),
+                "weights": dict(self._elastic.tenant_weights),
+                "quotas": dict(self._elastic.tenant_quotas),
+                "controller": (
+                    dict(self._elastic_controller.last_decision)
+                    if self._elastic_controller is not None else None
+                ),
+            }
         return out
 
     def _effective_reuse_store(self):
@@ -1420,6 +1608,16 @@ class ContractionService:
                         1 for row in drift.values()
                         if isinstance(row, dict) and row.get("alerting")
                     )
+                if self._elastic is not None:
+                    from tnc_tpu.serve import elastic as _elastic_mod
+
+                    payload["tenants"] = self._tenant_depths()
+                    payload["elastic"] = _elastic_mod.counters()
+                # the cluster dispatcher's last per-process slice-range
+                # assignment (serve_top --fleet's assignment column)
+                assignment = getattr(self.dispatcher, "last_ranges", None)
+                if assignment is not None:
+                    payload["assignment"] = [list(r) for r in assignment]
                 return payload
 
             self._fleet_registry = registry
@@ -1560,6 +1758,29 @@ class ContractionService:
                             {"tier": tier, "outcome": key}, value,
                         )
                     )
+        if self._elastic is not None:
+            from tnc_tpu.serve import elastic as _elastic_mod
+
+            # serve_elastic_*: the elastic event ledger (reassigned /
+            # preempted / scale decisions), per-tenant queue depths,
+            # and the controller's current target — same numbers as
+            # stats()["elastic"], so /metrics and /fleet federate them
+            for event, value in sorted(_elastic_mod.counters().items()):
+                fams.append(
+                    ("counter", "serve.elastic.events",
+                     {"event": event}, float(value))
+                )
+            for tenant, depth in sorted(self._tenant_depths().items()):
+                fams.append(
+                    ("gauge", "serve.elastic.tenant_queue",
+                     {"tenant": tenant}, float(depth))
+                )
+            ctrl = self._elastic_controller
+            if ctrl is not None:
+                fams.append(
+                    ("gauge", "serve.elastic.scale_target", {},
+                     float(ctrl.last_decision.get("target", 0)))
+                )
         return fams
 
 
