@@ -2132,6 +2132,12 @@ def _serve_bench() -> dict:
             max_batch=max_batch,
             max_wait_ms=wait_ms,
             max_queue=max(n_queries, 1024),
+            # cost-truth loop on the reference constants: production
+            # sampling + drift-triggered refit state rides the record's
+            # serving.calibration block (in-process versions only — the
+            # bench is one replica, no shared registry)
+            cost_model=ref_model,
+            cost_truth=True,
         ) as svc:
             # warmup outside the timed window: one singleton (the
             # batch-1 bucket) AND one full amplitude batch (the
@@ -2249,6 +2255,22 @@ def _serve_bench() -> dict:
         "reference_model": ref_constants,
         "slo": slo_block,
     }
+    # serving.calibration: the cost-truth loop's state at burst end —
+    # the live model generation, sampler fill, and the refit /
+    # publish / rollback ledger (scripts/perf_gate.py cross-checks
+    # model_version consistency and fit staleness)
+    cal_stats = stats.get("calibration")
+    if cal_stats:
+        block["calibration"] = {
+            "model_version": cal_stats["model_version"],
+            "model": cal_stats["model"],
+            "fitted_unix": cal_stats["fitted_unix"],
+            "sampler": {
+                "offered": cal_stats["sampler"]["offered"],
+                "kept": cal_stats["sampler"]["kept"],
+            },
+            "counts": cal_stats["counts"],
+        }
     sweep_spec = os.environ.get("BENCH_SERVE_SWEEP")
     if sweep_spec:
         block["reuse"] = _serve_reuse_sweep(
@@ -2286,6 +2308,14 @@ def _serve_bench() -> dict:
         f"[bench]   slo: drift_max_ratio {slo_block['drift_max_ratio']}, "
         f"alerts {slo_block['alerts'] or 'none'}"
     )
+    if "calibration" in block:
+        c = block["calibration"]
+        log(
+            f"[bench]   calibration: model v{c['model_version']}, "
+            f"sampler {c['sampler']['kept']}/{c['sampler']['offered']} "
+            f"kept, refits {c['counts']['refits']}, rollbacks "
+            f"{c['counts']['rollbacks']}"
+        )
     fleet_block = _serve_fleet_block()
     if fleet_block is not None:
         block["fleet"] = fleet_block
@@ -2472,6 +2502,18 @@ def _serve_fleet_block() -> dict | None:
             ages = [r["age_s"] for r in roster["replicas"]]
             if ages:
                 out["max_heartbeat_gap_s"] = round(max(ages), 3)
+            # per-replica cost-model generations: >1 distinct version
+            # means the fleet was split across model generations during
+            # the run (perf_gate warns — mixed pricing taints fleet-wide
+            # comparisons)
+            versions = sorted({
+                r["payload"]["model_version"]
+                for r in roster["replicas"]
+                if isinstance(r.get("payload"), dict)
+                and r["payload"].get("model_version") is not None
+            })
+            if versions:
+                out["model_versions"] = versions
         except Exception as e:  # registry unreadable ≠ bench failure
             out["registry_error"] = f"{type(e).__name__}: {e}"
     if obs.enabled():
@@ -2530,6 +2572,9 @@ def _run_config(config: str) -> dict:
     extra = out[3] if len(out) > 3 else {}
     record = {
         "metric": metric,
+        # when this record was measured: the anchor perf_gate's
+        # calibration-staleness warning compares fitted_unix against
+        "written_unix": time.time(),
         "value": round(tpu_s, 4) if tpu_s >= 0.001 else float(f"{tpu_s:.3g}"),
         "unit": "s",
         "vs_baseline": (

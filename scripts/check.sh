@@ -92,6 +92,9 @@ TNC_TPU_PLATFORM=cpu python scripts/reuse_smoke.py
 echo "== SLO smoke (live /metrics==stats, >=95% trace attribution, injected slowdown flips burn+drift) =="
 TNC_TPU_PLATFORM=cpu python scripts/slo_smoke.py
 
+echo "== cost-truth smoke (sampler overhead pin, measured-margin replan, drift->refit->versioned adoption, regressed swap auto-rollback, bitwise goldens) =="
+TNC_TPU_PLATFORM=cpu python scripts/cost_truth_smoke.py
+
 echo "== approx-tier smoke (chi-ladder error bars vs oracle, forced escalation, tier pricing) =="
 TNC_TPU_PLATFORM=cpu python scripts/approx_smoke.py
 

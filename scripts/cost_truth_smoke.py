@@ -1,0 +1,328 @@
+#!/usr/bin/env python
+"""Cost-truth loop smoke for scripts/check.sh: one live service driven
+through the full detect -> refit -> publish -> adopt -> rollback cycle,
+pinned end to end.
+
+1. **Overhead pin**: warm singleton-amplitude p50 with the production
+   sampler enabled stays within 5% of the disabled path (plus a
+   quarter-millisecond absolute guard: CPU dispatch here is ~1 ms and
+   scheduler jitter alone exceeds 5% of that).
+2. **Measured-margin replans**: with the scoreboard warm, a
+   BackgroundReplanner attempt prices the incumbent from MEASURED
+   dispatch seconds (counted in ``stats()["measured_margins"]``) — and
+   the deliberately pessimistic offline model (predictions ~20x above
+   reality) cannot lure it into a swap.
+3. **Drift -> refit -> versioned adoption**: an injected dispatch
+   slowdown (fault DSL ``serve.dispatch=slow:...``) fires the drift
+   alert, which triggers a hysteresis-bounded refit; the accepted fit
+   is published to the model registry as a new version and adopted at
+   a batch boundary, visible on ``/calibration`` and ``/metrics``.
+4. **Auto-rollback**: a deliberately regressed plan swap (a genuinely
+   different random-greedy plan, made slow by a heavier fault) trips
+   the post-swap watch, rolls back to the prior plan, pins the bad
+   plan's signature, and a re-staged copy of it is refused.
+5. **Bitwise stability**: golden amplitudes taken before any of the
+   above reproduce bit-for-bit at the end — calibration moves pricing,
+   never numerics.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+os.environ.setdefault("TNC_TPU_COST_TRUTH", "1")
+
+import numpy as np  # noqa: E402
+
+from tnc_tpu.builders.random_circuit import brickwork_circuit  # noqa: E402
+from tnc_tpu.contractionpath.paths import Greedy, OptMethod  # noqa: E402
+from tnc_tpu.obs.calibrate import CalibratedCostModel  # noqa: E402
+from tnc_tpu.obs.cost_truth import CostTruthConfig  # noqa: E402
+from tnc_tpu.obs.http import parse_prometheus, wait_port_released  # noqa: E402
+from tnc_tpu.obs.slo import (  # noqa: E402
+    BurnWindow,
+    LatencyObjective,
+    SLOConfig,
+)
+from tnc_tpu.resilience.faultinject import faults  # noqa: E402
+from tnc_tpu.serve import ContractionService  # noqa: E402
+from tnc_tpu.serve.plancache import PlanCache  # noqa: E402
+from tnc_tpu.serve.rebind import bind_template, plan_signature  # noqa: E402
+from tnc_tpu.serve.replan import BackgroundReplanner  # noqa: E402
+
+N_QUBITS = 6
+DEPTH = 4
+OVERHEAD_REPS = 96  # singletons per overhead-pin phase
+SLOW_S = 0.05  # drift-phase injected per-dispatch sleep
+REGRESS_S = 0.5  # rollback-phase injected sleep (vs ~1ms baseline)
+GOLDEN_BITS = ["000000", "010101", "111111", "001100"]
+
+
+def slo_config() -> SLOConfig:
+    return SLOConfig(
+        # the burn objective sits far above both healthy (~1ms) and the
+        # injected 50ms slowdown: this smoke pins the DRIFT path alone
+        objectives=(LatencyObjective("*", 5.0, target=0.9),),
+        windows=(BurnWindow(15.0, 60.0, 2.0),),
+        min_requests=8,
+        drift_threshold=3.0,
+        drift_alpha=0.3,
+        drift_min_samples=3,
+        drift_baseline_samples=4,
+    )
+
+
+def cost_truth_config() -> CostTruthConfig:
+    return CostTruthConfig(
+        refit_min_samples=6,
+        refit_cooldown_s=0.5,
+        max_rel_step=0.5,
+        min_rel_change=0.001,
+        scoreboard_min_samples=4,
+        rollback_window=6,
+        rollback_tolerance=2.0,
+        rollback_min_samples=2,
+    )
+
+
+def fetch(url: str) -> str:
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.read().decode("utf-8")
+
+
+def calibration(svc) -> dict:
+    return svc.stats()["calibration"]
+
+
+def wait_until(predicate, timeout_s: float = 30.0, label: str = ""):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {label}")
+
+
+def timed_singletons(svc, rng, n: int) -> float:
+    """p50 client-side latency of n serial singleton amplitudes."""
+    lat = []
+    for _ in range(n):
+        bits = "".join(rng.choice(["0", "1"], N_QUBITS))
+        t0 = time.perf_counter()
+        svc.amplitude(bits)
+        lat.append(time.perf_counter() - t0)
+    return statistics.median(lat)
+
+
+def golden(svc) -> bytes:
+    return np.asarray(
+        [svc.amplitude(b) for b in GOLDEN_BITS], dtype=np.complex128
+    ).tobytes()
+
+
+def different_plan(bound):
+    """A genuinely different plan for the SAME template (different
+    contraction order -> different program signature): greedy under an
+    alternative pair heuristic — deterministic, and asserted different."""
+    for kind, alpha in (
+        ("size", 1.0),
+        ("memory-removed-log", 1.0),
+        ("memory-removed", 0.25),
+        ("memory-removed", 2.0),
+    ):
+        alt = bind_template(
+            bound.template,
+            Greedy(OptMethod.GREEDY, cost_fn=kind, alpha=alpha),
+            plan_cache=None,
+            target_size=bound.target_size,
+        )
+        if plan_signature(alt) != plan_signature(bound):
+            return alt
+    raise AssertionError("every greedy heuristic found the same plan")
+
+
+def main() -> int:
+    rng = np.random.default_rng(7)
+    circuit = brickwork_circuit(N_QUBITS, DEPTH, np.random.default_rng(0))
+    cache = PlanCache(tempfile.mkdtemp())
+    registry_dir = tempfile.mkdtemp()
+    # deliberately pessimistic offline constants: predictions land ~20x
+    # above measured reality, so (a) the drift refit has real work to
+    # do and (b) no replan candidate can beat a measured incumbent
+    model0 = CalibratedCostModel(flops_per_s=1e6, dispatch_s=1e-3)
+
+    with ContractionService.from_circuit(
+        circuit,
+        plan_cache=cache,
+        slo=slo_config(),
+        cost_model=model0,
+        telemetry_port=0,
+        max_batch=8,
+        max_wait_ms=1.0,
+    ) as svc:
+        base = svc._telemetry.url
+        port = svc._telemetry.port
+        svc.amplitude("0" * N_QUBITS)  # plan/compile warmup
+
+        # ---- 1. overhead pin (sampler off, then on) ------------------
+        p50_off = timed_singletons(svc, rng, OVERHEAD_REPS)
+        svc.enable_cost_truth(
+            registry=registry_dir, config=cost_truth_config()
+        )
+        assert calibration(svc)["model_version"] == 1, calibration(svc)
+        p50_on = timed_singletons(svc, rng, OVERHEAD_REPS)
+        assert p50_on <= p50_off * 1.05 + 2.5e-4, (
+            f"sampler overhead busted the pin: p50 {p50_off * 1e3:.3f} ms "
+            f"(off) -> {p50_on * 1e3:.3f} ms (on)"
+        )
+        print(
+            f"[cost_truth_smoke] overhead pin: p50 {p50_off * 1e3:.3f} ms "
+            f"off -> {p50_on * 1e3:.3f} ms on "
+            f"({(p50_on / p50_off - 1.0) * 100.0:+.1f}%)"
+        )
+        amps0 = golden(svc)
+
+        # ---- 2. measured-margin replan -------------------------------
+        cal = wait_until(
+            lambda: calibration(svc)
+            if calibration(svc)["counts"]["samples"]
+            >= cost_truth_config().scoreboard_min_samples
+            else None,
+            label="a warm scoreboard",
+        )
+        assert cal["sampler"]["kept"] > 0, cal["sampler"]
+        assert svc.measured_plan_seconds() is not None
+        rp = BackgroundReplanner(
+            svc, cache,
+            optimizer=Greedy(OptMethod.RANDOM_GREEDY, ntrials=2, seed=3),
+            cost_model=svc.cost_model,
+        )
+        rp._attempt_once()
+        assert rp.stats["measured_margins"] >= 1, rp.stats
+        assert rp.stats["rejects"] >= 1, (
+            f"pessimistic predictions beat a measured incumbent: {rp.stats}"
+        )
+        print(
+            "[cost_truth_smoke] replan margin priced the incumbent from "
+            f"measured seconds ({svc.measured_plan_seconds() * 1e3:.3f} ms) "
+            "and rejected the candidate"
+        )
+
+        # ---- 3. drift -> refit -> versioned adoption -----------------
+        with faults(f"serve.dispatch=slow:{SLOW_S}*-1"):
+            for _ in range(12):
+                svc.amplitude("".join(rng.choice(["0", "1"], N_QUBITS)))
+            cal = wait_until(
+                lambda: calibration(svc)
+                if calibration(svc)["counts"]["model_adoptions"] >= 1
+                else (
+                    svc.amplitude(
+                        "".join(rng.choice(["0", "1"], N_QUBITS))
+                    )
+                    and None
+                ),
+                label="a refit adoption under drift",
+            )
+        kinds = {a["kind"] for a in svc.stats()["slo"]["alerts"]}
+        assert "drift" in kinds, svc.stats()["slo"]["alerts"]
+        assert cal["counts"]["refits"] >= 1, cal["counts"]
+        assert cal["counts"]["publishes"] >= 2, cal["counts"]  # seed + refit
+        assert cal["model_version"] >= 2, cal
+        assert cal["model"]["flops_per_s"] != model0.flops_per_s
+        # no dispatches run between here and the fetches, so the
+        # adopted version is stable; the registry may already hold a
+        # LATER staged-but-unadopted publish (refits keep firing while
+        # the drift alert decays), hence >= on the document version
+        cal = calibration(svc)
+        with open(os.path.join(registry_dir, "cost_model.json")) as fh:
+            doc = json.load(fh)
+        assert doc["version"] >= cal["model_version"], doc
+        assert doc["trigger"] == "drift", doc
+        endpoint = json.loads(fetch(base + "/calibration"))
+        assert endpoint["model_version"] == cal["model_version"], endpoint
+        pm = parse_prometheus(fetch(base + "/metrics"))
+        gauge = {
+            k: v for k, v in pm.items()
+            if "cost_truth_model_version" in k
+        }
+        assert gauge and set(gauge.values()) == {
+            float(cal["model_version"])
+        }, gauge
+        print(
+            f"[cost_truth_smoke] drift alert -> refit -> model "
+            f"v{cal['model_version']} adopted "
+            f"(flops/s {model0.flops_per_s:.3g} -> "
+            f"{cal['model']['flops_per_s']:.3g}, "
+            f"{cal['counts']['refits']} refit(s))"
+        )
+        assert golden(svc) == amps0, "amplitudes drifted after refit"
+
+        # ---- 4. regressed swap -> auto-rollback ----------------------
+        orig = svc.bound
+        alt = different_plan(orig)
+        svc.swap_bound(alt)
+        svc.amplitude("0" * N_QUBITS)  # batch boundary: adopt + arm watch
+        cal = calibration(svc)
+        assert cal["counts"]["rollback_watches"] >= 1, cal["counts"]
+        with faults(f"serve.dispatch=slow:{REGRESS_S}*-1"):
+            for _ in range(3):
+                svc.amplitude("".join(rng.choice(["0", "1"], N_QUBITS)))
+        svc.amplitude("0" * N_QUBITS)  # boundary: adopt the rollback
+        cal = wait_until(
+            lambda: calibration(svc)
+            if calibration(svc)["counts"]["rollbacks"] >= 1
+            else None,
+            label="the rollback",
+        )
+        assert svc.bound is orig, "rollback did not restore the prior plan"
+        assert cal["counts"]["rollback_pinned"] == 1, cal["counts"]
+        assert cal["pinned_plans"] == 1, cal
+        assert cal["last_rollback"] is not None, cal
+        assert cal["swap_watch"] is None, cal
+        print(
+            f"[cost_truth_smoke] regressed swap rolled back "
+            f"(measured {cal['last_rollback']['measured_s'] * 1e3:.1f} ms "
+            f"vs baseline {cal['last_rollback']['baseline_s'] * 1e3:.3f} ms"
+            f", plan pinned)"
+        )
+
+        # the pinned plan cannot come back: a re-staged copy is refused
+        svc.swap_bound(alt)
+        svc.amplitude("0" * N_QUBITS)
+        cal = wait_until(
+            lambda: calibration(svc)
+            if calibration(svc)["counts"].get("pin_refusals", 0) >= 1
+            else None,
+            label="the pin refusal",
+        )
+        assert svc.bound is orig, "a pinned plan was re-adopted"
+        assert calibration(svc)["counts"]["rollbacks"] == 1
+        print("[cost_truth_smoke] re-staged pinned plan refused")
+
+        # ---- 5. bitwise stability ------------------------------------
+        assert golden(svc) == amps0, "amplitudes drifted after rollback"
+        print(
+            f"[cost_truth_smoke] {len(GOLDEN_BITS)} golden amplitudes "
+            "bitwise-stable through refit + rollback"
+        )
+
+    assert wait_port_released("127.0.0.1", port), (
+        f"telemetry port {port} still accepting connections after stop()"
+    )
+    print("[cost_truth_smoke] OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -50,6 +50,7 @@ REQUIRED_MODULES = (
     os.path.join("tnc_tpu", "obs", "slo.py"),
     os.path.join("tnc_tpu", "obs", "http.py"),
     os.path.join("tnc_tpu", "obs", "fleet.py"),
+    os.path.join("tnc_tpu", "obs", "cost_truth.py"),
     os.path.join("tnc_tpu", "utils", "digest.py"),
     os.path.join("tnc_tpu", "ops", "strassen.py"),
     os.path.join("tnc_tpu", "ops", "pallas_complex.py"),

@@ -49,7 +49,11 @@ failures, unless ``--strict``):
 - the serving SLO block (``serving.slo``) — the candidate's worst
   measured-vs-baseline dispatch drift ratio (warn beyond 1.5x: the
   hardware/schedule moved away from what the run itself calibrated)
-  and any burn/drift alerts the measured run fired.
+  and any burn/drift alerts the measured run fired;
+- calibration freshness (``--calibration-horizon``) — a cost model
+  fitted long before the record was written, or fleet replicas that
+  disagree on the adopted ``model_version``, means the gate's
+  throughput cross-checks are judging against an outdated truth.
 
 Exit codes: 0 pass, 1 regression, 2 unusable input (missing files,
 error records, mismatched metrics).
@@ -138,6 +142,7 @@ def compare(
     sigma: float = 2.0,
     phase_tol: float = 0.75,
     phase_floor_s: float = 0.05,
+    calibration_horizon_s: float = 86400.0,
 ) -> tuple[int, list[str]]:
     """Gate logic; returns (exit_code, messages). Pure on dicts so the
     tests drive it without subprocesses."""
@@ -194,6 +199,25 @@ def compare(
             f"warning: calibrated throughput dropped "
             f"{bf / cf:.2f}x ({bf:.3g} -> {cf:.3g} FLOP/s)"
         )
+
+    # calibration staleness cross-check: a record whose cost model was
+    # fitted long before the record itself was written is judging fresh
+    # hardware against an old truth — the gate's throughput comparisons
+    # above become meaningless without anyone noticing. Checks both the
+    # offline block (``calibration.fitted_unix``) and the cost-truth
+    # serving block (``serving.calibration.fitted_unix``).
+    written = cand.get("written_unix")
+    if written and calibration_horizon_s > 0:
+        scal = (cand.get("serving") or {}).get("calibration") or {}
+        for label, block in (("calibration", cc), ("serving.calibration", scal)):
+            fitted = block.get("fitted_unix")
+            if fitted and float(written) - float(fitted) > calibration_horizon_s:
+                age = float(written) - float(fitted)
+                msgs.append(
+                    f"warning: {label} model is stale: fitted "
+                    f"{age / 3600.0:.1f}h before the record was written "
+                    f"(horizon {calibration_horizon_s / 3600.0:.1f}h)"
+                )
 
     # distributed fan-in cross-check: reduce-phase wall time and the
     # schedule's concurrency (pairs/levels) between records
@@ -312,6 +336,13 @@ def compare(
         msgs.append(
             f"warning: {cfl['replicas_stale']} fleet replica(s) still "
             "stale at the end of the candidate bench run"
+        )
+    versions = cfl.get("model_versions") or []
+    if len(set(versions)) > 1:
+        msgs.append(
+            "warning: fleet replicas disagree on the cost-model version "
+            f"({sorted(set(versions))}) — a registry adoption is lagging "
+            "on part of the fleet, so per-replica predictions diverge"
         )
 
     # serving reuse cross-check (the BENCH_SERVE_SWEEP block): the
@@ -487,6 +518,12 @@ def main(argv: list[str] | None = None) -> int:
         help="noise multiplier applied to the rep spread (default 2.0)",
     )
     parser.add_argument(
+        "--calibration-horizon", type=float, default=86400.0,
+        help="warn when the candidate's cost model was fitted more than "
+             "this many seconds before the record was written "
+             "(default 86400 = 24h; <=0 disables)",
+    )
+    parser.add_argument(
         "--strict", action="store_true",
         help="phase regressions fail the gate instead of warning",
     )
@@ -501,7 +538,7 @@ def main(argv: list[str] | None = None) -> int:
 
     code, msgs = compare(
         base, cand, min_tol=args.min_tol, max_tol=args.max_tol,
-        sigma=args.sigma,
+        sigma=args.sigma, calibration_horizon_s=args.calibration_horizon,
     )
     warned = any(m.startswith("warning:") for m in msgs)
     for m in msgs:

@@ -224,8 +224,8 @@ def render_fleet_frame(
 ) -> tuple[str, dict[str, float]]:
     head = (
         f"{'replica':<18} {'state':<7} {'hb age':>7} {'queue':>6} "
-        f"{'qps':>7} {'p99 ms':>8} {'alerts':>6} {'assign':>12} "
-        f"{'tenants':<18}"
+        f"{'qps':>7} {'p99 ms':>8} {'alerts':>6} {'model':>6} "
+        f"{'drift':>7} {'assign':>12} {'tenants':<18}"
     )
     lines = [
         f"fleet_top — {len(sources)} replicas   {time.strftime('%H:%M:%S')}",
@@ -237,6 +237,14 @@ def render_fleet_frame(
         name, payload = src["name"], src["payload"]
         queue = payload.get("queue_depth", "?")
         alerts = payload.get("slo_alerts", "?")
+        # cost-truth columns: the heartbeat carries the replica's live
+        # cost-model generation and its worst drift ratio — a replica
+        # serving under a stale model (version lagging its peers) or
+        # drifting pricing is visible at a glance
+        version = payload.get("model_version")
+        model_s = f"v{version}" if version is not None else "-"
+        drift = payload.get("drift_ratio")
+        drift_s = f"{drift:.2f}" if drift is not None else "-"
         # elastic columns: the root's heartbeat carries the last
         # collective round's per-process slice-range assignment; any
         # elastic-enabled replica carries its per-tenant queue depths
@@ -276,8 +284,8 @@ def render_fleet_frame(
         age_s = f"{age:.1f}s" if age is not None else "-"
         lines.append(
             f"{name:<18} {state:<7} {age_s:>7} {queue!s:>6} "
-            f"{qps_s:>7} {p99_s:>8} {alerts!s:>6} {assign_s:>12} "
-            f"{tenants_s:<18}"
+            f"{qps_s:>7} {p99_s:>8} {alerts!s:>6} {model_s:>6} "
+            f"{drift_s:>7} {assign_s:>12} {tenants_s:<18}"
         )
     return "\n".join(lines), completed_now
 

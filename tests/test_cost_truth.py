@@ -1,0 +1,653 @@
+"""Cost-truth loop (tnc_tpu.obs.cost_truth) + its serving surfaces.
+
+Pins the calibration-lifecycle contracts:
+
+- **production sampler**: per-(type × bucket) reservoir cap, stratum
+  independence, the ``enabled=False`` no-op hot path, and per-step
+  normalization of fit samples;
+- **refit hysteresis**: min-sample gate, per-term clamp against the
+  current model, and the significance gate that refuses version churn
+  on noise;
+- **model registry**: monotone versioned publish/load round trips,
+  corrupt-entry deletion (degrade, never crash), fingerprint probes,
+  and the watcher's own-publish round-trip guard;
+- **scoreboard + swap watch**: measured-seconds gating by sample
+  count, LRU eviction, and the regressed/ok/sticky verdict machine;
+- **controller**: seed-generation precedence (registry beats
+  constructor model), two-phase stage/adopt, refit cooldown, the
+  rollback-once handshake, and the ``TNC_TPU_COST_TRUTH=0`` kill
+  switch;
+- **serving surfaces**: drift-unstable query types land in
+  ``slo.drift_excluded`` (never the drift detector), the replanner's
+  measured-incumbent plumbing, perf_gate's staleness and
+  fleet-version-skew warnings, serve_top's model/drift columns, and
+  the flight-recorder ``model_version`` annotation.
+"""
+
+import importlib.util
+import json
+import os
+import time
+from types import SimpleNamespace
+
+import pytest
+
+import tnc_tpu.obs as obs
+from tnc_tpu.obs.calibrate import CalibratedCostModel, StepSample
+from tnc_tpu.obs.cost_truth import (
+    CostTruth,
+    CostTruthConfig,
+    ModelRegistry,
+    ModelRegistryWatcher,
+    PlanScoreboard,
+    ProductionSampler,
+    SwapWatch,
+    config_from_env,
+    refit_model,
+)
+from tnc_tpu.obs.slo import BurnWindow, LatencyObjective, SLOConfig, SLOEngine
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# -- production sampler ----------------------------------------------------
+
+
+class TestProductionSampler:
+    def test_reservoir_cap_per_stratum(self):
+        s = ProductionSampler(capacity=8)
+        for i in range(200):
+            s.offer("amplitude", 1, 1e9, 1e6, 3, 1e-3 * (i + 1))
+        c = s.counts()
+        assert c["offered"] == 200
+        assert c["kept"] == 8
+        assert c["buckets"]["amplitude/b1"] == {"seen": 200, "kept": 8}
+        assert len(s.samples()) == 8
+
+    def test_strata_are_independent(self):
+        s = ProductionSampler(capacity=4)
+        for _ in range(10):
+            s.offer("amplitude", 1, 1e9, 0.0, 1, 1e-3)
+            s.offer("amplitude", 8, 1e9, 0.0, 1, 1e-3)
+            s.offer("marginal", 1, 1e9, 0.0, 1, 1e-3)
+        buckets = s.counts()["buckets"]
+        assert set(buckets) == {"amplitude/b1", "amplitude/b8", "marginal/b1"}
+        assert all(b["kept"] == 4 for b in buckets.values())
+
+    def test_disabled_is_a_no_op(self):
+        s = ProductionSampler(capacity=8, enabled=False)
+        for _ in range(50):
+            s.offer("amplitude", 1, 1e9, 0.0, 1, 1e-3)
+        assert s.counts() == {"offered": 0, "kept": 0, "buckets": {}}
+        assert s.samples() == []
+
+    def test_fit_samples_normalize_per_step(self):
+        """A dispatch covering N steps must enter the fit as per-STEP
+        rows, or the fitted dispatch_s would absorb N× the overhead."""
+        s = ProductionSampler(capacity=4)
+        s.offer("amplitude", 2, 8e9, 4e6, 4, 0.4)
+        (row,) = s.fit_samples()
+        assert row.name == "dispatch[amplitude/b2]"
+        assert row.flops == pytest.approx(2e9)
+        assert row.bytes == pytest.approx(1e6)
+        assert row.dur_s == pytest.approx(0.1)
+        assert row.source == "serve"
+
+    def test_reset_drains(self):
+        s = ProductionSampler(capacity=4)
+        s.offer("amplitude", 1, 1e9, 0.0, 1, 1e-3)
+        s.reset()
+        assert s.samples() == []
+
+
+# -- refit hysteresis ------------------------------------------------------
+
+
+def _rate_samples(flops_per_s, dispatch_s=0.0, n=8):
+    """Exact samples at a known rate: dur = flops/F + c, no noise."""
+    return [
+        StepSample(
+            f"synth[{i}]",
+            float(i + 1) * 1e9,
+            0.0,
+            (i + 1) * 1e9 / flops_per_s + dispatch_s,
+        )
+        for i in range(n)
+    ]
+
+
+class TestRefitModel:
+    def test_min_samples_gate(self):
+        cfg = CostTruthConfig(refit_min_samples=16)
+        model, info = refit_model(
+            CalibratedCostModel(flops_per_s=1e9),
+            _rate_samples(1e9, n=4),
+            cfg,
+        )
+        assert model is None
+        assert info["rejected"] == "min_samples"
+
+    def test_clamp_bounds_the_step(self):
+        """Traffic 10x slower than the model claims moves the constant
+        only max_rel_step per epoch — the fleet converges over several
+        generations instead of lurching."""
+        cfg = CostTruthConfig(refit_min_samples=4, max_rel_step=0.5)
+        current = CalibratedCostModel(flops_per_s=2e9)
+        model, info = refit_model(current, _rate_samples(2e8), cfg)
+        assert model is not None
+        assert "flops_per_s" in info["clamped"]
+        assert model.flops_per_s == pytest.approx(2e9 / 1.5)
+
+    def test_significance_gate_refuses_noise_generations(self):
+        cfg = CostTruthConfig(refit_min_samples=4, min_rel_change=0.05)
+        current = CalibratedCostModel(flops_per_s=1e9, dispatch_s=1e-4)
+        model, info = refit_model(
+            current, _rate_samples(1e9, dispatch_s=1e-4), cfg
+        )
+        assert model is None
+        assert info["rejected"] == "below_min_rel_change"
+        assert info["moved"] < 0.05
+
+    def test_first_epoch_adopts_fit_unclamped(self):
+        cfg = CostTruthConfig(refit_min_samples=4)
+        model, info = refit_model(None, _rate_samples(3e9), cfg)
+        assert model is not None
+        assert model.flops_per_s == pytest.approx(3e9, rel=0.05)
+        assert info["clamped"] == []
+
+
+# -- model registry --------------------------------------------------------
+
+
+class TestModelRegistry:
+    def test_publish_load_roundtrip_and_monotone_versions(self, tmp_path):
+        reg = ModelRegistry(tmp_path)
+        assert reg.latest() is None
+        v1 = reg.publish(
+            CalibratedCostModel(flops_per_s=1e9, dispatch_s=2e-4),
+            n_samples=12, trigger="seed",
+        )
+        v2 = reg.publish(
+            CalibratedCostModel(flops_per_s=2e9), n_samples=30,
+            trigger="drift",
+        )
+        assert (v1, v2) == (1, 2)
+        version, model = reg.latest()
+        assert version == 2
+        assert model.flops_per_s == pytest.approx(2e9)
+        doc = reg.load()
+        assert doc["trigger"] == "drift"
+        assert doc["n_samples"] == 30
+        assert doc["fitted_unix"] <= time.time()
+        assert reg.stats()["publish"] == 2
+
+    def test_corrupt_document_degrades_to_no_model(self, tmp_path):
+        reg = ModelRegistry(tmp_path)
+        reg.publish(CalibratedCostModel(flops_per_s=1e9))
+        reg.path.write_text("{not json")
+        assert reg.load() is None
+        assert not reg.path.exists()
+        assert reg.stats()["corrupt"] == 1
+        # next publish restarts the version chain cleanly
+        assert reg.publish(CalibratedCostModel(flops_per_s=1e9)) == 1
+
+    def test_non_model_json_is_also_corrupt(self, tmp_path):
+        reg = ModelRegistry(tmp_path)
+        reg.path.write_text(json.dumps({"version": 3}))  # no flops_per_s
+        assert reg.latest() is None
+        assert reg.stats()["corrupt"] == 1
+
+    def test_fingerprint_tracks_generations(self, tmp_path):
+        reg = ModelRegistry(tmp_path)
+        assert reg.fingerprint() is None
+        reg.publish(CalibratedCostModel(flops_per_s=1e9))
+        fp1 = reg.fingerprint()
+        reg.publish(CalibratedCostModel(flops_per_s=2e9))
+        fp2 = reg.fingerprint()
+        assert fp1 and fp2 and fp1 != fp2
+
+
+class TestModelRegistryWatcher:
+    def test_stages_foreign_generation_and_skips_own(self, tmp_path):
+        reg = ModelRegistry(tmp_path)
+        ct = CostTruth(
+            CostTruthConfig(),
+            model=CalibratedCostModel(flops_per_s=1e9),
+            registry=reg,
+        )
+        assert ct.model_version == 1  # constructor model published as seed
+        svc = SimpleNamespace(_cost_truth=ct)
+        watcher = ModelRegistryWatcher(svc, reg)
+        assert watcher.poll_once() is False  # nothing new
+
+        # a FOREIGN replica publishes v2 through its own handle
+        ModelRegistry(tmp_path).publish(
+            CalibratedCostModel(flops_per_s=2e9), trigger="drift"
+        )
+        assert watcher.poll_once() is True
+        assert watcher.stats["adopts"] == 1
+        assert ct.stats()["pending_version"] == 2
+        version, model = ct.adopt_pending()
+        assert version == 2
+        assert model.flops_per_s == pytest.approx(2e9)
+
+        # our OWN publish+stage must not round-trip through the watcher
+        v3 = reg.publish(CalibratedCostModel(flops_per_s=3e9))
+        assert ct.stage(v3, CalibratedCostModel(flops_per_s=3e9))
+        assert watcher.poll_once() is False
+        assert watcher.stats["skips"] == 1
+
+
+# -- scoreboard + swap watch ----------------------------------------------
+
+
+class TestPlanScoreboard:
+    def test_measured_seconds_gated_by_samples(self):
+        sb = PlanScoreboard()
+        sb.note("k", 0.01, predicted_s=0.004)
+        sb.note("k", 0.03, predicted_s=0.004)
+        assert sb.measured_seconds("k", min_samples=3) is None
+        sb.note("k", 0.02)
+        assert sb.measured_seconds("k", min_samples=3) == pytest.approx(0.02)
+        row = sb.rows()["k"]
+        assert row["n"] == 3
+        assert row["measured_over_predicted"] == pytest.approx(5.0)
+
+    def test_eviction_drops_least_recently_updated(self):
+        sb = PlanScoreboard(max_plans=2)
+        sb.note("a", 0.01)
+        sb.note("b", 0.01)
+        sb.note("a", 0.01)  # refresh a; b is now oldest
+        sb.note("c", 0.01)
+        assert set(sb.rows()) == {"a", "c"}
+
+
+class TestSwapWatch:
+    def _watch(self, **over):
+        kw = dict(key="k", baseline_s=0.01, window=4, tolerance=1.5,
+                  min_samples=2)
+        kw.update(over)
+        return SwapWatch(**kw)
+
+    def test_regressed_after_min_samples(self):
+        w = self._watch()
+        assert w.note(0.1) is None  # below min_samples: still watching
+        assert w.note(0.1) == "regressed"
+
+    def test_ok_when_window_exhausts_healthy(self):
+        w = self._watch()
+        assert w.note(0.01) is None
+        for _ in range(2):
+            assert w.note(0.012) is None  # mean under 1.5x baseline
+        assert w.note(0.009) == "ok"
+
+    def test_verdict_is_sticky(self):
+        w = self._watch(min_samples=1)
+        assert w.note(1.0) == "regressed"
+        assert w.note(0.0001) == "regressed"
+        assert len(w.samples) == 1  # post-verdict notes don't accumulate
+
+
+# -- controller ------------------------------------------------------------
+
+
+def _ctl_config(**over):
+    kw = dict(refit_min_samples=4, refit_cooldown_s=10.0,
+              rollback_window=4, rollback_tolerance=1.5,
+              rollback_min_samples=1)
+    kw.update(over)
+    return CostTruthConfig(**kw)
+
+
+class TestCostTruthController:
+    def test_seed_generation_precedence(self, tmp_path):
+        # no registry, no model: version 0 (nothing to audit)
+        assert CostTruth(CostTruthConfig()).model_version == 0
+        # no registry, constructor model: in-process version 1
+        ct = CostTruth(
+            CostTruthConfig(), model=CalibratedCostModel(flops_per_s=1e9)
+        )
+        assert ct.model_version == 1
+        # empty registry: the offline model becomes generation 1
+        reg = ModelRegistry(tmp_path / "a")
+        ct = CostTruth(
+            CostTruthConfig(),
+            model=CalibratedCostModel(flops_per_s=1e9),
+            registry=reg,
+        )
+        assert ct.model_version == 1
+        assert reg.load()["trigger"] == "seed"
+        # populated registry: the fleet's generation BEATS the
+        # constructor model
+        reg2 = ModelRegistry(tmp_path / "b")
+        reg2.publish(CalibratedCostModel(flops_per_s=5e9))
+        reg2.publish(CalibratedCostModel(flops_per_s=7e9))
+        ct = CostTruth(
+            CostTruthConfig(),
+            model=CalibratedCostModel(flops_per_s=1e9),
+            registry=reg2,
+        )
+        assert ct.model_version == 2
+        assert ct.model.flops_per_s == pytest.approx(7e9)
+
+    def test_two_phase_stage_adopt(self):
+        ct = CostTruth(
+            CostTruthConfig(), model=CalibratedCostModel(flops_per_s=1e9)
+        )
+        m2 = CalibratedCostModel(flops_per_s=2e9)
+        assert ct.stage(2, m2, origin="registry")
+        assert not ct.stage(2, CalibratedCostModel(flops_per_s=9e9))
+        assert not ct.stage(1, m2)  # not newer than current
+        assert ct.model.flops_per_s == pytest.approx(1e9)  # not yet adopted
+        assert ct.adopt_pending() == (2, m2)
+        assert ct.adopt_pending() is None
+        stats = ct.stats()
+        assert stats["model_version"] == 2
+        assert stats["counts"]["model_adoptions"] == 1
+
+    def test_refit_cooldown_and_rejection_counting(self):
+        clock = SimpleNamespace(t=100.0)
+        ct = CostTruth(
+            _ctl_config(refit_cooldown_s=10.0),
+            model=CalibratedCostModel(flops_per_s=1e9),
+            clock=lambda: clock.t,
+        )
+        # too few samples: the epoch runs (first call is past the
+        # cooldown) and is rejected
+        assert ct.maybe_refit(trigger="drift") is False
+        assert ct.stats()["counts"]["refit_rejected"] == 1
+        # inside the cooldown the epoch does not even run
+        clock.t += 1.0
+        assert ct.maybe_refit(trigger="drift") is False
+        assert ct.stats()["counts"]["refit_rejected"] == 1
+        # past the cooldown, with real samples 2x off the model: a new
+        # generation is staged for batch-boundary adoption
+        clock.t += 10.0
+        for i in range(6):
+            ct.observe_dispatch(
+                "amplitude", 1, dur_s=(i + 1) * 1e9 / 5e8,
+                flops=(i + 1) * 1e9, steps=1,
+            )
+        assert ct.maybe_refit(trigger="drift") is True
+        assert ct.stats()["counts"]["refits"] == 1
+        assert ct.stats()["pending_version"] == 2
+        version, model = ct.adopt_pending()
+        assert version == 2
+        # clamped one step toward the 5e8 truth
+        assert model.flops_per_s == pytest.approx(1e9 / 1.5, rel=0.05)
+
+    def test_rollback_handshake_fires_once_and_pins(self):
+        ct = CostTruth(
+            _ctl_config(), model=CalibratedCostModel(flops_per_s=1e9)
+        )
+        prior = object()
+        assert ct.arm_swap_watch("k", prior, "badsig", baseline_s=0.01)
+        assert ct.stats()["counts"]["rollback_watches"] == 1
+        # unrelated plan keys never feed the watch
+        assert ct.observe_dispatch("amplitude", 1, 0.5, plan_key="other") is None
+        assert ct.observe_dispatch("amplitude", 1, 0.5, plan_key="k") == "rollback"
+        # the verdict is consumed: no second rollback for the same swap
+        assert ct.observe_dispatch("amplitude", 1, 0.5, plan_key="k") is None
+        assert ct.take_rollback() is prior
+        assert ct.take_rollback() is None
+        assert ct.is_pinned("badsig")
+        assert not ct.is_pinned("goodsig")
+        stats = ct.stats()
+        assert stats["counts"]["rollbacks"] == 1
+        assert stats["counts"]["rollback_pinned"] == 1
+        assert stats["pinned_plans"] == 1
+        assert stats["last_rollback"]["baseline_s"] == pytest.approx(0.01)
+        # the rollback adoption itself is never watched (else the
+        # restored plan could "regress" against its own baseline)...
+        assert not ct.arm_swap_watch("k2", object(), "s2", baseline_s=0.01)
+        # ...but the next ordinary swap is
+        assert ct.arm_swap_watch("k3", object(), "s3", baseline_s=0.01)
+
+    def test_healthy_swap_releases_watch_without_rollback(self):
+        ct = CostTruth(
+            _ctl_config(rollback_min_samples=2),
+            model=CalibratedCostModel(flops_per_s=1e9),
+        )
+        assert ct.arm_swap_watch("k", object(), "sig", baseline_s=0.01)
+        for _ in range(4):
+            assert ct.observe_dispatch(
+                "amplitude", 1, 0.009, plan_key="k"
+            ) is None
+        stats = ct.stats()
+        assert stats["swap_watch"] is None
+        assert stats["counts"]["rollbacks"] == 0
+        assert stats["pinned_plans"] == 0
+
+    def test_unwatchable_swaps_are_trusted(self):
+        ct = CostTruth(
+            _ctl_config(), model=CalibratedCostModel(flops_per_s=1e9)
+        )
+        assert not ct.arm_swap_watch("k", object(), "s", baseline_s=None)
+        assert not ct.arm_swap_watch("k", None, "s", baseline_s=0.01)
+        assert not ct.arm_swap_watch("k", object(), "s", baseline_s=0.0)
+        assert ct.stats()["counts"]["rollback_watches"] == 0
+
+    def test_kill_switch_suppresses_the_loop(self, monkeypatch):
+        monkeypatch.setenv("TNC_TPU_COST_TRUTH", "0")
+        cfg = config_from_env(_ctl_config())
+        assert cfg.enabled is False
+        ct = CostTruth(cfg, model=CalibratedCostModel(flops_per_s=1e9))
+        for _ in range(8):
+            ct.observe_dispatch("amplitude", 1, 0.01, flops=1e9)
+        assert ct.stats()["counts"]["samples"] == 0
+        assert ct.stats()["sampler"]["offered"] == 0
+        assert ct.maybe_refit() is False
+        monkeypatch.delenv("TNC_TPU_COST_TRUTH")
+        assert config_from_env(_ctl_config()).enabled is True
+
+
+# -- drift-unstable exclusion ---------------------------------------------
+
+
+class TestDriftExclusion:
+    def test_engine_counts_excluded_buckets(self):
+        eng = SLOEngine(SLOConfig(
+            objectives=(LatencyObjective("*", 0.1, target=0.9),),
+            windows=(BurnWindow(60.0, 300.0, 2.0),),
+        ))
+        for _ in range(3):
+            eng.record_dispatch_excluded("sample/b1")
+        eng.record_dispatch_excluded("expectation/b1")
+        stats = eng.stats()
+        assert stats["drift_excluded"] == {
+            "sample/b1": 3, "expectation/b1": 1,
+        }
+        assert stats["drift"] == {}  # nothing leaked into the detector
+
+    def test_sample_queries_are_excluded_from_drift(self):
+        """Self-normalizing query types (drift_stable=False handlers)
+        must land in the excluded counts, never the drift detector —
+        their measured seconds have no stable relation to the priced
+        amplitude work."""
+        from tests.test_serve import make_circuit
+        from tnc_tpu.serve import ContractionService
+
+        cfg = SLOConfig(
+            objectives=(LatencyObjective("*", 5.0, target=0.9),),
+            windows=(BurnWindow(30.0, 120.0, 2.0),),
+            drift_threshold=3.0,
+            drift_min_samples=2,
+            drift_baseline_samples=3,
+        )
+        with ContractionService.from_circuit(
+            make_circuit(n=4, depth=2, seed=3), queries=True, slo=cfg
+        ) as svc:
+            for i in range(3):
+                svc.sample(2, seed=i)
+            for _ in range(3):
+                svc.amplitude("0000")
+            slo = svc.stats()["slo"]
+        excluded = slo["drift_excluded"]
+        assert any(b.startswith("sample/") for b in excluded)
+        assert sum(excluded.values()) >= 3
+        assert not any(b.startswith("sample/") for b in slo["drift"])
+        assert not any(b.startswith("amplitude/") for b in excluded)
+
+
+# -- replanner plumbing ----------------------------------------------------
+
+
+class TestReplannerMeasuredIncumbent:
+    def _replanner(self, service, cost_model):
+        from tnc_tpu.contractionpath.paths import Greedy, OptMethod
+        from tnc_tpu.serve.replan import BackgroundReplanner
+
+        return BackgroundReplanner(
+            service, None,
+            optimizer=Greedy(OptMethod.GREEDY),
+            cost_model=cost_model,
+        )
+
+    def test_measured_incumbent_requires_seconds_objective(self):
+        svc = SimpleNamespace(measured_plan_seconds=lambda: 0.005)
+        rp = self._replanner(svc, CalibratedCostModel(flops_per_s=1e9))
+        assert rp.measured_incumbent() == pytest.approx(0.005)
+        # flops objective: measured seconds are not comparable
+        rp = self._replanner(svc, None)
+        assert rp.measured_incumbent() is None
+
+    def test_measured_incumbent_cold_scoreboard(self):
+        svc = SimpleNamespace(measured_plan_seconds=lambda: None)
+        rp = self._replanner(svc, CalibratedCostModel(flops_per_s=1e9))
+        assert rp.measured_incumbent() is None
+
+    def test_adopt_cost_model_reprices_and_reopens(self):
+        from tnc_tpu.serve.replan import CalibratedObjective
+
+        svc = SimpleNamespace(measured_plan_seconds=lambda: None)
+        rp = self._replanner(svc, CalibratedCostModel(flops_per_s=1e9))
+        rp._done_keys.add("settled")
+        m2 = CalibratedCostModel(flops_per_s=2e9)
+        rp.adopt_cost_model(m2)
+        assert rp.cost_model is m2
+        assert isinstance(rp.objective, CalibratedObjective)
+        assert rp.objective.cost_model is m2
+        assert rp._done_keys == set()
+        # a flops-objective replanner never consumed the model: no-op
+        rp2 = self._replanner(svc, None)
+        rp2._done_keys.add("settled")
+        rp2.adopt_cost_model(m2)
+        assert rp2.cost_model is None
+        assert rp2._done_keys == {"settled"}
+
+
+# -- perf gate: calibration freshness + fleet version skew -----------------
+
+
+def _gate_record(value=0.01, **over):
+    rec = {
+        "metric": "wall_s", "value": value,
+        "rep_stats": {"count": 3, "min_s": value * 0.98,
+                      "max_s": value * 1.02, "mean_s": value},
+        "calibration": {"flops_per_s": 1e9},
+    }
+    rec.update(over)
+    return rec
+
+
+class TestPerfGateCalibration:
+    def test_stale_offline_calibration_warns(self):
+        gate = _script("perf_gate")
+        now = 1.7e9
+        base = _gate_record()
+        cand = _gate_record(
+            written_unix=now,
+            calibration={"flops_per_s": 1e9, "fitted_unix": now - 3 * 86400},
+        )
+        code, msgs = gate.compare(base, cand)
+        assert code == 0  # warn-only: freshness never fails the gate
+        (msg,) = [m for m in msgs if "stale" in m]
+        assert "calibration model is stale" in msg
+        assert "72.0h" in msg
+
+    def test_stale_serving_calibration_warns(self):
+        gate = _script("perf_gate")
+        now = 1.7e9
+        cand = _gate_record(
+            written_unix=now,
+            serving={"calibration": {"fitted_unix": now - 2 * 86400}},
+        )
+        code, msgs = gate.compare(_gate_record(), cand)
+        assert code == 0
+        assert any("serving.calibration model is stale" in m for m in msgs)
+
+    def test_fresh_model_and_disabled_horizon_stay_quiet(self):
+        gate = _script("perf_gate")
+        now = 1.7e9
+        fresh = _gate_record(
+            written_unix=now,
+            calibration={"flops_per_s": 1e9, "fitted_unix": now - 3600},
+        )
+        _, msgs = gate.compare(_gate_record(), fresh)
+        assert not any("stale" in m for m in msgs)
+        stale = _gate_record(
+            written_unix=now,
+            calibration={"flops_per_s": 1e9, "fitted_unix": now - 3 * 86400},
+        )
+        _, msgs = gate.compare(
+            _gate_record(), stale, calibration_horizon_s=0.0
+        )
+        assert not any("stale" in m for m in msgs)
+
+    def test_fleet_model_version_skew_warns(self):
+        gate = _script("perf_gate")
+        cand = _gate_record(serving={"fleet": {"model_versions": [3, 3, 2]}})
+        code, msgs = gate.compare(_gate_record(), cand)
+        assert code == 0
+        (msg,) = [m for m in msgs if "cost-model version" in m]
+        assert "disagree" in msg and "[2, 3]" in msg
+        # a converged fleet is quiet
+        cand = _gate_record(serving={"fleet": {"model_versions": [3, 3, 3]}})
+        _, msgs = gate.compare(_gate_record(), cand)
+        assert not any("disagree" in m for m in msgs)
+
+
+# -- serve_top fleet columns ----------------------------------------------
+
+
+class TestServeTopFleetColumns:
+    def test_model_and_drift_columns_render(self):
+        serve_top = _script("serve_top")
+        sources = [
+            {"name": "replica-a", "state": "ok", "url": None, "age_s": 1.2,
+             "payload": {"queue_depth": 0, "slo_alerts": 0,
+                         "model_version": 3, "drift_ratio": 1.25}},
+            {"name": "replica-b", "state": "ok", "url": None, "age_s": 0.4,
+             "payload": {"queue_depth": 2, "slo_alerts": 1}},
+        ]
+        frame, _ = serve_top.render_fleet_frame(sources, None, 0.0)
+        head, row_a, row_b = frame.splitlines()[1], *frame.splitlines()[3:5]
+        assert "model" in head and "drift" in head
+        assert "v3" in row_a and "1.25" in row_a
+        # a replica without cost-truth renders placeholders, not zeros
+        # (a v0 would read as "ancient model" on the ops view)
+        assert " - " in row_b and "v0" not in row_b
+
+
+# -- flight-recorder annotation -------------------------------------------
+
+
+class TestFlightAnnotation:
+    def test_model_version_rides_the_flight_context(self):
+        obs.set_flight_annotation(model_version=7)
+        try:
+            assert obs.flight_annotations()["model_version"] == 7
+            obs.set_flight_annotation(model_version=8)
+            assert obs.flight_annotations()["model_version"] == 8
+        finally:
+            obs.set_flight_annotation(model_version=None)
+        assert "model_version" not in obs.flight_annotations()

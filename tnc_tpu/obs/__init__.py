@@ -57,6 +57,15 @@ from tnc_tpu.obs.slo import (  # noqa: F401
     SLOConfig,
     SLOEngine,
 )
+from tnc_tpu.obs.cost_truth import (  # noqa: F401
+    CostTruth,
+    CostTruthConfig,
+    ModelRegistry,
+    ModelRegistryWatcher,
+    PlanScoreboard,
+    ProductionSampler,
+    refit_model,
+)
 # the HTTP endpoint layer re-exports lazily (PEP 562): `from tnc_tpu
 # import obs` happens in every module of the library, and only
 # telemetry-serving processes should pay the http.server import
@@ -78,11 +87,13 @@ _FLEET_EXPORTS = (
     "adopt_trace_context",
     "current_dispatch_context",
     "dispatch_context",
+    "flight_annotations",
     "flight_recorder",
     "maybe_flight_recorder",
     "merge_fleet_metrics",
     "replica_identity",
     "replica_name",
+    "set_flight_annotation",
 )
 
 

@@ -36,6 +36,7 @@ the two ledgers meet:
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import Iterable, Sequence
 
@@ -299,6 +300,10 @@ def calibration_report(
         "dispatch_overhead_s": float(f"{model.dispatch_s:.4e}"),
         "fit_terms": list(model.terms),
         "n_samples": model.n_samples,
+        # when the constants were fit: the staleness anchor
+        # scripts/perf_gate.py warns on (a record whose calibration is
+        # much older than the record was measured under drifted truth)
+        "fitted_unix": time.time(),
     }
     report.update(error_report(samples, model, top=top))
     return report

@@ -709,6 +709,30 @@ class FleetAggregator:
 
 # -- crash flight recorder ----------------------------------------------
 
+#: process-wide context stamped into every flight-recorder dump (the
+#: ``context`` key): durable facts a postmortem needs that no span
+#: carries — e.g. the cost-model generation that was pricing traffic at
+#: crash time (``model_version``, set by the serving layer on adoption)
+_flight_annotations: dict = {}
+_flight_annotations_lock = threading.Lock()
+
+
+def set_flight_annotation(**kwargs) -> None:
+    """Merge key/value context into future flight-recorder dumps.
+    Values must be JSON-serializable scalars; ``None`` deletes a key."""
+    with _flight_annotations_lock:
+        for key, value in kwargs.items():
+            if value is None:
+                _flight_annotations.pop(key, None)
+            else:
+                _flight_annotations[key] = value
+
+
+def flight_annotations() -> dict:
+    """The current annotation context (a copy)."""
+    with _flight_annotations_lock:
+        return dict(_flight_annotations)
+
 
 class FlightRecorder:
     """Postmortem span ring: keeps the last ``capacity`` closed spans
@@ -804,6 +828,7 @@ class FlightRecorder:
                         for k, v in reg.gauges().items()
                     },
                     "dropped_spans": reg.dropped_spans(),
+                    "context": flight_annotations(),
                 }
                 tmp = self.path.with_name(
                     f"{self.path.name}.{os.getpid()}."

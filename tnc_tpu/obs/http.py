@@ -227,6 +227,10 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 body = json.dumps(srv.fleet()).encode("utf-8")
                 ctype = "application/json"
                 status = 200
+            elif path == "/calibration":
+                body = json.dumps(srv.calibration()).encode("utf-8")
+                ctype = "application/json"
+                status = 200
             else:
                 body = b'{"error": "not found"}'
                 ctype = "application/json"
@@ -259,7 +263,11 @@ class TelemetryServer:
     - ``slo_fn() -> dict`` — the ``/slo`` JSON body;
     - ``fleet_fn() -> dict`` — the ``/fleet`` JSON body (the federated
       cross-replica view, usually a
-      :meth:`~tnc_tpu.obs.fleet.FleetAggregator.snapshot`).
+      :meth:`~tnc_tpu.obs.fleet.FleetAggregator.snapshot`);
+    - ``calibration_fn() -> dict`` — the ``/calibration`` JSON body
+      (the cost-truth loop's state: live model generation, sampler
+      fill, refit ledger, plan scoreboard; see
+      :mod:`tnc_tpu.obs.cost_truth`).
 
     ``base_labels`` stamps every ``/metrics`` series (fleet replicas
     pass ``{"replica": "p<idx>"}`` so scrapes stay distinguishable
@@ -286,6 +294,7 @@ class TelemetryServer:
         extra_metrics_fn: Callable[[], Iterable[Sample]] | None = None,
         fleet_fn: Callable[[], dict] | None = None,
         base_labels: dict | None = None,
+        calibration_fn: Callable[[], dict] | None = None,
     ):
         self.registry = registry
         self.host = host
@@ -294,6 +303,7 @@ class TelemetryServer:
         self.slo_fn = slo_fn
         self.extra_metrics_fn = extra_metrics_fn
         self.fleet_fn = fleet_fn
+        self.calibration_fn = calibration_fn
         self.base_labels = dict(base_labels) if base_labels else None
         self._httpd: http.server.ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
@@ -316,6 +326,12 @@ class TelemetryServer:
 
     def fleet(self) -> dict:
         return self.fleet_fn() if self.fleet_fn else {"enabled": False}
+
+    def calibration(self) -> dict:
+        return (
+            self.calibration_fn() if self.calibration_fn
+            else {"enabled": False}
+        )
 
     # -- lifecycle -------------------------------------------------------
 

@@ -257,12 +257,18 @@ class DriftDetector:
         }
 
     def stats(self) -> dict[str, dict]:
+        """Per-bucket state rows. ``n``/``ewma``/``baseline`` expose the
+        sample counts and EWMA state ``min_samples``/``baseline_samples``
+        tuning needs to be observable; ``calibrated`` says whether the
+        bucket tracks measured/predicted ratios or raw seconds."""
         return {
             name: {
                 "ratio": round(self._normalized(b), 4),
                 "ewma": round(b.ewma, 6),
                 "baseline": round(b.baseline, 6),
                 "n": b.n,
+                "calibrated": b.calibrated,
+                "baseline_done": b.baseline_done,
                 "alerting": self._bucket_alerting(b),
             }
             for name, b in self._buckets.items()
@@ -297,6 +303,12 @@ class SLOEngine:
         )
         self._active: dict[str, dict] = {}
         self._alerts_total = 0
+        # dispatches the drift detector never saw, per bucket: kinds
+        # whose handlers declare drift_stable=False are excluded from
+        # drift (their per-bucket seconds are not comparable), but the
+        # excluded volume must stay visible or min_requests tuning
+        # reads "no drift" as "no traffic"
+        self._drift_excluded: dict[str, int] = {}
         self._horizon = max(
             (w.long_s for w in self.config.windows), default=0.0
         )
@@ -339,6 +351,15 @@ class SLOEngine:
         drift then tracks raw measured seconds per bucket)."""
         with self._lock:
             self.drift.update(bucket, predicted_s, measured_s)
+
+    def record_dispatch_excluded(self, bucket: str) -> None:
+        """One dispatch of a payload-variant (``drift_stable=False``)
+        kind, deliberately NOT fed to the drift detector — counted per
+        bucket so the exclusion is observable instead of silent."""
+        with self._lock:
+            self._drift_excluded[bucket] = (
+                self._drift_excluded.get(bucket, 0) + 1
+            )
 
     def _prune(self, now: float) -> None:
         horizon = self._horizon
@@ -472,10 +493,12 @@ class SLOEngine:
         with self._lock:
             outcomes = dict(self._outcome_counts)
             drift = self.drift.stats()
+            excluded = dict(self._drift_excluded)
             total = self._alerts_total
         return {
             "objectives": burn_rows,
             "drift": drift,
+            "drift_excluded": excluded,
             "alerts": alerts,
             "alerts_total": total,
             "outcomes": outcomes,

@@ -293,7 +293,9 @@ class BackgroundReplanner:
         # digest every tick just to find the key in _done_keys
         self._keyed_bound = None
         self._keyed_key: str | None = None
-        self.stats = {"attempts": 0, "swaps": 0, "rejects": 0}
+        self.stats = {
+            "attempts": 0, "swaps": 0, "rejects": 0, "measured_margins": 0,
+        }
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -319,6 +321,34 @@ class BackgroundReplanner:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+    # -- cost-truth integration --------------------------------------------
+
+    def measured_incumbent(self) -> float | None:
+        """The serving plan's measured mean dispatch seconds from the
+        cost-truth scoreboard — the margin's incumbent cost when warm.
+        None without a seconds objective (measured seconds are not
+        comparable to a flops objective), without cost-truth, or while
+        the scoreboard row is cold."""
+        if self.cost_model is None:
+            return None
+        fn = getattr(self.service, "measured_plan_seconds", None)
+        return fn() if fn is not None else None
+
+    def adopt_cost_model(self, model) -> None:
+        """Adopt a new cost-model generation (the service calls this at
+        the batch boundary where it adopts one): the seconds objective
+        re-prices under the new constants, and settled per-structure
+        verdicts re-open — a plan rejected under stale pricing may win
+        under the truth. No-op for a flops-objective replanner (its
+        decisions never consumed the model)."""
+        if model is None or self.cost_model is None:
+            return
+        self.cost_model = model
+        self.objective = CalibratedObjective(model)
+        if self._default_optimizer and hasattr(self.optimizer, "objective"):
+            self.optimizer.objective = self.objective
+        self._done_keys.clear()
 
     # -- worker ------------------------------------------------------------
 
@@ -412,6 +442,17 @@ class BackgroundReplanner:
             leaves, incumbent_path.toplevel, incumbent_slicing,
             self.objective,
         )
+        # cost-truth scoreboard: when the incumbent's MEASURED dispatch
+        # seconds are warm, the margin compares against reality instead
+        # of the prediction — a plan that predicts well but measures
+        # badly becomes beatable. Seconds-objective only (a measured
+        # second cannot be compared against a flop count); cold
+        # scoreboard falls back to the prediction.
+        measured = self.measured_incumbent()
+        if measured is not None:
+            incumbent_cost = measured
+            self.stats["measured_margins"] += 1
+            obs.counter_add("serve.replan.measured_margin")
 
         if not candidate_cost < self.margin * incumbent_cost:
             self.stats["rejects"] += 1

@@ -77,7 +77,12 @@ from tnc_tpu.obs.core import QuantileSummary
 from tnc_tpu.ops.backends import JaxBackend
 from tnc_tpu.resilience import retry as _retry
 from tnc_tpu.resilience.faultinject import fault_point
-from tnc_tpu.serve.rebind import BoundProgram, bind_circuit, pow2_bucket
+from tnc_tpu.serve.rebind import (
+    BoundProgram,
+    bind_circuit,
+    plan_signature,
+    pow2_bucket,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -258,6 +263,14 @@ class ContractionService:
         self._fleet_aggregator = None
         self._slo = None
         self._slo_last_check = 0.0
+        # cost-truth plane (enable_cost_truth): production sampling,
+        # drift-triggered refits, versioned model adoption, the plan
+        # scoreboard and the post-swap rollback watch
+        self._cost_truth = None
+        # per-bound derived constants (program flops/bytes/steps, plan
+        # key + signature), memoized by bound identity: computed once
+        # per adopted plan, never per dispatch
+        self._bound_profiles: dict[int, dict] = {}
         # elastic plane (enable_elastic): tenant/priority scheduling
         # config, advisory scale controller, preemption state (the
         # priority of the batch currently dispatching, and a recursion
@@ -289,6 +302,8 @@ class ContractionService:
         fleet_dir: str | None = None,
         fleet_endpoints=None,
         fleet_heartbeat_s: float = 2.0,
+        cost_truth: bool = False,
+        cost_truth_options: dict | None = None,
         **kwargs,
     ) -> "ContractionService":
         """Build (plan/compile once, plan cache honored) and start.
@@ -328,7 +343,14 @@ class ContractionService:
         :class:`~tnc_tpu.serve.replan.SharedCacheWatcher`: a replica
         deployment sharing one cache directory adopts OTHER replicas'
         published plans (including their background replanner's swaps)
-        at batch boundaries. ``watch_options`` are its kwargs."""
+        at batch boundaries. ``watch_options`` are its kwargs.
+
+        ``cost_truth=True`` turns on the cost-truth loop
+        (:meth:`enable_cost_truth`): production dispatch sampling,
+        drift-triggered cost-model refits, versioned model adoption
+        and the plan scoreboard + post-swap rollback watch.
+        ``cost_truth_options`` are its kwargs (notably ``registry=`` —
+        a shared model-registry directory for fleet-wide adoption)."""
         if background_replan and plan_cache is None:
             raise ValueError("background_replan requires a plan_cache")
         if shared_cache_watch and plan_cache is None:
@@ -366,6 +388,8 @@ class ContractionService:
                 )
                 svc._watchers.append(watcher)
                 watcher.start()
+            if cost_truth or cost_truth_options:
+                svc.enable_cost_truth(**(cost_truth_options or {}))
             if telemetry_port is not None:
                 svc.serve_telemetry(port=telemetry_port)
             if fleet_dir is not None or fleet_endpoints:
@@ -459,16 +483,39 @@ class ContractionService:
 
     def _current_bound(self) -> BoundProgram:
         """The bound to dispatch the NEXT batch under, adopting any
-        staged replacement first."""
+        staged replacement (and any staged cost-model generation)
+        first — the one boundary where swaps become visible, so no
+        batch ever mixes plans or model versions."""
+        ct = self._cost_truth
+        refused = prior = None
         with self._lock:
             pending, self._pending_bound = self._pending_bound, None
             if pending is not None:
-                self.bound = pending
-                self._counts["plan_swaps"] += 1
-                self._generation += 1
+                if ct is not None and ct.is_pinned(plan_signature(pending)):
+                    # a rolled-back plan staged again (shared-cache
+                    # watcher, replanner re-run): the stager cannot
+                    # know it regressed here — refuse, keep serving
+                    refused, pending = pending, None
+                else:
+                    prior = self.bound
+                    self.bound = pending
+                    self._counts["plan_swaps"] += 1
+                    self._generation += 1
+        if refused is not None:
+            ct.count("pin_refusals")
+            obs.counter_add("serve.cost_truth.pin_refused")
+            logger.warning(
+                "refused adoption of a regression-pinned plan"
+            )
         if pending is not None:
             obs.counter_add("serve.replan.adopted")
             logger.info("adopted replanned program for serving")
+            if ct is not None:
+                self._arm_swap_watch(pending, prior)
+        if ct is not None:
+            adopted = ct.adopt_pending()
+            if adopted is not None:
+                self._adopt_cost_model(*adopted)
         return self.bound
 
     def attach_slo(self, slo) -> "ContractionService":
@@ -1090,6 +1137,7 @@ class ContractionService:
                 batch=len(group), kind=kind, riders=riders,
                 generation=generation,
                 collapsed=len(group) - len(payloads),
+                **self._span_model(),
             ):
                 results = self.retry_policy.run(
                     lambda: self._dispatch_group(kind, payloads, bound),
@@ -1115,6 +1163,7 @@ class ContractionService:
         dispatch_s = done - t0
         self._note_dispatch(kind, dispatch_s)
         self._slo_dispatch(kind, len(group), dispatch_s, bound)
+        self._cost_truth_dispatch(kind, len(group), dispatch_s, bound)
         for req, result in zip(group, results):
             if self._complete(req, result=result):
                 self._finish(
@@ -1143,6 +1192,7 @@ class ContractionService:
                     "serve.dispatch",
                     batch=1, kind=req.kind, riders=f"r{req.rid}",
                     generation=generation, degraded=1,
+                    **self._span_model(),
                 ):
                     results = self._dispatch_group(req.kind, [req.bits], bound)
             except Exception as exc:  # noqa: BLE001 — per-request verdict
@@ -1159,6 +1209,7 @@ class ContractionService:
             done = time.monotonic()
             self._note_dispatch(req.kind, done - t0)
             self._slo_dispatch(req.kind, 1, done - t0, bound)
+            self._cost_truth_dispatch(req.kind, 1, done - t0, bound)
             if self._complete(req, result=results[0]):
                 self._finish(
                     req, done, dispatch_s=done - t0, riders=1,
@@ -1270,10 +1321,16 @@ class ContractionService:
         manufacture drift out of workload mix."""
         if self._slo is None:
             return
+        bucket = f"{kind}/b{batch_bucket(batch)}"
         handler = self._handlers.get(kind)
         if handler is not None and not getattr(handler, "drift_stable", True):
+            # excluded, but COUNTED: the /slo surface must show how
+            # much traffic the detector deliberately never sees, or
+            # "no drift buckets" is indistinguishable from "no traffic"
+            exclude = getattr(self._slo, "record_dispatch_excluded", None)
+            if exclude is not None:
+                exclude(bucket)
             return
-        bucket = f"{kind}/b{batch_bucket(batch)}"
         self._slo.record_dispatch(
             bucket, self._predict_dispatch_s(kind, bound), measured_s
         )
@@ -1285,11 +1342,9 @@ class ContractionService:
         if self.cost_model is None or kind != "amplitude":
             return None
         try:
-            from tnc_tpu.ops.program import steps_flops
-
-            steps = bound.program.steps
+            prof = self._bound_profile(bound)
             return self.cost_model.op_seconds(
-                steps_flops(steps), dispatches=max(len(steps), 1)
+                prof["flops"], dispatches=prof["steps"]
             )
         except Exception:  # noqa: BLE001 — prediction is best-effort
             return None
@@ -1306,7 +1361,219 @@ class ContractionService:
         if now - self._slo_last_check < self._SLO_CHECK_INTERVAL_S:
             return
         self._slo_last_check = now
-        self._slo.check()
+        alerts = self._slo.check()
+        if self._cost_truth is not None and any(
+            a.get("kind") == "drift" for a in alerts
+        ):
+            # the drift alert IS the refit trigger: reality diverged
+            # from the model, so re-learn the constants from sampled
+            # production traffic instead of waiting for a human (the
+            # refit's own cooldown/hysteresis bounds the reaction)
+            self._cost_truth.maybe_refit(trigger="drift")
+
+    # -- cost-truth loop (production calibration) --------------------------
+
+    def enable_cost_truth(
+        self,
+        registry=None,
+        config=None,
+        watch: bool = True,
+        poll_interval_s: float = 0.25,
+    ) -> "ContractionService":
+        """Turn on the cost-truth loop: amplitude dispatches are
+        reservoir-sampled by (kind × batch bucket), a drift alert
+        triggers a hysteresis-bounded refit of the
+        ``time ≈ flops/F + bytes/B + c`` model, accepted fits are
+        published as versioned generations, and every pricing surface
+        (drift predictions, replanner objective, router quotes) adopts
+        a generation only at batch boundaries. A plan scoreboard keyed
+        by plan-cache key records measured vs predicted dispatch
+        seconds; a freshly swapped plan that measures worse than the
+        incumbent's baseline beyond tolerance auto-rolls back
+        (:mod:`tnc_tpu.obs.cost_truth`).
+
+        ``registry`` — a :class:`~tnc_tpu.obs.cost_truth.ModelRegistry`
+        or a directory path for one; replicas sharing the directory
+        converge on one model generation (``watch=True`` polls it every
+        ``poll_interval_s`` seconds, the ``SharedCacheWatcher`` path).
+        Without a registry, versions are in-process only. ``config`` —
+        a :class:`~tnc_tpu.obs.cost_truth.CostTruthConfig`. The whole
+        plane is suppressible with ``TNC_TPU_COST_TRUTH=0``."""
+        from tnc_tpu.obs import cost_truth as _ct
+
+        cfg = _ct.config_from_env(config)
+        if registry is not None and not isinstance(
+            registry, _ct.ModelRegistry
+        ):
+            registry = _ct.ModelRegistry(registry)
+        ct = _ct.CostTruth(cfg, model=self.cost_model, registry=registry)
+        self._cost_truth = ct
+        if ct.model is not None and ct.model is not self.cost_model:
+            # the registry's current generation outranks the
+            # constructor's offline constants: the fleet's source of
+            # truth prices this replica from the first dispatch
+            self._adopt_cost_model(ct.model_version, ct.model)
+        elif ct.model_version:
+            _fleet.set_flight_annotation(model_version=ct.model_version)
+        if watch and registry is not None and cfg.enabled:
+            watcher = _ct.ModelRegistryWatcher(
+                self, registry, poll_interval_s=poll_interval_s
+            )
+            self._watchers.append(watcher)
+            watcher.start()
+        return self
+
+    def _bound_profile(self, bound: BoundProgram) -> dict:
+        """Derived per-bound constants (program flops/bytes/step count,
+        plan-cache key, plan signature, scoreboard key), memoized by
+        bound identity so the hot path never recomputes them per
+        dispatch. Safe from any thread (atomic dict ops; a lost race
+        costs one recompute)."""
+        prof = self._bound_profiles.get(id(bound))
+        if prof is not None and prof["bound"] is bound:
+            return prof
+        from tnc_tpu.ops.program import steps_bytes, steps_flops
+        from tnc_tpu.serve.plancache import network_structure_digest
+
+        steps = bound.program.steps
+        cache_key = network_structure_digest(
+            bound.template.network, bound.target_size
+        )
+        sig = plan_signature(bound)
+        prof = {
+            "bound": bound,
+            "flops": float(steps_flops(steps)),
+            "bytes": float(steps_bytes(steps)),
+            "steps": max(len(steps), 1),
+            "cache_key": cache_key,
+            "sig": sig,
+            # scoreboard rows are per PLAN: the cache key names the
+            # structure, the signature the specific plan serving it —
+            # so an adopted swap scores separately from its incumbent
+            "score_key": f"{cache_key}:{sig[:12]}",
+        }
+        if len(self._bound_profiles) >= 8:
+            self._bound_profiles.clear()
+        self._bound_profiles[id(bound)] = prof
+        return prof
+
+    def _cost_truth_dispatch(
+        self, kind: str, batch: int, dispatch_s: float, bound: BoundProgram
+    ) -> None:
+        """Feed the cost-truth plane one measured dispatch (sampler +
+        scoreboard + the post-swap regression watch), and restage the
+        prior plan when the watch's verdict is a regression. Amplitude
+        dispatches only — the one kind whose program flops the service
+        can see, the same reason ``_predict_dispatch_s`` is
+        amplitude-only."""
+        ct = self._cost_truth
+        if ct is None or kind != "amplitude":
+            return
+        try:
+            prof = self._bound_profile(bound)
+        except Exception:  # noqa: BLE001 — observability must not fail serving
+            return
+        verdict = ct.observe_dispatch(
+            kind, batch, dispatch_s,
+            flops=prof["flops"], nbytes=prof["bytes"], steps=prof["steps"],
+            plan_key=prof["score_key"],
+            predicted_s=self._predict_dispatch_s(kind, bound),
+        )
+        if verdict == "rollback":
+            self._rollback_plan(prof)
+
+    def _rollback_plan(self, prof: dict) -> None:
+        """Auto-rollback: the adopted plan's measured cost regressed
+        beyond tolerance inside its watch window — restage the prior
+        bound (adopted at the next batch boundary, like any swap) and
+        pin the regressed plan's signature against re-adoption."""
+        ct = self._cost_truth
+        prior = ct.take_rollback()
+        if prior is None:
+            return
+        with self._lock:
+            self._pending_bound = prior
+        obs.counter_add("serve.cost_truth.rollback")
+        # rollbacks are incidents, not bookkeeping: ride the same alert
+        # counter family the SLO engine fires so dashboards see them
+        obs.counter_add("slo.alerts", kind="plan_rollback")
+        logger.warning(
+            "plan %s rolled back: measured dispatch seconds regressed "
+            "past %.2fx its pre-swap baseline (%s)",
+            prof["score_key"][:20], ct.config.rollback_tolerance,
+            ct.last_rollback,
+        )
+
+    def _arm_swap_watch(
+        self, new_bound: BoundProgram, prior_bound: BoundProgram | None
+    ) -> None:
+        """Start the regression watch for a just-adopted plan swap. The
+        baseline is the incumbent's MEASURED seconds when the
+        scoreboard is warm, its calibrated prediction otherwise; with
+        neither the swap is unwatchable and simply trusted."""
+        ct = self._cost_truth
+        if ct is None or prior_bound is None:
+            return
+        try:
+            prior_prof = self._bound_profile(prior_bound)
+            new_prof = self._bound_profile(new_bound)
+        except Exception:  # noqa: BLE001 — watch arming is best-effort
+            return
+        baseline = ct.scoreboard.measured_seconds(
+            prior_prof["score_key"],
+            min_samples=ct.config.scoreboard_min_samples,
+        )
+        if baseline is None and self.cost_model is not None:
+            baseline = self.cost_model.op_seconds(
+                prior_prof["flops"], dispatches=prior_prof["steps"]
+            )
+        if ct.arm_swap_watch(
+            new_prof["score_key"], prior_bound, new_prof["sig"], baseline
+        ):
+            obs.counter_add("serve.cost_truth.swap_watch")
+
+    def _adopt_cost_model(self, version: int, model) -> None:
+        """A staged model generation becomes the one every pricing
+        surface reads: the service's own drift predictions and quotes,
+        the FidelityRouter's rung pricing, the background replanner's
+        seconds objective — one auditable generation, adopted at a
+        batch boundary, stamped on spans and flight recordings."""
+        self.cost_model = model
+        if self._router is not None:
+            self._router.cost_model = model
+        replanner = self._replanner
+        if replanner is not None:
+            adopt = getattr(replanner, "adopt_cost_model", None)
+            if adopt is not None:
+                adopt(model)
+        _fleet.set_flight_annotation(model_version=version)
+        obs.counter_add("serve.cost_truth.model_adopted")
+        logger.info(
+            "adopted cost-model generation v%d (%.3e flops/s, "
+            "%.1e s/dispatch)", version, model.flops_per_s,
+            model.dispatch_s,
+        )
+
+    def measured_plan_seconds(self) -> float | None:
+        """Measured mean dispatch seconds for the CURRENT serving plan,
+        from the scoreboard (None while cold or without cost-truth) —
+        the replanner's measured-incumbent margin input."""
+        ct = self._cost_truth
+        if ct is None:
+            return None
+        try:
+            prof = self._bound_profile(self.bound)
+        except Exception:  # noqa: BLE001 — pricing input is best-effort
+            return None
+        return ct.scoreboard.measured_seconds(
+            prof["score_key"], min_samples=ct.config.scoreboard_min_samples
+        )
+
+    def _span_model(self) -> dict:
+        """Span kwargs stamping the active model generation (empty
+        without cost-truth, so existing span shapes are unchanged)."""
+        ct = self._cost_truth
+        return {} if ct is None else {"model_version": ct.model_version}
 
     # -- stats -------------------------------------------------------------
 
@@ -1476,6 +1743,8 @@ class ContractionService:
             out["plan_cache"] = self._plan_cache.stats()
         if self._slo is not None:
             out["slo"] = self._slo.stats()
+        if self._cost_truth is not None:
+            out["calibration"] = self._cost_truth.stats()
         if self._elastic is not None:
             from tnc_tpu.serve import elastic as _elastic_mod
 
@@ -1547,6 +1816,12 @@ class ContractionService:
             body["enabled"] = True
             return body
 
+        def calibration() -> dict:
+            # late-bound: enable_cost_truth may run after serve_telemetry
+            if self._cost_truth is None:
+                return {"enabled": False}
+            return self._cost_truth.stats()
+
         self._telemetry = TelemetryServer(
             registry=obs.get_registry(),
             host=host,
@@ -1555,6 +1830,7 @@ class ContractionService:
             slo_fn=slo,
             extra_metrics_fn=self._prometheus_families,
             fleet_fn=fleet,
+            calibration_fn=calibration,
         ).start()
         return self._telemetry
 
@@ -1607,6 +1883,21 @@ class ContractionService:
                     payload["drift_alerting"] = sum(
                         1 for row in drift.values()
                         if isinstance(row, dict) and row.get("alerting")
+                    )
+                    # worst live measured/predicted ratio across drift
+                    # buckets: serve_top --fleet's at-a-glance column
+                    ratios = [
+                        row["ratio"] for row in drift.values()
+                        if isinstance(row, dict)
+                        and row.get("ratio") is not None
+                    ]
+                    if ratios:
+                        payload["drift_ratio"] = round(
+                            max(ratios, key=lambda r: abs(r - 1.0)), 4
+                        )
+                if self._cost_truth is not None:
+                    payload["model_version"] = (
+                        self._cost_truth.model_version
                     )
                 if self._elastic is not None:
                     from tnc_tpu.serve import elastic as _elastic_mod
@@ -1781,6 +2072,27 @@ class ContractionService:
                     ("gauge", "serve.elastic.scale_target", {},
                      float(ctrl.last_decision.get("target", 0)))
                 )
+        ct = self._cost_truth
+        if ct is not None:
+            # cost-truth plane: the live model generation, the loop's
+            # event ledger (samples/refits/publishes/adoptions/
+            # rollbacks), and the sampler's reservoir fill — the same
+            # numbers as stats()["calibration"], so /metrics and /fleet
+            # federate them
+            cal = ct.stats()
+            fams.append(
+                ("gauge", "serve.cost_truth.model_version", {},
+                 float(cal["model_version"]))
+            )
+            for event, value in sorted(cal["counts"].items()):
+                fams.append(
+                    ("counter", "serve.cost_truth.events",
+                     {"event": event}, float(value))
+                )
+            fams.append(
+                ("gauge", "serve.cost_truth.sampler_kept", {},
+                 float(cal["sampler"]["kept"]))
+            )
         return fams
 
 
