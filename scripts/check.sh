@@ -77,6 +77,9 @@ fi
 echo "== joint planner smoke (joint tree+slice search vs post-pass on a pinned budget network) =="
 TNC_TPU_PLATFORM=cpu python scripts/joint_planner_smoke.py
 
+echo "== plansvc smoke (2-proc trial fan-out, dedupe pinned, merged best <= single-node at equal budget) =="
+TNC_TPU_PLATFORM=cpu python scripts/plansvc_smoke.py
+
 echo "== crash-resume smoke (SIGKILL mid-range, resume, compare to golden) =="
 TNC_TPU_PLATFORM=cpu python scripts/crash_resume_smoke.py
 

@@ -60,6 +60,8 @@ REQUIRED_MODULES = (
     os.path.join("tnc_tpu", "serve", "multihost.py"),
     os.path.join("tnc_tpu", "serve", "reuse.py"),
     os.path.join("tnc_tpu", "serve", "elastic.py"),
+    os.path.join("tnc_tpu", "serve", "plansvc.py"),
+    os.path.join("tnc_tpu", "contractionpath", "symbolic.py"),
 )
 
 executed: set[tuple[str, int]] = set()

@@ -83,6 +83,14 @@ SLICED_GATE_NETWORKS = {
 GATE_JOINT_SA_STEPS = 2000
 GATE_JOINT_SA_ROUNDS = 3
 
+#: pinned effort for the ``fleet_trials`` column: the planner-fleet
+#: trial grid (scripts/plansvc_smoke.py runs the same protocol) at the
+#: pod's default per-trial depth — the column compares WHERE the trials
+#: run (2 processes vs 1), not how deep they search
+FLEET_NTRIALS = 4
+FLEET_SA_STEPS = 600
+FLEET_SA_ROUNDS = 2
+
 
 def _gate_network(name: str):
     from tnc_tpu.builders.connectivity import ConnectivityLayout
@@ -286,6 +294,73 @@ def measure_sliced_gate_network(name: str) -> dict:
         "target_log2": target_log2,
         "post": plan(False),
         "joint": plan(True),
+        "fleet_trials": measure_fleet_trials(tn, target),
+    }
+
+
+def measure_fleet_trials(tn, target: float) -> dict:
+    """The planner-fleet column: the same deterministic trial grid run
+    distributed (2 standalone workers racing claims over one trial
+    board) and single-node (in-process), best-by-digest merged each
+    way. Trials are pure functions of (structure, spec), so the two
+    arms select from the identical candidate set — the gate pins
+    distributed <= single (an exact tie in practice; any gap means the
+    trial path went nondeterministic or the merge lost results)."""
+    import subprocess
+    import tempfile
+
+    from tnc_tpu.ops.program import flat_leaf_tensors
+    from tnc_tpu.serve.plansvc import (
+        TrialBoard,
+        best_plan,
+        run_trials_local,
+        seed_trials,
+    )
+
+    leaves = flat_leaf_tensors(tn)
+    specs = seed_trials(
+        FLEET_NTRIALS, seed=42,
+        sa_steps=FLEET_SA_STEPS, sa_rounds=FLEET_SA_ROUNDS,
+    )
+    t0 = time.perf_counter()
+    single = best_plan(run_trials_local(leaves, target, specs))
+    single_s = time.perf_counter() - t0
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with tempfile.TemporaryDirectory() as tmp:
+        board = TrialBoard(tmp, owner="seed")
+        board.publish_structure(leaves, target, key="fleet_trials")
+        for spec in specs:
+            board.post_trial(spec)
+        env = dict(os.environ)
+        env.setdefault("TNC_TPU_PLATFORM", "cpu")
+        t0 = time.perf_counter()
+        workers = [
+            subprocess.Popen(
+                [sys.executable, "-m", "tnc_tpu.serve.plansvc", tmp,
+                 "--owner", f"w{i}"],
+                cwd=repo, env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+            )
+            for i in range(2)
+        ]
+        for w in workers:
+            w.wait(timeout=1200)
+        distributed_s = time.perf_counter() - t0
+        results = board.results()
+        merged = best_plan(results)
+
+    inf = float("inf")
+    return {
+        "ntrials": FLEET_NTRIALS,
+        "results": len(results),
+        "single_hoisted_flops": single.cost if single else inf,
+        "distributed_hoisted_flops": merged.cost if merged else inf,
+        "digest_match": bool(
+            merged and single and merged.digest() == single.digest()
+        ),
+        "single_seconds": round(single_s, 3),
+        "distributed_seconds": round(distributed_s, 3),
     }
 
 
@@ -400,6 +475,12 @@ def compare_quality(
     network (beyond float noise), and must beat it strictly on at
     least one — otherwise making slicing a search dimension has
     silently stopped paying.
+
+    The ``fleet_trials`` column inside each sliced entry adds the
+    distributed-planning invariant: the fleet fan-out (same trial
+    budget, 2 processes) must tie or beat the single-node run on
+    hoisted sliced cost — trials are deterministic, so a loss means
+    nondeterminism or a dropped result, never "bad luck".
     """
     base_nets = base.get("gate_networks")
     fresh_nets = fresh.get("gate_networks")
@@ -500,6 +581,22 @@ def compare_quality(
                     b["joint"]["predicted_seconds"],
                     joint["predicted_seconds"],
                 )
+                bft = b.get("fleet_trials")
+                if isinstance(bft, dict):
+                    fft = f.get("fleet_trials")
+                    if not isinstance(fft, dict):
+                        # the baseline measured distributed planning;
+                        # a fresh run that silently dropped the column
+                        # must not pass by omission
+                        return 2, msgs + [
+                            "fresh record is missing the fleet_trials "
+                            f"block for {net}"
+                        ]
+                    ratio_check(
+                        net, "fleet_trials.distributed_hoisted_flops",
+                        bft["distributed_hoisted_flops"],
+                        fft["distributed_hoisted_flops"],
+                    )
             # the gated sliced totals are what the hoisting executors
             # actually pay: the hoist-aware flop total and the predicted
             # seconds — the naive num_slices x per-slice total stays a
@@ -517,6 +614,22 @@ def compare_quality(
                     )
                 if joint[metric] < post[metric]:
                     strict_win = True
+            # fleet invariant: the distributed fan-out selects from the
+            # same deterministic candidate set as a single node at the
+            # same trial budget — ties allowed, losses never
+            ft = f.get("fleet_trials")
+            if isinstance(ft, dict):
+                dist = ft["distributed_hoisted_flops"]
+                single = ft["single_hoisted_flops"]
+                if dist > single * tie:
+                    verdict = 1
+                    msgs.append(
+                        f"PLAN REGRESSION: {net} distributed fleet "
+                        f"search ({dist:.4g} hoisted flops over 2 "
+                        f"procs) lost to single-node ({single:.4g}) at "
+                        "the same trial budget — trial determinism "
+                        "broke or the merge dropped results"
+                    )
         if not strict_win:
             verdict = 1
             msgs.append(

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Planner-fleet smoke: 2-process trial fan-out on the smallest sliced
+gate network (line20_d12 at the 2^6 budget).
+
+Pins, in under ~30s of CPU:
+
+- the full board protocol across real process boundaries: a seeded
+  trial grid, two standalone workers (``python -m
+  tnc_tpu.serve.plansvc``) racing claims over the same directory,
+  every trial getting exactly one result;
+- dedupe-by-digest: re-posting the identical grid creates zero new
+  trial files, and no trial runs twice (claims + reclaims == trials);
+- the distributed merge can never lose to a single node: the merged
+  best over the fan-out equals (or beats — never trails) the best of
+  the same specs run locally at the same trial budget. Trials are
+  deterministic functions of (structure, spec), so this is an exact
+  tie by construction, and any drift means nondeterminism crept into
+  the trial path.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+NTRIALS = 4
+SEED = 42
+SA_STEPS = 150
+SA_ROUNDS = 1
+TARGET_LOG2 = 6.0
+
+
+def main() -> int:
+    from planner_quality import _gate_network
+    from tnc_tpu.ops.program import flat_leaf_tensors
+    from tnc_tpu.serve.plansvc import (
+        TrialBoard,
+        best_plan,
+        run_trials_local,
+        seed_trials,
+    )
+
+    tn = _gate_network("line20_d12")
+    leaves = flat_leaf_tensors(tn)
+    target = 2.0**TARGET_LOG2
+    specs = seed_trials(
+        NTRIALS, seed=SEED, sa_steps=SA_STEPS, sa_rounds=SA_ROUNDS
+    )
+
+    with tempfile.TemporaryDirectory() as tmp:
+        board = TrialBoard(tmp, owner="seed")
+        assert board.publish_structure(leaves, target, key="smoke")
+        posted = sum(board.post_trial(s) for s in specs)
+        assert posted == NTRIALS, f"posted {posted}/{NTRIALS}"
+        # dedupe pinned: the identical grid re-posted creates nothing
+        reposted = sum(board.post_trial(s) for s in specs)
+        assert reposted == 0, f"dedupe leak: {reposted} duplicate trials"
+        assert board.stats["dedup"] == NTRIALS
+
+        env = dict(os.environ)
+        env.setdefault("TNC_TPU_PLATFORM", "cpu")
+        workers = [
+            subprocess.Popen(
+                [sys.executable, "-m", "tnc_tpu.serve.plansvc", tmp,
+                 "--owner", f"w{i}"],
+                cwd=REPO, env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True,
+            )
+            for i in range(2)
+        ]
+        for w in workers:
+            out, _ = w.communicate(timeout=600)
+            assert w.returncode == 0, f"worker failed:\n{out}"
+
+        assert board.done(), "fan-out left pending trials"
+        results = board.results()
+        assert len(results) == NTRIALS, f"{len(results)}/{NTRIALS} results"
+        # every trial ran exactly once across the two workers: the
+        # lease protocol handed each claim to one process
+        leases = len(list(TrialBoard(tmp).directory.glob("lease-*.json")))
+        assert leases == NTRIALS, f"{leases} leases for {NTRIALS} trials"
+
+        merged = best_plan(results)
+        local = best_plan(run_trials_local(leaves, target, specs))
+        assert merged is not None and local is not None
+        print(
+            f"plansvc smoke: {NTRIALS} trials over 2 procs — merged "
+            f"best {merged.cost:.4g} (x{merged.num_slices} slices), "
+            f"single-node best {local.cost:.4g}"
+        )
+        assert merged.cost <= local.cost, (
+            f"distributed merge lost to single node: {merged.cost} > "
+            f"{local.cost} — trial determinism broke"
+        )
+        assert merged.digest() == local.digest(), (
+            "distributed and single-node winners diverged structurally "
+            "at the same seed set"
+        )
+    print("plansvc smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -225,7 +225,8 @@ def render_fleet_frame(
     head = (
         f"{'replica':<18} {'state':<7} {'hb age':>7} {'queue':>6} "
         f"{'qps':>7} {'p99 ms':>8} {'alerts':>6} {'model':>6} "
-        f"{'drift':>7} {'assign':>12} {'tenants':<18}"
+        f"{'drift':>7} {'plan':>6} {'trials':>6} {'best':>7} "
+        f"{'assign':>12} {'tenants':<18}"
     )
     lines = [
         f"fleet_top — {len(sources)} replicas   {time.strftime('%H:%M:%S')}",
@@ -245,6 +246,15 @@ def render_fleet_frame(
         model_s = f"v{version}" if version is not None else "-"
         drift = payload.get("drift_ratio")
         drift_s = f"{drift:.2f}" if drift is not None else "-"
+        # planner-fleet columns: the heartbeat carries each replica's
+        # plansvc role (coordinator/worker), the trials it has run,
+        # and the last merge's relative best-cost improvement — who is
+        # planning, how much, and whether it is paying off
+        psvc = payload.get("plansvc") or {}
+        plan_s = (psvc.get("role") or "-")[:6]
+        trials_s = str(psvc.get("trials", "-"))
+        delta = psvc.get("best_delta")
+        best_s = f"{delta * 100:+.1f}%" if delta else "-"
         # elastic columns: the root's heartbeat carries the last
         # collective round's per-process slice-range assignment; any
         # elastic-enabled replica carries its per-tenant queue depths
@@ -285,7 +295,8 @@ def render_fleet_frame(
         lines.append(
             f"{name:<18} {state:<7} {age_s:>7} {queue!s:>6} "
             f"{qps_s:>7} {p99_s:>8} {alerts!s:>6} {model_s:>6} "
-            f"{drift_s:>7} {assign_s:>12} {tenants_s:<18}"
+            f"{drift_s:>7} {plan_s:>6} {trials_s:>6} {best_s:>7} "
+            f"{assign_s:>12} {tenants_s:<18}"
         )
     return "\n".join(lines), completed_now
 

@@ -22,7 +22,6 @@ Pins the subsystem's contracts:
   the ``serve_elastic_*`` Prometheus families.
 """
 
-import threading
 import time
 
 import numpy as np

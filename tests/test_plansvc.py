@@ -1,0 +1,587 @@
+"""Planner fleet: symbolic plans, the trial board, and fleet serving.
+
+Pins the subsystem's contracts:
+
+- :class:`~tnc_tpu.contractionpath.symbolic.SymbolicPlan` wire
+  round-trips, digests by structure only (provenance never splits
+  identity), self-verifies on parse, and diffs structurally;
+- the partition move (arXiv:2507.20667) keeps the sliced-cost
+  evaluator consistent: ``_swap_leaves`` is self-inverse and an anneal
+  full of partition moves lands on a state whose incremental cost
+  equals a from-scratch evaluation;
+- trial grids are deterministic (same seed → same digests) and trials
+  are pure functions of (structure, spec);
+- the board's lease lifecycle: exclusive claims, mtime-stale reclaim
+  of a SIGKILL'd worker's lease (real subprocess), failure markers
+  terminating infeasible trials, corrupt/tampered records dropping;
+- the 2-process end-to-end path: a standalone worker's trial results
+  are merged by one replica's pod and adopted *live* by another
+  replica's running service through the shared-cache watcher — with
+  zero ``plan.find_path`` spans on the adopting replica and
+  bit-identical amplitudes between the two replicas once both serve
+  the merged plan;
+- replanner delegation: with a pod attached, the hot-key search runs
+  through the fleet (one code path), not the local hyper fallback.
+"""
+
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import tnc_tpu.obs as obs
+from tnc_tpu.contractionpath.contraction_cost import contract_path_cost
+from tnc_tpu.contractionpath.contraction_path import (
+    ContractionPath,
+    ssa_replace_ordering,
+)
+from tnc_tpu.contractionpath.symbolic import PlanDiff, SymbolicPlan, diff
+from tnc_tpu.obs.core import MetricsRegistry
+from tnc_tpu.serve import ContractionService, PlanCache
+from tnc_tpu.serve.plansvc import (
+    TrialBoard,
+    TrialSpec,
+    best_plan,
+    run_trial,
+    run_trials_local,
+    seed_trials,
+    work_board,
+)
+from tnc_tpu.tensornetwork.tensor import LeafTensor
+
+from tests.test_serve import make_circuit
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def enabled_obs():
+    reg = obs.configure(enabled=True, registry=MetricsRegistry())
+    try:
+        yield reg
+    finally:
+        obs.configure(enabled=False, registry=MetricsRegistry())
+
+
+def chain_leaves(n=8, dim=2):
+    """A line of n bond-dim-`dim` tensors: legs (i, i+1)."""
+    return [LeafTensor([i, i + 1], [dim, dim]) for i in range(n)]
+
+
+def find_path_spans():
+    return sum(
+        1
+        for r in obs.get_registry().span_records()
+        if r.name == "plan.find_path"
+    )
+
+
+# ---------------------------------------------------------------------------
+# symbolic plans
+
+
+class TestSymbolicPlan:
+    def test_wire_round_trip_and_digest_by_structure(self):
+        a = SymbolicPlan.from_search(
+            [(0, 1), (2, 3), (4, 5)], (9, 4), (2, 2), 123.0,
+            sliced_total=456.0, peak=64.0,
+            provenance={"trial": "t1"},
+        )
+        b = SymbolicPlan.from_obj(a.to_obj())
+        assert b == a
+        # provenance and costs are payload, not identity
+        c = SymbolicPlan.from_search(
+            [(0, 1), (2, 3), (4, 5)], (4, 9), (2, 2), 999.0,
+            provenance={"trial": "t2"},
+        )
+        assert c.digest() == a.digest()
+        # slice set co-sorted by leg on normalize
+        assert c.slice_legs == (4, 9)
+
+    def test_tampered_record_rejected(self):
+        plan = SymbolicPlan.from_search([(0, 1), (2, 3)], (7,), (2,), 1.0)
+        obj = plan.to_obj()
+        obj["pairs"][0] = [1, 0]  # structure no longer matches digest
+        with pytest.raises(ValueError, match="digest mismatch"):
+            SymbolicPlan.from_obj(obj)
+        with pytest.raises(ValueError, match="unusable"):
+            SymbolicPlan.from_obj({"version": 99})
+
+    def test_structural_diff(self):
+        a = SymbolicPlan.from_search(
+            [(0, 1), (4, 2), (5, 3)], (7,), (2,), 1.0
+        )
+        same = SymbolicPlan.from_search(
+            [(0, 1), (4, 2), (5, 3)], (7,), (2,), 2.0
+        )
+        d = diff(a, same)
+        assert isinstance(d, PlanDiff) and d.identical
+        b = SymbolicPlan.from_search(
+            [(2, 3), (4, 0), (5, 1)], (9,), (2,), 1.0
+        )
+        d = diff(a, b)
+        assert not d.identical
+        # the root subtree (all leaves) is always shared
+        assert d.shared_subtrees >= 1
+        assert d.slices_added == (9,) and d.slices_dropped == (7,)
+
+
+# ---------------------------------------------------------------------------
+# the partition move (arXiv:2507.20667)
+
+
+class TestPartitionMove:
+    def _tree_ev(self, leaves):
+        from tnc_tpu.contractionpath.paths.greedy import _ssa_greedy
+        from tnc_tpu.contractionpath.sliced_cost import (
+            ContractionTree,
+            SlicedCostEvaluator,
+        )
+
+        base = _ssa_greedy(list(leaves))
+        tree = ContractionTree.from_ssa_path(leaves, list(base))
+        full_dims = dict(tree.dims)
+        tree.dims = dict(tree.dims)
+        ev = SlicedCostEvaluator.from_tree(tree, dims=full_dims)
+        return tree, ev, full_dims
+
+    def _fresh_cost(self, tree, full_dims):
+        from tnc_tpu.contractionpath.sliced_cost import SlicedCostEvaluator
+
+        return SlicedCostEvaluator.from_tree(tree, dims=full_dims).cost()
+
+    def test_swap_leaves_self_inverse_and_evaluator_consistent(self):
+        from tnc_tpu.contractionpath.sliced_cost import _swap_leaves
+
+        tree, ev, full_dims = self._tree_ev(chain_leaves(8))
+        a, b = next(
+            (i, j)
+            for i in range(tree.num_leaves)
+            for j in range(tree.num_leaves)
+            if i != j and tree.nodes[i].parent != tree.nodes[j].parent
+        )
+        cost0 = ev.cost()
+        shape0 = [(nd.parent, nd.left, nd.right) for nd in tree.nodes]
+        legs0 = [set(nd.legs) for nd in tree.nodes]
+
+        _swap_leaves(tree, ev, a, b)
+        # incremental bookkeeping equals a from-scratch evaluation
+        assert ev.cost() == pytest.approx(
+            self._fresh_cost(tree, full_dims)
+        )
+        _swap_leaves(tree, ev, a, b)  # self-inverse: bitwise restore
+        assert [(nd.parent, nd.left, nd.right) for nd in tree.nodes] \
+            == shape0
+        assert [set(nd.legs) for nd in tree.nodes] == legs0
+        assert ev.cost() == pytest.approx(cost0)
+
+    def test_anneal_with_partition_moves_stays_consistent(self):
+        from tnc_tpu.contractionpath.sliced_cost import anneal_sliced
+
+        tree, ev, full_dims = self._tree_ev(chain_leaves(10))
+        anneal_sliced(
+            tree, ev, random.Random(0), 60, 0.5, 0.01, 2.0**30,
+            p_slice_move=0.0, p_partition_move=1.0,
+        )
+        assert ev.cost() == pytest.approx(
+            self._fresh_cost(tree, full_dims)
+        )
+
+
+# ---------------------------------------------------------------------------
+# trial specs and execution
+
+
+class TestTrials:
+    def test_spec_round_trip_and_version_pin(self):
+        spec = TrialSpec(kind="bisect", seed=7, imbalance=0.125)
+        assert TrialSpec.from_obj(spec.to_obj()) == spec
+        with pytest.raises(ValueError):
+            TrialSpec.from_obj({"version": 0, "kind": "sa"})
+
+    def test_seed_trials_deterministic_and_diverse(self):
+        a = seed_trials(7, seed=5)
+        b = seed_trials(7, seed=5)
+        assert [s.digest() for s in a] == [s.digest() for s in b]
+        assert len({s.digest() for s in a}) == 7
+        # trial 0: the no-search greedy baseline
+        assert a[0].kind == "greedy" and a[0].sa_steps == 0
+        kinds = {s.kind for s in a[1:]}
+        assert kinds == {"sa", "sa_partition", "bisect"}
+        assert all(
+            s.p_partition > 0 for s in a if s.kind == "sa_partition"
+        )
+        # a different seed moves the grid
+        assert [s.digest() for s in seed_trials(7, seed=6)] \
+            != [s.digest() for s in a]
+
+    def test_run_trial_deterministic(self):
+        leaves = chain_leaves(10)
+        spec = seed_trials(4, seed=42, sa_steps=60, sa_rounds=1)[1]
+        p1 = run_trial(spec, leaves, 2.0**30)
+        p2 = run_trial(spec, leaves, 2.0**30)
+        assert p1.digest() == p2.digest()
+        assert p1.cost == p2.cost
+
+    def test_best_plan_dedupes_and_orders(self):
+        a = SymbolicPlan.from_search([(0, 1), (2, 3)], (), (), 5.0)
+        a_dup = SymbolicPlan.from_search(
+            [(0, 1), (2, 3)], (), (), 5.0, provenance={"other": 1}
+        )
+        b = SymbolicPlan.from_search([(1, 2), (3, 0)], (), (), 9.0)
+        assert best_plan([None, b, a, a_dup]).digest() == a.digest()
+        assert best_plan([None, None]) is None
+
+
+# ---------------------------------------------------------------------------
+# the trial board
+
+
+class TestTrialBoard:
+    def test_structure_first_publisher_wins(self, tmp_path):
+        b1 = TrialBoard(tmp_path, owner="a")
+        b2 = TrialBoard(tmp_path, owner="b")
+        leaves = chain_leaves(4)
+        assert b1.publish_structure(leaves, 64.0, key="k") is True
+        assert b2.publish_structure(leaves, 64.0, key="k") is False
+        doc = b2.load_structure()
+        assert doc["key"] == "k" and doc["target_size"] == 64.0
+        assert [t.legs for t in doc["inputs"]] == [t.legs for t in leaves]
+
+    def test_stale_lease_reclaim_in_process(self, tmp_path):
+        b1 = TrialBoard(tmp_path, stale_after_s=0.2, owner="a")
+        b2 = TrialBoard(tmp_path, stale_after_s=0.2, owner="b")
+        spec = TrialSpec(kind="greedy", sa_steps=0, sa_rounds=0)
+        b1.post_trial(spec)
+        assert b1.claim(spec.digest()) is True
+        assert b2.claim(spec.digest()) is False  # fresh lease holds
+        time.sleep(0.3)
+        assert b2.claim(spec.digest()) is True  # stale → taken over
+        assert b2.stats["reclaims"] == 1
+        doc = json.loads(
+            (tmp_path / f"lease-{spec.digest()}.json").read_text()
+        )
+        assert doc["owner"] == "b"
+
+    def test_failure_marker_terminates_trial(self, tmp_path):
+        board = TrialBoard(tmp_path, owner="a")
+        board.publish_structure(chain_leaves(4), 64.0)
+        spec = TrialSpec(kind="greedy", sa_steps=0, sa_rounds=0)
+        board.post_trial(spec)
+        board.post_result(spec.digest(), None, error="unreachable")
+        assert board.done() is True  # failed counts as an outcome
+        assert board.results() == []
+        assert board.stats["failures"] == 1
+
+    def test_corrupt_and_tampered_results_drop(self, tmp_path):
+        board = TrialBoard(tmp_path, owner="a")
+        plan = SymbolicPlan.from_search([(0, 1), (2, 3)], (), (), 3.0)
+        board.post_result("good", plan)
+        (tmp_path / "result-torn.json").write_text("{not json")
+        tampered = plan.to_obj()
+        tampered["pairs"] = [[2, 3], [0, 1]]  # digest no longer matches
+        (tmp_path / "result-evil.json").write_text(json.dumps(tampered))
+        results = board.results()
+        assert [p.digest() for p in results] == [plan.digest()]
+        assert board.stats["corrupt"] == 2
+        assert not (tmp_path / "result-torn.json").exists()
+        assert not (tmp_path / "result-evil.json").exists()
+
+    def test_sigkilled_worker_lease_reclaimed_and_result_merged(
+        self, tmp_path
+    ):
+        """The lease lifecycle end to end, with a real dead process:
+        a standalone worker claims a trial and is SIGKILL'd while
+        holding the lease; after the staleness window, an in-process
+        worker reclaims the lease (atomic takeover), runs the trial,
+        and the board drains to a merged result."""
+        board = TrialBoard(tmp_path, stale_after_s=0.5, owner="parent")
+        board.publish_structure(chain_leaves(6), 2.0**30)
+        spec = TrialSpec(kind="greedy", sa_steps=0, sa_rounds=0)
+        board.post_trial(spec)
+
+        env = dict(os.environ)
+        env.setdefault("TNC_TPU_PLATFORM", "cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tnc_tpu.serve.plansvc",
+             str(tmp_path), "--owner", "victim",
+             "--hold-after-claim", "--stale-after", "0.5"],
+            cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        try:
+            line = proc.stdout.readline()
+            assert line.startswith("CLAIMED "), f"worker said: {line!r}"
+            assert line.split()[1] == spec.digest()
+        finally:
+            proc.kill()  # SIGKILL: the lease file stays behind
+            proc.wait(timeout=30)
+        assert os.path.exists(tmp_path / f"lease-{spec.digest()}.json")
+        assert not board.done()
+
+        time.sleep(0.6)  # past the staleness window
+        ran = work_board(board)
+        assert ran == 1
+        assert board.stats["reclaims"] == 1  # took the dead lease over
+        assert board.stats["claims"] == 0
+        assert board.done()
+        results = board.results()
+        assert len(results) == 1
+        local = run_trials_local(chain_leaves(6), 2.0**30, [spec])[0]
+        assert results[0].digest() == local.digest()
+
+
+# ---------------------------------------------------------------------------
+# service wiring
+
+
+class TestServiceWiring:
+    def test_plansvc_requires_plan_cache(self, tmp_path):
+        with pytest.raises(ValueError, match="plansvc requires"):
+            ContractionService.from_circuit(
+                make_circuit(seed=3), plansvc=True
+            )
+        svc = ContractionService.from_circuit(make_circuit(seed=3))
+        try:
+            with pytest.raises(ValueError, match="requires a plan_cache"):
+                svc.enable_plansvc()
+        finally:
+            svc.stop()
+
+    def test_stats_heartbeat_and_prometheus_surfaces(self, tmp_path):
+        cache = PlanCache(tmp_path)
+        svc = ContractionService.from_circuit(
+            make_circuit(seed=3), plan_cache=cache,
+            target_size=2.0**40,
+            plansvc=True, plansvc_dir=str(tmp_path / "boards"),
+            plansvc_options={
+                "ntrials": 2, "sa_steps": 40, "sa_rounds": 1,
+                "poll_interval_s": 3600.0,  # pod stays parked
+            },
+        )
+        try:
+            block = svc.stats()["plansvc"]
+            assert block["role"] == "idle"
+            assert set(block["counts"]) >= {"trials_run", "merges", "swaps"}
+            assert set(block["board"]) >= {"posts", "claims", "reclaims"}
+            hb = svc._plansvc.heartbeat_payload()
+            assert set(hb) == {"role", "trials", "best_delta"}
+            fams = {name for _, name, _, _ in svc._prometheus_families()}
+            assert "serve.plansvc.events" in fams
+            assert "serve.plansvc.board" in fams
+            assert "serve.plansvc.best_delta" in fams
+        finally:
+            svc.stop()
+        assert svc._plansvc is None  # stop() detached the pod
+
+
+# ---------------------------------------------------------------------------
+# replanner delegation
+
+
+class TestReplannerDelegation:
+    def test_hot_key_search_runs_through_the_fleet(self, tmp_path):
+        from tnc_tpu.serve.replan import BackgroundReplanner
+
+        cache = PlanCache(tmp_path / "cache")
+        svc = ContractionService.from_circuit(
+            make_circuit(seed=9), plan_cache=cache, target_size=2.0**40
+        )
+        try:
+            svc.enable_plansvc(
+                directory=str(tmp_path / "boards"),
+                ntrials=2, sa_steps=40, sa_rounds=1,
+                poll_interval_s=3600.0,  # the delegate drives the work
+                margin=1.5,  # any priced candidate may swap (test-only)
+            )
+            replanner = BackgroundReplanner(svc, cache)  # not started
+            swapped = replanner._attempt_once()
+            assert replanner.stats["delegated"] == 1
+            assert swapped is True
+            assert replanner.stats["swaps"] == 1
+            pod_counts = svc.stats()["plansvc"]["counts"]
+            assert pod_counts["trials_run"] == 2
+            assert pod_counts["merges"] == 1
+            assert pod_counts["swaps"] == 1
+            # the swap stages at a batch boundary; the next request
+            # serves from the fleet-merged plan
+            svc.amplitude("0" * 5, timeout_s=60)
+            assert svc.bound.plan["finder"] == "PlannerFleet"
+            # final verdict: the replanner never re-searches this key
+            assert replanner._attempt_once() is False
+            assert replanner.stats["delegated"] == 1
+        finally:
+            svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# the 2-process end-to-end adoption path
+
+
+class TestFleetAdoption:
+    def _sequential_plan(self, cache, tn, target):
+        """A deliberately bad (strictly sequential) incumbent, stored
+        through the normal cache path — so the fleet's merged best is
+        deterministically an improvement and structurally distinct."""
+        from tnc_tpu.ops.program import build_program, flat_leaf_tensors
+
+        leaves = flat_leaf_tensors(tn)
+        n = len(leaves)
+        ssa = [(0, 1)] + [(n + j, j + 2) for j in range(n - 2)]
+        path = ssa_replace_ordering(
+            ContractionPath.simple([list(p) for p in ssa])
+        )
+        program = build_program(tn, path)
+        flops, peak = contract_path_cost(leaves, path, True)
+        assert peak <= target
+        plan = cache.record_for(
+            path, program, flops=flops, peak=peak,
+            finder="Greedy", target_size=target,
+        )
+        return plan, program
+
+    def test_worker_result_adopted_live_by_watching_replica(
+        self, tmp_path, enabled_obs
+    ):
+        """Full loop across a real process boundary: a standalone
+        worker process runs the board's trials; replica B's pod merges
+        the winner through the shared plan cache; replica A's running
+        service — which has performed ZERO pathfinding — adopts it
+        live via the shared-cache watcher. Once both replicas serve
+        the merged plan, their amplitudes are bit-identical."""
+        circuit = make_circuit(seed=11)
+        target = 2.0**40
+        cache = PlanCache(tmp_path / "cache")
+        boards = tmp_path / "boards"
+
+        # seed the cache entry, then overwrite it with the bad
+        # sequential incumbent every replica will bind to
+        svc0 = ContractionService.from_circuit(
+            circuit, plan_cache=cache, target_size=target
+        )
+        tn = svc0.bound.template.network
+        key = cache.key_for_network(tn, target)
+        svc0.stop()
+        plan0, program0 = self._sequential_plan(cache, tn, target)
+        cache.store(key, plan0)
+
+        spans_before_a = find_path_spans()
+        svc_a = ContractionService.from_circuit(
+            make_circuit(seed=11), plan_cache=cache, target_size=target,
+            shared_cache_watch=True,
+            watch_options={"poll_interval_s": 0.05},
+        )
+        svc_b = None
+        try:
+            # replica A bound straight from the (bad) cache entry:
+            # zero pathfinding, serving the sequential plan
+            assert find_path_spans() == spans_before_a
+            assert svc_a.bound.program.signature_digest() \
+                == program0.signature_digest()
+            amp_before = svc_a.amplitude("0" * 5, timeout_s=60)
+
+            # the trial grid runs in a REAL separate process
+            board = TrialBoard(boards / key, owner="seeder")
+            from tnc_tpu.ops.program import flat_leaf_tensors
+
+            board.publish_structure(
+                flat_leaf_tensors(tn), target, key=key
+            )
+            specs = seed_trials(2, seed=42, sa_steps=40, sa_rounds=1)
+            for spec in specs:
+                board.post_trial(spec)
+            env = dict(os.environ)
+            env.setdefault("TNC_TPU_PLATFORM", "cpu")
+            out = subprocess.run(
+                [sys.executable, "-m", "tnc_tpu.serve.plansvc",
+                 str(boards / key), "--owner", "worker-proc"],
+                cwd=REPO, env=env, capture_output=True, text=True,
+                timeout=600,
+            )
+            assert out.returncode == 0, out.stdout + out.stderr
+            assert board.done()
+            assert len(board.results()) == len(specs)
+
+            # replica B joins, finds the board drained, merges the
+            # worker's best through the shared cache, swaps locally
+            svc_b = ContractionService.from_circuit(
+                make_circuit(seed=11), plan_cache=cache,
+                target_size=target,
+                plansvc=True, plansvc_dir=str(boards),
+                plansvc_options={
+                    "ntrials": 2, "sa_steps": 40, "sa_rounds": 1,
+                    "poll_interval_s": 0.01,
+                },
+            )
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if svc_b.stats()["plansvc"]["counts"]["swaps"] >= 1:
+                    break
+                time.sleep(0.05)
+            pod_stats = svc_b.stats()["plansvc"]
+            assert pod_stats["counts"]["swaps"] == 1, pod_stats
+            assert pod_stats["role"] == "worker"  # board pre-seeded
+            # B ran nothing locally: every result came from the worker
+            assert pod_stats["counts"]["trials_run"] == 0
+
+            # replica A's watcher adopts the publish live
+            deadline = time.monotonic() + 60
+            adopted = False
+            while time.monotonic() < deadline:
+                svc_a.amplitude("0" * 5, timeout_s=60)
+                if svc_a.stats()["counts"]["plan_swaps"] >= 1:
+                    adopted = True
+                    break
+                time.sleep(0.05)
+            assert adopted, svc_a.stats()["counts"]
+
+            # still ZERO pathfinding on A: the adoption rebuilt
+            # through the cache-hit path
+            assert find_path_spans() == spans_before_a
+
+            # value continuity across the swap (a different path
+            # re-associates float sums → approx, not bitwise) ...
+            amp_after = svc_a.amplitude("0" * 5, timeout_s=60)
+            assert amp_after == pytest.approx(amp_before, rel=1e-10)
+            # ... and bit-identity between the replicas now that both
+            # serve the SAME merged plan
+            svc_b.amplitude("0" * 5, timeout_s=60)  # apply staged swap
+            assert svc_a.bound.program.signature_digest() \
+                == svc_b.bound.program.signature_digest()
+            assert svc_a.bound.plan["finder"] == "PlannerFleet"
+            amp_b = svc_b.amplitude("0" * 5, timeout_s=60)
+            assert np.array_equal(
+                np.asarray(amp_after), np.asarray(amp_b)
+            )
+        finally:
+            svc_a.stop()
+            if svc_b is not None:
+                svc_b.stop()
+
+
+# ---------------------------------------------------------------------------
+# standalone CLI
+
+
+class TestWorkerCli:
+    def test_unseeded_board_exits_2(self, tmp_path):
+        from tnc_tpu.serve import plansvc
+
+        assert plansvc.main([str(tmp_path)]) == 2
+
+    def test_max_trials_bounds_a_run(self, tmp_path):
+        from tnc_tpu.serve import plansvc
+
+        board = TrialBoard(tmp_path, owner="seed")
+        board.publish_structure(chain_leaves(6), 2.0**30)
+        for spec in seed_trials(3, seed=1, sa_steps=20, sa_rounds=1):
+            board.post_trial(spec)
+        assert plansvc.main([str(tmp_path), "--max-trials", "1"]) == 0
+        assert len(board.result_digests()) == 1
+        assert plansvc.main([str(tmp_path)]) == 0
+        assert board.done()

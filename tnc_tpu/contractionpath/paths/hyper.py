@@ -600,9 +600,18 @@ def _bisection_path_impl(
     rng: random.Random,
     imbalance: float,
     cutoff: int,
+    discount_legs: frozenset[int] | None = None,
+    discount_weight: float = 0.125,
 ) -> list[tuple[int, int]]:
     """One randomized top-down bisection trial (module-level so the
-    trial pool's spawn workers can run it)."""
+    trial pool's spawn workers can run it).
+
+    ``discount_legs`` makes the cut slice-aware: legs in the set (a
+    candidate slice set) get cut weight ``discount_weight`` instead of
+    ``log2(bond dim)``, steering the partitioner toward cutting legs
+    that will be sliced away anyway. An explicit weight override is
+    required — dim-based discounting is a no-op on bond-dimension-2
+    circuit legs, where ``log2(max(2, d))`` is 1 for every leg."""
     legs = dict(legs_map)
     next_id = start_id
     ssa_path: list[tuple[int, int]] = []
@@ -647,7 +656,10 @@ def _bisection_path_impl(
         for leg, pins in pin_lists.items():
             if len(pins) >= 2:
                 edge_pins.append(pins)
-                edge_weights.append(math.log2(max(2, dims[leg])))
+                if discount_legs is not None and leg in discount_legs:
+                    edge_weights.append(discount_weight)
+                else:
+                    edge_weights.append(math.log2(max(2, dims[leg])))
         sub = Hypergraph(len(ids), [1.0] * len(ids), edge_pins, edge_weights)
         sides = bisect(sub, imbalance, rng)
         left = [v for v, s in zip(ids, sides) if s == 0]

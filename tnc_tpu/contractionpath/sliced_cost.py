@@ -511,12 +511,21 @@ def anneal_sliced(
     target_size: float,
     max_slices: int = 1 << 26,
     p_slice_move: float = 0.25,
+    p_partition_move: float = 0.0,
 ) -> None:
     """SA-style interleaved refinement: tree rotation moves and
     slice-set swap moves, both accepted by Metropolis on the log2 ratio
     of the evaluator's hoisted sliced cost, under the peak budget.
     ``tree.dims`` is kept as the *reduced* model (sliced legs dim 1) so
-    DP repair passes interleaved by the caller see the slice set."""
+    DP repair passes interleaved by the caller see the slice set.
+
+    ``p_partition_move`` enables a third move kind — a leaf exchange
+    between two subtrees (the partition move of the joint
+    partition+slice SA, arXiv:2507.20667), which escapes basins that
+    rotations alone cannot leave because a rotation never changes which
+    leaves share a subtree. Off by default: the committed planner
+    baselines were annealed without it; fleet trial grids
+    (:mod:`tnc_tpu.serve.plansvc`) opt in per-trial."""
     internal = [i for i, nd in enumerate(tree.nodes)
                 if not nd.is_leaf and i in ev._slot_of]
     if not internal:
@@ -525,9 +534,16 @@ def anneal_sliced(
     for step in range(steps):
         frac = step / max(1, steps - 1)
         temp = t_start * (t_end / t_start) ** frac
-        if rng.random() < p_slice_move and ev.removed:
+        move_draw = rng.random()
+        if move_draw < p_slice_move and ev.removed:
             _slice_move(tree, ev, rng, temp, target_size, max_slices,
                         full_dims)
+            continue
+        if (
+            p_partition_move > 0.0
+            and p_slice_move <= move_draw < p_slice_move + p_partition_move
+        ):
+            _partition_move(tree, ev, rng, temp, target_size)
             continue
         p = internal[rng.randrange(len(internal))]
         if not tree._reachable(p):
@@ -612,6 +628,75 @@ def _slice_move(
         settle(ok, add, None)
 
 
+def _partition_move(
+    tree: ContractionTree,
+    ev: SlicedCostEvaluator,
+    rng: random.Random,
+    temp: float,
+    target_size: float,
+) -> None:
+    """One partition move (arXiv:2507.20667): exchange two random
+    leaves that sit under different parents, re-deriving legs and
+    evaluator slots only along the two parent→LCA chains (above the
+    LCA the subtree leaf set — hence every leg set — is unchanged).
+    Accepted like a rotation; revert is the same swap again."""
+    n = tree.num_leaves
+    if n < 4:
+        return
+    a = rng.randrange(n)
+    b = rng.randrange(n)
+    if a == b or tree.nodes[a].parent == tree.nodes[b].parent:
+        return
+    if tree.nodes[a].parent < 0 or tree.nodes[b].parent < 0:
+        return
+    old_cost = ev.cost()
+    _swap_leaves(tree, ev, a, b)
+    ok = ev.peak() <= target_size and _sa_accept(
+        _log2_delta(ev.cost(), old_cost), temp, rng
+    )
+    if not ok:
+        _swap_leaves(tree, ev, a, b)
+
+
+def _swap_leaves(
+    tree: ContractionTree, ev: SlicedCostEvaluator, a: int, b: int
+) -> None:
+    """Exchange leaves ``a`` and ``b`` in the tree and bring ``ev``
+    back in sync. Self-inverse (calling it twice restores the state
+    bitwise), which is what makes the SA revert trivial."""
+    nodes = tree.nodes
+    pa, pb = nodes[a].parent, nodes[b].parent
+    if nodes[pa].left == a:
+        nodes[pa].left = b
+    else:
+        nodes[pa].right = b
+    if nodes[pb].left == b:
+        nodes[pb].left = a
+    else:
+        nodes[pb].right = a
+    nodes[a].parent, nodes[b].parent = pb, pa
+
+    def ancestors(i: int) -> list[int]:
+        out = []
+        while i >= 0:
+            out.append(i)
+            i = nodes[i].parent
+        return out
+
+    chain_a, chain_b = ancestors(pa), ancestors(pb)
+    on_a = set(chain_a)
+    lca = next(i for i in chain_b if i in on_a)
+    below_a = chain_a[: chain_a.index(lca)]
+    below_b = chain_b[: chain_b.index(lca)]
+    # legs first (chain order is bottom-up; chains are disjoint below
+    # the LCA), then the evaluator — sync_nodes reads current child
+    # legs. The LCA's own legs are invariant but its step cost is not.
+    for i in below_a + below_b:
+        nd = nodes[i]
+        nd.legs = nodes[nd.left].legs ^ nodes[nd.right].legs
+    ev.sync_nodes(tree, below_a + below_b + [lca])
+
+
 def joint_slice_search(
     inputs: Sequence[LeafTensor],
     ssa_path: Sequence[tuple[int, int]],
@@ -626,6 +711,7 @@ def joint_slice_search(
     seed: int = 42,
     max_slices: int = 1 << 26,
     temps: tuple[float, float] = (0.3, 0.01),
+    p_partition_move: float = 0.0,
 ) -> tuple[list[tuple[int, int]], "Slicing", float]:
     """Joint tree+slice refinement of one candidate tree: greedy slice
     seeding (or ``seed_slices``), then rounds of interleaved SA
@@ -672,7 +758,7 @@ def joint_slice_search(
     for _ in range(max(0, sa_rounds)):
         anneal_sliced(
             tree, ev, rng, sa_steps, temps[0], temps[1], target_size,
-            max_slices,
+            max_slices, p_partition_move=p_partition_move,
         )
         track()
         if reconf_rounds > 0:

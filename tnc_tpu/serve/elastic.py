@@ -161,6 +161,11 @@ def assign_ranges(
     concatenation of partials is independent of *which* processes are
     alive.  With no live member (degenerate roster) everything lands on
     process 0.
+
+    >>> assign_ranges(10, {0, 2}, 3)  # slot 1 is dead
+    [(0, 5), (0, 0), (5, 10)]
+    >>> assign_ranges(10, set(), 3)  # degenerate roster -> root
+    [(0, 10), (0, 0), (0, 0)]
     """
     from tnc_tpu.serve.multihost import shard_ranges
 

@@ -295,6 +295,7 @@ class BackgroundReplanner:
         self._keyed_key: str | None = None
         self.stats = {
             "attempts": 0, "swaps": 0, "rejects": 0, "measured_margins": 0,
+            "delegated": 0,
         }
 
     # -- lifecycle ---------------------------------------------------------
@@ -413,6 +414,21 @@ class BackgroundReplanner:
             return False
         self.stats["attempts"] += 1
         obs.counter_add("serve.replan.attempt")
+
+        # with a planner fleet attached, hot-key searches fan out over
+        # idle replicas instead of running one local hyper trial set —
+        # one code path for replanning and fleet planning, no race on
+        # the same cache key. The local search below stays the
+        # no-fleet fallback.
+        pod = getattr(self.service, "_plansvc", None)
+        if pod is not None and pod.supports(bound):
+            self.stats["delegated"] += 1
+            obs.counter_add("serve.replan.delegated")
+            swapped = pod.delegate(bound, key)
+            if swapped:
+                self.stats["swaps"] += 1
+            self._done_keys.add(key)
+            return swapped
 
         if (
             self._default_optimizer

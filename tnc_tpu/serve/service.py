@@ -249,6 +249,7 @@ class ContractionService:
         # the dispatcher adopts it at the next batch boundary
         self._pending_bound: BoundProgram | None = None
         self._replanner = None  # attached BackgroundReplanner, if any
+        self._plansvc = None  # attached PlannerFleet pod, if any
         self._watchers: list = []  # attached SharedCacheWatchers
         self._rids = itertools.count(1)
         # plan-swap generation: bumps on every adopted replan/shared
@@ -304,6 +305,9 @@ class ContractionService:
         fleet_heartbeat_s: float = 2.0,
         cost_truth: bool = False,
         cost_truth_options: dict | None = None,
+        plansvc: bool = False,
+        plansvc_dir: str | None = None,
+        plansvc_options: dict | None = None,
         **kwargs,
     ) -> "ContractionService":
         """Build (plan/compile once, plan cache honored) and start.
@@ -350,11 +354,22 @@ class ContractionService:
         drift-triggered cost-model refits, versioned model adoption
         and the plan scoreboard + post-swap rollback watch.
         ``cost_truth_options`` are its kwargs (notably ``registry=`` —
-        a shared model-registry directory for fleet-wide adoption)."""
+        a shared model-registry directory for fleet-wide adoption).
+
+        ``plansvc=True`` (requires ``plan_cache``) attaches a
+        :class:`~tnc_tpu.serve.plansvc.PlannerFleet` pod
+        (:meth:`enable_plansvc`): idle windows run distributed planner
+        trials against the shared trial board (``plansvc_dir``,
+        defaulting to a ``plansvc/`` sibling inside the plan-cache
+        directory) and the merged best publishes through the plan
+        cache so every watching replica adopts it. ``plansvc_options``
+        are its constructor kwargs."""
         if background_replan and plan_cache is None:
             raise ValueError("background_replan requires a plan_cache")
         if shared_cache_watch and plan_cache is None:
             raise ValueError("shared_cache_watch requires a plan_cache")
+        if plansvc and plan_cache is None:
+            raise ValueError("plansvc requires a plan_cache")
         query_circuit = circuit.copy() if queries else None
         approx_circuit = circuit.copy() if approx else None
         bound = bind_circuit(
@@ -388,6 +403,10 @@ class ContractionService:
                 )
                 svc._watchers.append(watcher)
                 watcher.start()
+            if plansvc:
+                svc.enable_plansvc(
+                    directory=plansvc_dir, **(plansvc_options or {})
+                )
             if cost_truth or cost_truth_options:
                 svc.enable_cost_truth(**(cost_truth_options or {}))
             if telemetry_port is not None:
@@ -422,7 +441,13 @@ class ContractionService:
         """Stop accepting requests; by default finish ('drain') what is
         already queued, otherwise fail queued requests with
         :class:`ServiceClosedError`. An attached background replanner
-        is stopped first (it must not swap into a closing service)."""
+        is stopped first (it must not swap into a closing service).
+        The planner pod goes before even that: the replanner's
+        delegate path blocks on the pod, and the pod's stop flag is
+        what unblocks it."""
+        pod, self._plansvc = self._plansvc, None
+        if pod is not None:
+            pod.stop()
         replanner, self._replanner = self._replanner, None
         if replanner is not None:
             replanner.stop()
@@ -634,6 +659,31 @@ class ContractionService:
         self.register_query_handler(router)
         self._router = router
         self._ensure_tier("approx")
+        return self
+
+    def enable_plansvc(
+        self, directory: str | None = None, **options
+    ) -> "ContractionService":
+        """Attach a :class:`~tnc_tpu.serve.plansvc.PlannerFleet` pod:
+        a daemon that — only while the request queue is empty — runs
+        distributed planner trials against the shared trial board
+        under ``directory`` (default: a ``plansvc/`` sibling inside
+        the plan-cache directory) and merges the fleet's best plan
+        through the plan cache + ``swap_bound``. Requires the service
+        to have been built with a plan cache. ``options`` are
+        :class:`~tnc_tpu.serve.plansvc.PlannerFleet` kwargs
+        (``ntrials``, ``margin``, ``sa_steps``, ``cost_model``...).
+        Idempotent re-attach replaces the previous pod."""
+        from tnc_tpu.serve.plansvc import PlannerFleet
+
+        if self._plan_cache is None:
+            raise ValueError("enable_plansvc requires a plan_cache")
+        if self._plansvc is not None:
+            self._plansvc.stop()
+            self._plansvc = None
+        PlannerFleet(
+            self, self._plan_cache, directory=directory, **options
+        ).start()
         return self
 
     @property
@@ -1743,6 +1793,8 @@ class ContractionService:
             out["plan_cache"] = self._plan_cache.stats()
         if self._slo is not None:
             out["slo"] = self._slo.stats()
+        if self._plansvc is not None:
+            out["plansvc"] = self._plansvc.stats()
         if self._cost_truth is not None:
             out["calibration"] = self._cost_truth.stats()
         if self._elastic is not None:
@@ -1899,6 +1951,10 @@ class ContractionService:
                     payload["model_version"] = (
                         self._cost_truth.model_version
                     )
+                if self._plansvc is not None:
+                    # planner columns for serve_top --fleet: role,
+                    # trials completed here, last merge's cost delta
+                    payload["plansvc"] = self._plansvc.heartbeat_payload()
                 if self._elastic is not None:
                     from tnc_tpu.serve import elastic as _elastic_mod
 
@@ -2007,6 +2063,22 @@ class ContractionService:
                 fams.append(
                     ("counter", "serve.plan_cache", {"event": key}, value)
                 )
+        if self._plansvc is not None:
+            svc_stats = self._plansvc.stats()
+            for key, value in sorted(svc_stats["counts"].items()):
+                fams.append(
+                    ("counter", "serve.plansvc.events", {"event": key},
+                     value)
+                )
+            for key, value in sorted(svc_stats["board"].items()):
+                fams.append(
+                    ("counter", "serve.plansvc.board", {"event": key},
+                     value)
+                )
+            fams.append(
+                ("gauge", "serve.plansvc.best_delta", {},
+                 svc_stats["best_delta"])
+            )
 
         def summary(name: str, labels: dict, block: dict, total: float):
             for q, qlabel in (("p50", "0.5"), ("p90", "0.9"), ("p99", "0.99")):
